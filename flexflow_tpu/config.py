@@ -1,0 +1,149 @@
+"""Runtime configuration.
+
+TPU-native equivalent of the reference's FFConfig
+(reference: include/flexflow/config.h:92-167, src/runtime/model.cc:3501-3730).
+
+Where the reference queries the Realm machine model for node/GPU counts, we
+query ``jax.devices()``; where it carries Legion knobs (`-ll:gpu`, zero-copy
+memory sizes), we carry mesh-shape and XLA knobs. CLI parsing mirrors
+``FFConfig::parse_args`` so reference users find the same flags.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+
+from .ffconst import CompMode
+
+
+@dataclasses.dataclass
+class FFIterationConfig:
+    """Per-iteration dynamic config (reference: config.h:162-167).
+
+    ``seq_length`` truncates sequence models to the batch's true length.
+    Under jit each distinct value compiles its own executable (bucketing),
+    which plays the role of the reference's iteration-level truncation.
+    """
+
+    seq_length: int = -1
+
+    def reset(self) -> None:
+        self.seq_length = -1
+
+
+@dataclasses.dataclass
+class FFConfig:
+    """Global runtime config (reference: config.h:92-160 fields,
+    model.cc:3566-3730 ``parse_args``)."""
+
+    batch_size: int = 64
+    epochs: int = 1
+    learning_rate: float = 0.01
+    weight_decay: float = 1e-4
+    # parallelism/search knobs (reference: config.h:116-160)
+    num_nodes: int = 1
+    workers_per_node: int = 0  # 0 => autodetect
+    search_budget: int = 0
+    search_alpha: float = 1.2
+    search_overlap_backward_update: bool = False
+    only_data_parallel: bool = False
+    enable_sample_parallel: bool = True
+    enable_parameter_parallel: bool = False
+    enable_attribute_parallel: bool = False
+    enable_inplace_optimizations: bool = True
+    perform_fusion: bool = False
+    perform_memory_search: bool = False
+    substitution_json_path: Optional[str] = None
+    machine_model_file: Optional[str] = None
+    export_strategy_file: Optional[str] = None
+    import_strategy_file: Optional[str] = None
+    export_strategy_computation_graph_file: Optional[str] = None
+    include_costs_dot_graph: bool = False
+    base_optimize_threshold: int = 10
+    # profiling / tracing
+    profiling: bool = False
+    print_freq: int = 10
+    # numerics
+    computation_mode: CompMode = CompMode.TRAINING
+    seed: int = 0
+    # mesh description: axis names and sizes; None => 1-D data mesh over all
+    # visible devices (reference analog: register_all_machine_views'
+    # 1-D GPU views, src/runtime/graph.cc:2329-2360)
+    mesh_shape: Optional[dict] = None
+
+    def __post_init__(self):
+        if self.workers_per_node == 0:
+            self.workers_per_node = max(1, len(jax.devices()) // max(1, self.num_nodes))
+
+    @property
+    def num_devices(self) -> int:
+        return self.num_nodes * self.workers_per_node
+
+    @staticmethod
+    def parse_args(argv: Sequence[str]) -> "FFConfig":
+        """CLI flag parsing mirroring FFConfig::parse_args
+        (reference: src/runtime/model.cc:3566-3730)."""
+        cfg = FFConfig()
+        it = iter(range(len(argv)))
+        args = list(argv)
+        i = 0
+        while i < len(args):
+            a = args[i]
+
+            def _next():
+                nonlocal i
+                i += 1
+                return args[i]
+
+            if a in ("-e", "--epochs"):
+                cfg.epochs = int(_next())
+            elif a in ("-b", "--batch-size"):
+                cfg.batch_size = int(_next())
+            elif a in ("--lr", "--learning-rate"):
+                cfg.learning_rate = float(_next())
+            elif a in ("--wd", "--weight-decay"):
+                cfg.weight_decay = float(_next())
+            elif a == "--budget" or a == "--search-budget":
+                cfg.search_budget = int(_next())
+            elif a == "--alpha" or a == "--search-alpha":
+                cfg.search_alpha = float(_next())
+            elif a == "--only-data-parallel":
+                cfg.only_data_parallel = True
+            elif a == "--enable-parameter-parallel":
+                cfg.enable_parameter_parallel = True
+            elif a == "--enable-attribute-parallel":
+                cfg.enable_attribute_parallel = True
+            elif a == "--fusion":
+                cfg.perform_fusion = True
+            elif a == "--memory-search":
+                cfg.perform_memory_search = True
+            elif a == "--profiling":
+                cfg.profiling = True
+            elif a == "--print-freq":
+                cfg.print_freq = int(_next())
+            elif a == "--substitution-json":
+                cfg.substitution_json_path = _next()
+            elif a == "--machine-model-file":
+                cfg.machine_model_file = _next()
+            elif a == "--export-strategy":
+                cfg.export_strategy_file = _next()
+            elif a == "--import-strategy":
+                cfg.import_strategy_file = _next()
+            elif a == "--taskgraph":
+                cfg.export_strategy_file = _next()
+            elif a == "--compgraph":
+                cfg.export_strategy_computation_graph_file = _next()
+            elif a == "--include-costs-dot-graph":
+                cfg.include_costs_dot_graph = True
+            elif a == "--nodes":
+                cfg.num_nodes = int(_next())
+            elif a in ("-ll:gpu", "-ll:tpu", "--workers-per-node"):
+                cfg.workers_per_node = int(_next())
+            elif a == "--seed":
+                cfg.seed = int(_next())
+            # unknown flags are ignored, matching the reference's tolerance
+            i += 1
+        return cfg

@@ -1,0 +1,47 @@
+"""Lazy layer graph node.
+
+TPU-native equivalent of the reference's ``Layer``
+(reference: include/flexflow/layer.h:10-61, src/runtime/layer.cc). A Layer
+records the op type, its attributes (the reference's key/value property
+store — ``Layer::add_int_property`` et al.), its input tensors, and its
+output tensors. ``FFModel.compile`` lowers Layers to Ops over
+ParallelTensors (reference: model.cc:2785 ``create_operators_from_layers``).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, List, Optional
+
+from ..ffconst import OpType
+from .tensor import Tensor
+
+_layer_ids = itertools.count()
+
+
+class Layer:
+    def __init__(
+        self,
+        op_type: OpType,
+        name: Optional[str] = None,
+        inputs: Optional[List[Tensor]] = None,
+        attrs: Optional[Dict[str, Any]] = None,
+    ):
+        self.layer_guid: int = next(_layer_ids)
+        self.op_type = op_type
+        self.name = name or f"{op_type.value}_{self.layer_guid}"
+        self.inputs: List[Tensor] = list(inputs or [])
+        self.outputs: List[Tensor] = []
+        self.weights: List[Tensor] = []
+        # key/value attribute store (reference: layer.h add_int_property /
+        # add_float_property / add_string_property / add_initializer)
+        self.attrs: Dict[str, Any] = dict(attrs or {})
+
+    def add_property(self, key: str, value: Any) -> None:
+        self.attrs[key] = value
+
+    def get_property(self, key: str, default: Any = None) -> Any:
+        return self.attrs.get(key, default)
+
+    def __repr__(self) -> str:
+        return f"Layer({self.name}, {self.op_type.value}, in={[t.name for t in self.inputs]})"

@@ -1,0 +1,94 @@
+"""Device-placement abstractions.
+
+TPU-native equivalents of the reference's ``MachineView`` /
+``ParallelConfig`` / ``MachineResource``
+(reference: include/flexflow/machine_view.h:14-96,
+src/runtime/machine_view.cc, ``register_all_machine_views``
+src/runtime/graph.cc:2329-2360).
+
+Where the reference describes a strided nd-grid of GPU device ids, the TPU
+design describes a **named device mesh** (``jax.sharding.Mesh``): mesh axes
+play machine-view dimensions; the XLA SPMD partitioner plays the FFMapper
+(task→device placement). ``MachineView`` here is a lightweight named view
+over a subset of mesh axes used by strategies and (later) the search.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+# canonical axis names (the strategy vocabulary)
+DATA_AXIS = "data"      # sample/batch parallelism
+MODEL_AXIS = "model"    # parameter/attribute (tensor) parallelism
+PIPE_AXIS = "pipe"      # pipeline parallelism
+SEQ_AXIS = "seq"        # sequence/context parallelism
+EXPERT_AXIS = "expert"  # expert parallelism
+
+
+@dataclasses.dataclass(frozen=True)
+class MachineView:
+    """A named nd-view of devices (reference: machine_view.h:14-60).
+
+    ``axes`` maps mesh-axis name → degree. The product of degrees is the
+    number of devices the view spans. The reference's ``start_device_id`` /
+    stride encoding is subsumed by mesh coordinates.
+    """
+
+    axes: Tuple[Tuple[str, int], ...]
+
+    @staticmethod
+    def from_dict(d: Dict[str, int]) -> "MachineView":
+        return MachineView(tuple((k, int(v)) for k, v in d.items() if v > 1))
+
+    @property
+    def num_devices(self) -> int:
+        n = 1
+        for _, deg in self.axes:
+            n *= deg
+        return n
+
+    def degree(self, axis: str) -> int:
+        for a, deg in self.axes:
+            if a == axis:
+                return deg
+        return 1
+
+    def __str__(self) -> str:
+        return "MachineView(" + ",".join(f"{a}={d}" for a, d in self.axes) + ")"
+
+
+def make_mesh(
+    mesh_shape: Optional[Dict[str, int]] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build the global device mesh.
+
+    Default (no ``mesh_shape``): a 1-D data mesh over all devices — the
+    analog of the reference's default 1-D machine views
+    (graph.cc:2329-2360, all-divisor 1-D GPU views).
+
+    ``mesh_shape`` example: ``{"data": 2, "model": 4}``. Axis order follows
+    insertion order; put the fastest-communicating axis (tensor-parallel)
+    last so it lands on the innermost ICI ring.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if not mesh_shape:
+        mesh_shape = {DATA_AXIS: len(devices)}
+    sizes = [int(v) for v in mesh_shape.values()]
+    n = int(np.prod(sizes))
+    if n != len(devices):
+        raise ValueError(
+            f"mesh shape {mesh_shape} needs {n} devices, have {len(devices)}"
+        )
+    dev_array = np.asarray(devices, dtype=object).reshape(sizes)
+    return Mesh(dev_array, tuple(mesh_shape.keys()))
+
+
+def mesh_axis_sizes(mesh: Mesh) -> Dict[str, int]:
+    return dict(zip(mesh.axis_names, mesh.devices.shape))

@@ -1,0 +1,159 @@
+"""Operator base class and registry.
+
+TPU-native re-design of the reference's ``Op``
+(reference: include/flexflow/operator.h:51-277). The reference Op carries
+Legion task launchers (``init/forward/backward``), per-device ``OpMeta``,
+region requirements, and a ``measure_operator_cost`` hook. Here an Op is a
+pure function over jax arrays plus metadata:
+
+* ``infer_output_shapes`` — shape rule (reference: each op's output-shape
+  logic in its constructor, e.g. src/ops/linear.cc).
+* ``weight_specs`` — declared weights with initializers (reference: weight
+  ParallelTensor creation per op).
+* ``forward`` — jax lowering. **No hand-written backward**: the whole step
+  is differentiated with ``jax.grad``; custom VJPs appear only where a
+  Pallas kernel needs one.
+* ``propagate`` — parallel-dim mapping: given input ParallelTensorShapes and
+  this op's strategy, produce output/weight shardings (reference:
+  ``ParallelDimMappingRecord`` operator.h:22 + ``solve_parallel_dim_mappings``
+  model.h:238).
+* ``flops``/cost hooks for the simulator (reference:
+  ``measure_operator_cost``).
+
+The per-device ``OpMeta``/``FFHandler`` machinery has no equivalent: device
+state lives in sharded arrays, and XLA owns kernel selection.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Type
+
+import jax
+import jax.numpy as jnp
+
+from ..ffconst import DataType, OpType
+from .layer import Layer
+from .machine import DATA_AXIS, MachineView
+from .parallel_tensor import ParallelDim, ParallelTensorShape
+
+
+@dataclasses.dataclass
+class WeightSpec:
+    """A trainable weight declared by an op."""
+
+    name: str
+    shape: Tuple[int, ...]
+    dtype: DataType = DataType.FLOAT
+    initializer: Optional[Any] = None  # Initializer instance or None => op default
+    weight_decay: bool = True          # dense kernels yes, biases/norm scales no
+
+
+@dataclasses.dataclass
+class LowerCtx:
+    """Context threaded through op lowering inside the jitted step."""
+
+    mesh: Any = None
+    training: bool = True
+    rng: Optional[jax.Array] = None      # per-op PRNG key (dropout etc.)
+    seq_length: int = -1                 # FFIterationConfig.seq_length
+    compute_dtype: Optional[Any] = None  # e.g. jnp.bfloat16 for mixed precision
+    # auxiliary losses collected during forward (e.g. MoE load-balancing —
+    # the reference injects these as hand-written gradients in aggregate.cu;
+    # here they are differentiable terms added to the training loss)
+    aux_losses: Optional[list] = None
+
+
+class Op:
+    """Base operator. Subclasses set ``op_type`` and implement the hooks."""
+
+    op_type: OpType = OpType.NOOP
+
+    def __init__(self, layer: Layer, input_shapes: List[ParallelTensorShape]):
+        self.layer = layer
+        self.name = layer.name
+        self.attrs = layer.attrs
+        self.input_shapes = input_shapes
+        # filled by the compiler:
+        self.output_shapes: List[ParallelTensorShape] = []
+        self.weight_shapes: Dict[str, ParallelTensorShape] = {}
+        self.machine_view: Optional[MachineView] = None
+
+    # ---- shape rule -------------------------------------------------------
+    def infer_output_shapes(self) -> List[Tuple[Tuple[int, ...], DataType]]:
+        raise NotImplementedError
+
+    # ---- weights ----------------------------------------------------------
+    def weight_specs(self) -> List[WeightSpec]:
+        return []
+
+    # ---- lowering ---------------------------------------------------------
+    def forward(
+        self,
+        ctx: LowerCtx,
+        inputs: Sequence[jnp.ndarray],
+        weights: Dict[str, jnp.ndarray],
+    ) -> List[jnp.ndarray]:
+        raise NotImplementedError
+
+    # ---- parallel-dim mapping --------------------------------------------
+    def propagate(
+        self, input_shapes: List[ParallelTensorShape], strategy: Dict[str, str]
+    ) -> Tuple[List[ParallelTensorShape], Dict[str, ParallelTensorShape]]:
+        """Map input shardings to output/weight shardings under ``strategy``.
+
+        Default rule (covers most elementwise/batch-preserving ops): outputs
+        inherit the partitioning of input 0 on dims they share size with,
+        batch dim first; weights replicated. Mirrors the identity
+        parallel-dim mapping records most reference ops register.
+        """
+        out_shapes = []
+        in0 = input_shapes[0] if input_shapes else None
+        for sizes, dtype in self.infer_output_shapes():
+            dims = []
+            for i, s in enumerate(sizes):
+                src = None
+                if in0 is not None and i < len(in0.dims) and in0.dims[i].size == s:
+                    src = in0.dims[i]
+                if src is not None and src.is_partitioned:
+                    dims.append(ParallelDim(s, src.degree, src.axis))
+                else:
+                    dims.append(ParallelDim(s))
+            out_shapes.append(ParallelTensorShape(tuple(dims), dtype))
+        weight_shapes = {
+            ws.name: ParallelTensorShape.unpartitioned(ws.shape, ws.dtype)
+            for ws in self.weight_specs()
+        }
+        return out_shapes, weight_shapes
+
+    # ---- cost hooks (simulator; reference: measure_operator_cost) --------
+    def flops(self) -> float:
+        """Forward FLOPs estimate for the analytic cost model."""
+        return 0.0
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}({self.name})"
+
+
+# ---------------------------------------------------------------------------
+# registry: OpType -> Op subclass (reference analog: the create_operator_from
+# _layer static-factory switch, src/runtime/model.cc:2605)
+# ---------------------------------------------------------------------------
+_OP_REGISTRY: Dict[OpType, Type[Op]] = {}
+
+
+def register_op(cls: Type[Op]) -> Type[Op]:
+    _OP_REGISTRY[cls.op_type] = cls
+    return cls
+
+
+def create_op(layer: Layer, input_shapes: List[ParallelTensorShape]) -> Op:
+    try:
+        cls = _OP_REGISTRY[layer.op_type]
+    except KeyError:
+        raise NotImplementedError(f"no op registered for {layer.op_type}") from None
+    return cls(layer, input_shapes)
+
+
+def registered_ops() -> Dict[OpType, Type[Op]]:
+    return dict(_OP_REGISTRY)

@@ -1,0 +1,134 @@
+"""Partitioned-tensor IR: the heart of the Unity PCG algebra.
+
+TPU-native equivalent of the reference's ``ParallelDim`` /
+``ParallelTensorShape`` / ``ParallelTensorBase``
+(reference: include/flexflow/parallel_tensor.h:36-198,
+src/runtime/parallel_tensor.cc).
+
+Key design translation (SURVEY.md section 7 table):
+
+* reference ``ParallelDim {size, degree, parallel_idx, is_replica_dim}``
+  → here each dim carries ``degree`` plus the *mesh axis name* it is
+  sharded over. The mesh axis plays the role of ``parallel_idx`` (which
+  machine-view dimension realizes the partitioning).
+* a replica dim (``is_replica_dim``) — an extra degree-only dimension used
+  by the reference to express replication with gradient-reduction pairing —
+  maps to the tensor being *replicated* over a mesh axis, recorded in
+  ``replica_axes``. XLA's SPMD partitioner then emits the matching
+  all-reduce / reduce-scatter in the backward pass, exactly the pairing
+  parallel_tensor.h:70 encodes by hand.
+* the Legion region/partition handles have no equivalent: data placement is
+  fully described by a ``jax.sharding.NamedSharding`` derived from this
+  shape via :meth:`ParallelTensorShape.partition_spec`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+from jax.sharding import PartitionSpec
+
+from ..ffconst import DataType
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelDim:
+    """One tensor dimension with its partitioning.
+
+    reference: parallel_tensor.h:36-71.
+    ``axis`` is the mesh-axis name this dim is sharded over (None ⇒ degree 1,
+    i.e. the dim is not partitioned).
+    """
+
+    size: int
+    degree: int = 1
+    axis: Optional[str] = None  # mesh axis realizing the partition
+
+    def __post_init__(self):
+        assert self.degree >= 1
+        if self.degree > 1:
+            assert self.axis is not None, "partitioned dim needs a mesh axis"
+            assert self.size % self.degree == 0, (
+                f"dim size {self.size} not divisible by degree {self.degree}"
+            )
+
+    @property
+    def is_partitioned(self) -> bool:
+        return self.degree > 1
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelTensorShape:
+    """Shape + partitioning + replication of a distributed tensor.
+
+    reference: parallel_tensor.h:76-111 (``ParallelTensorShape``), with
+    replica dims folded into ``replica_axes`` (see module docstring).
+    """
+
+    dims: Tuple[ParallelDim, ...]
+    dtype: DataType = DataType.FLOAT
+    replica_axes: Tuple[str, ...] = ()  # mesh axes this tensor is replicated over
+
+    @staticmethod
+    def unpartitioned(shape: Tuple[int, ...], dtype: DataType = DataType.FLOAT) -> "ParallelTensorShape":
+        return ParallelTensorShape(tuple(ParallelDim(s) for s in shape), dtype)
+
+    @property
+    def sizes(self) -> Tuple[int, ...]:
+        return tuple(d.size for d in self.dims)
+
+    @property
+    def degrees(self) -> Tuple[int, ...]:
+        return tuple(d.degree for d in self.dims)
+
+    @property
+    def num_parts(self) -> int:
+        n = 1
+        for d in self.dims:
+            n *= d.degree
+        return n
+
+    def partition_spec(self) -> PartitionSpec:
+        """Lower to a GSPMD PartitionSpec: sharded dims carry their axis
+        name, everything else (incl. replica axes) is unspecified, which in
+        GSPMD means replicated — matching ``is_replica_dim`` semantics."""
+        return PartitionSpec(*[d.axis if d.is_partitioned else None for d in self.dims])
+
+    def with_dim(self, idx: int, dim: ParallelDim) -> "ParallelTensorShape":
+        dims = list(self.dims)
+        dims[idx] = dim
+        return dataclasses.replace(self, dims=tuple(dims))
+
+    def partitioned(self, idx: int, degree: int, axis: str) -> "ParallelTensorShape":
+        """Repartition: raise the partition degree of one dim
+        (reference: src/parallel_ops/partition.cc)."""
+        d = self.dims[idx]
+        return self.with_dim(idx, ParallelDim(d.size, degree, axis))
+
+    def combined(self, idx: int) -> "ParallelTensorShape":
+        """Combine: drop the partitioning of one dim
+        (reference: src/parallel_ops/combine.cc)."""
+        d = self.dims[idx]
+        return self.with_dim(idx, ParallelDim(d.size))
+
+    def replicated(self, axis: str) -> "ParallelTensorShape":
+        """Replicate: add a replica axis
+        (reference: src/parallel_ops/replicate.cc)."""
+        if axis in self.replica_axes:
+            return self
+        return dataclasses.replace(self, replica_axes=self.replica_axes + (axis,))
+
+    def reduced(self, axis: str) -> "ParallelTensorShape":
+        """Reduction: consume a replica axis by summing over it
+        (reference: src/parallel_ops/reduction.cc)."""
+        return dataclasses.replace(
+            self, replica_axes=tuple(a for a in self.replica_axes if a != axis)
+        )
+
+    def __str__(self) -> str:
+        parts = []
+        for d in self.dims:
+            parts.append(f"{d.size}" + (f"/{d.axis}:{d.degree}" if d.is_partitioned else ""))
+        rep = f" rep={list(self.replica_axes)}" if self.replica_axes else ""
+        return f"[{', '.join(parts)}]{rep}"

@@ -1,0 +1,94 @@
+"""Lazy frontend tensors.
+
+TPU-native equivalent of the reference's ``TensorBase``
+(reference: include/flexflow/tensor.h:29-85). A ``Tensor`` is a symbolic
+handle produced by a builder call on :class:`~flexflow_tpu.runtime.model.FFModel`;
+no device memory exists until ``compile()``. After compile, weight tensors can
+be read/written via numpy (``get_tensor``/``set_tensor`` — reference:
+parallel_tensor.h:164-169, flexflow_cffi.py:664-875).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import TYPE_CHECKING, Optional, Tuple
+
+import numpy as np
+
+from ..ffconst import DataType
+
+if TYPE_CHECKING:
+    from .layer import Layer
+    from ..runtime.model import FFModel
+
+_tensor_ids = itertools.count()
+
+
+class Tensor:
+    """Symbolic tensor in the lazy layer graph.
+
+    ``dims`` follow numpy/JAX convention: ``dims[0]`` is the outermost
+    (batch) dimension.  The reference stores dims innermost-first
+    (tensor.h: Legion coordinate order); we use row-major order because
+    that is what jax.numpy and XLA expect — conversion happens only in
+    reference-compat shims.
+    """
+
+    def __init__(
+        self,
+        dims: Tuple[int, ...],
+        dtype: DataType = DataType.FLOAT,
+        owner_layer: Optional["Layer"] = None,
+        owner_idx: int = 0,
+        name: Optional[str] = None,
+        model: Optional["FFModel"] = None,
+        create_gradients: bool = True,
+    ):
+        self.tensor_id: int = next(_tensor_ids)
+        self.dims: Tuple[int, ...] = tuple(int(d) for d in dims)
+        self.dtype: DataType = dtype
+        self.owner_layer = owner_layer
+        self.owner_idx = owner_idx
+        self.name = name or f"tensor_{self.tensor_id}"
+        self.model = model
+        self.create_gradients = create_gradients
+        # filled by compile() for inputs/labels; weights live in Parameter
+        self._value: Optional[np.ndarray] = None
+
+    @property
+    def num_dims(self) -> int:
+        return len(self.dims)
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return self.dims
+
+    def __repr__(self) -> str:
+        return f"Tensor({self.name}, dims={self.dims}, dtype={self.dtype.name})"
+
+    # ---- numpy interop (reference: flexflow_cffi.py set_tensor/get_tensor) --
+    def set_tensor(self, ffmodel, np_array: np.ndarray) -> None:
+        ffmodel._set_tensor_value(self, np_array)
+
+    def get_tensor(self, ffmodel) -> np.ndarray:
+        return ffmodel._get_tensor_value(self)
+
+    # mirror of the reference's inplace-capable API surface
+    def get_shape(self) -> Tuple[int, ...]:
+        return self.dims
+
+
+class Parameter(Tensor):
+    """Trainable weight tensor (reference: tensor.h Parameter; weights are
+    ParallelTensors with ``sync_type``)."""
+
+    def __init__(self, *args, initializer=None, sync_type=None, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.initializer = initializer
+        self.sync_type = sync_type
+
+    def get_weights(self, ffmodel) -> np.ndarray:
+        return ffmodel._get_tensor_value(self)
+
+    def set_weights(self, ffmodel, np_array: np.ndarray) -> None:
+        ffmodel._set_tensor_value(self, np_array)

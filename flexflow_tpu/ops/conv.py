@@ -1,0 +1,164 @@
+"""Convolution / pooling / batch-norm operators (NCHW, matching the
+reference's layout).
+
+TPU-native equivalents of:
+* Conv2D    — reference: src/ops/conv_2d.cc, kernels/conv_2d_kernels.cu
+  (cuDNN convolution with algorithm autotuning; builder model.h:403). Here
+  ``jax.lax.conv_general_dilated`` lowers to XLA convolution, which the TPU
+  backend tiles onto the MXU — the autotuning role is played by XLA.
+* Pool2D    — reference: src/ops/pool_2d.cc (cuDNN pooling; model.h:461).
+* BatchNorm — reference: src/ops/batch_norm.cc (cuDNN BN; model.h:478).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+from ..ffconst import ActiMode, DataType, OpType, PoolType
+from ..core.op import Op, WeightSpec, register_op
+from ..core.parallel_tensor import ParallelDim, ParallelTensorShape
+from ..runtime.initializer import (
+    ConstantInitializer,
+    DefaultBiasInitializer,
+    DefaultWeightInitializer,
+    ZeroInitializer,
+)
+from .linear import apply_activation
+
+
+def _conv_out(size: int, kernel: int, pad: int, stride: int) -> int:
+    return (size + 2 * pad - kernel) // stride + 1
+
+
+@register_op
+class Conv2D(Op):
+    op_type = OpType.CONV2D
+
+    def __init__(self, layer, input_shapes):
+        super().__init__(layer, input_shapes)
+        a = self.attrs
+        self.out_channels = a["out_channels"]
+        self.kernel = a["kernel"]
+        self.stride = a["stride"]
+        self.padding = a["padding"]
+        self.groups = a.get("groups", 1)
+        self.use_bias = a.get("use_bias", True)
+        self.activation = a.get("activation", ActiMode.NONE)
+        n, c, h, w = input_shapes[0].sizes
+        self.in_channels = c
+
+    def infer_output_shapes(self):
+        n, c, h, w = self.input_shapes[0].sizes
+        oh = _conv_out(h, self.kernel[0], self.padding[0], self.stride[0])
+        ow = _conv_out(w, self.kernel[1], self.padding[1], self.stride[1])
+        return [((n, self.out_channels, oh, ow), self.input_shapes[0].dtype)]
+
+    def weight_specs(self) -> List[WeightSpec]:
+        # OIHW kernel layout (reference: conv_2d.cc weight dims)
+        specs = [
+            WeightSpec(
+                "kernel",
+                (self.out_channels, self.in_channels // self.groups, *self.kernel),
+                self.input_shapes[0].dtype,
+                self.attrs.get("kernel_initializer") or DefaultWeightInitializer(),
+                weight_decay=True,
+            )
+        ]
+        if self.use_bias:
+            specs.append(
+                WeightSpec(
+                    "bias",
+                    (self.out_channels,),
+                    self.input_shapes[0].dtype,
+                    self.attrs.get("bias_initializer") or DefaultBiasInitializer(),
+                    weight_decay=False,
+                )
+            )
+        return specs
+
+    def forward(self, ctx, inputs, weights):
+        (x,) = inputs
+        y = jax.lax.conv_general_dilated(
+            x,
+            weights["kernel"],
+            window_strides=self.stride,
+            padding=[(self.padding[0], self.padding[0]), (self.padding[1], self.padding[1])],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            feature_group_count=self.groups,
+            preferred_element_type=x.dtype,
+        )
+        if self.use_bias:
+            y = y + weights["bias"][None, :, None, None]
+        return [apply_activation(y, self.activation)]
+
+    def flops(self) -> float:
+        (n, co, oh, ow), _ = self.infer_output_shapes()[0]
+        return 2.0 * n * co * oh * ow * (self.in_channels // self.groups) * self.kernel[0] * self.kernel[1]
+
+
+@register_op
+class Pool2D(Op):
+    op_type = OpType.POOL2D
+
+    def infer_output_shapes(self):
+        n, c, h, w = self.input_shapes[0].sizes
+        kh, kw = self.attrs["kernel"]
+        ph, pw = self.attrs["padding"]
+        sh, sw = self.attrs["stride"]
+        return [((n, c, _conv_out(h, kh, ph, sh), _conv_out(w, kw, pw, sw)),
+                 self.input_shapes[0].dtype)]
+
+    def forward(self, ctx, inputs, weights):
+        (x,) = inputs
+        kh, kw = self.attrs["kernel"]
+        ph, pw = self.attrs["padding"]
+        sh, sw = self.attrs["stride"]
+        dims = (1, 1, kh, kw)
+        strides = (1, 1, sh, sw)
+        pads = ((0, 0), (0, 0), (ph, ph), (pw, pw))
+        if self.attrs.get("pool_type", PoolType.MAX) is PoolType.MAX:
+            init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+            y = jax.lax.reduce_window(x, init, jax.lax.max, dims, strides, pads)
+        else:
+            s = jax.lax.reduce_window(x, 0.0, jax.lax.add, dims, strides, pads)
+            # cuDNN avg pooling divides by the full window (count includes pad)
+            y = s / float(kh * kw)
+        return [apply_activation(y, self.attrs.get("activation", ActiMode.NONE))]
+
+
+@register_op
+class BatchNorm(Op):
+    """Batch normalization over N,H,W per channel (NCHW).
+
+    reference: src/ops/batch_norm.cc (cuDNN spatial BN). Round-1 note:
+    normalization uses batch statistics in both modes; running-average
+    state for inference-mode parity is tracked in the model-state pytree
+    once that lands (see runtime/compiler.py TODO).
+    """
+
+    op_type = OpType.BATCHNORM
+
+    def infer_output_shapes(self):
+        return [(self.input_shapes[0].sizes, self.input_shapes[0].dtype)]
+
+    def weight_specs(self):
+        c = self.input_shapes[0].sizes[1]
+        dt = self.input_shapes[0].dtype
+        return [
+            WeightSpec("scale", (c,), dt, ConstantInitializer(1.0), weight_decay=False),
+            WeightSpec("bias", (c,), dt, ZeroInitializer(), weight_decay=False),
+        ]
+
+    def forward(self, ctx, inputs, weights):
+        (x,) = inputs
+        eps = 1e-5
+        mean = jnp.mean(x, axis=(0, 2, 3), keepdims=True)
+        var = jnp.var(x, axis=(0, 2, 3), keepdims=True)
+        y = (x - mean) * jax.lax.rsqrt(var + eps)
+        y = y * weights["scale"][None, :, None, None] + weights["bias"][None, :, None, None]
+        if self.attrs.get("relu", True):
+            y = jnp.maximum(y, 0)
+        return [y]

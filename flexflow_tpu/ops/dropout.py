@@ -1,0 +1,33 @@
+"""Dropout operator.
+
+TPU-native equivalent of the reference's Dropout
+(reference: src/ops/dropout.cc, kernels/dropout_kernels.cu — cuDNN dropout
+with per-device rng state; builder model.h:419). Randomness comes from the
+per-op PRNG key threaded through :class:`LowerCtx`, so the same program is
+reproducible across shardings (no per-device curand state to manage).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ffconst import OpType
+from ..core.op import Op, register_op
+
+
+@register_op
+class Dropout(Op):
+    op_type = OpType.DROPOUT
+
+    def infer_output_shapes(self):
+        return [(self.input_shapes[0].sizes, self.input_shapes[0].dtype)]
+
+    def forward(self, ctx, inputs, weights):
+        (x,) = inputs
+        rate = float(self.attrs.get("rate", 0.5))
+        if not ctx.training or rate <= 0.0:
+            return [x]
+        keep = 1.0 - rate
+        mask = jax.random.bernoulli(ctx.rng, p=keep, shape=x.shape)
+        return [jnp.where(mask, x / keep, jnp.zeros_like(x))]

@@ -1,0 +1,77 @@
+"""Elementwise binary operators with numpy broadcasting.
+
+TPU-native equivalent of the reference's ElementBinary
+(reference: src/ops/element_binary.cc, kernels/element_binary_kernels.cu —
+add/sub/mul/div/max/min with broadcast support; builders model.h:338-366).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..ffconst import OpType
+from ..core.op import Op, register_op
+from ..core.parallel_tensor import ParallelDim, ParallelTensorShape
+
+_BINARY_FNS: Dict[OpType, Callable] = {
+    OpType.EW_ADD: jnp.add,
+    OpType.EW_SUB: jnp.subtract,
+    OpType.EW_MUL: jnp.multiply,
+    OpType.EW_DIV: jnp.divide,
+    OpType.EW_MAX: jnp.maximum,
+    OpType.EW_MIN: jnp.minimum,
+}
+
+
+class _ElementBinaryBase(Op):
+    def infer_output_shapes(self):
+        a, b = self.input_shapes
+        out = np.broadcast_shapes(a.sizes, b.sizes)
+        return [(tuple(int(s) for s in out), a.dtype)]
+
+    def propagate(self, input_shapes, strategy):
+        """Output inherits sharding from whichever input supplies each
+        broadcast dim (reference: element_binary.cc dim mapping records)."""
+        out_sizes, dtype = self.infer_output_shapes()[0][0], input_shapes[0].dtype
+        nd = len(out_sizes)
+        dims = []
+        for i, s in enumerate(out_sizes):
+            chosen = ParallelDim(s)
+            for src in input_shapes:
+                off = nd - len(src.dims)
+                j = i - off
+                if 0 <= j < len(src.dims):
+                    d = src.dims[j]
+                    if d.size == s and d.is_partitioned:
+                        chosen = ParallelDim(s, d.degree, d.axis)
+                        break
+            dims.append(chosen)
+        return [ParallelTensorShape(tuple(dims), dtype)], {}
+
+    def flops(self) -> float:
+        n = 1
+        for s in self.infer_output_shapes()[0][0]:
+            n *= s
+        return float(n)
+
+
+def _make_binary(op_type: OpType):
+    fn = _BINARY_FNS[op_type]
+
+    @register_op
+    class _Binary(_ElementBinaryBase):
+        pass
+
+    _Binary.op_type = op_type
+    _Binary.__name__ = f"ElementBinary_{op_type.value}"
+    _Binary.forward = lambda self, ctx, inputs, weights, _fn=fn: [
+        _fn(inputs[0], inputs[1])
+    ]
+    return _Binary
+
+
+for _t in _BINARY_FNS:
+    _make_binary(_t)

@@ -1,0 +1,85 @@
+"""Elementwise unary operators.
+
+TPU-native equivalent of the reference's ElementUnary
+(reference: src/ops/element_unary.cc/.cu — exp/relu/gelu/sigmoid/tanh/elu/
+rsqrt/pow/sin/cos and the scalar_* variants; builders model.h:336-401).
+XLA fuses these into neighboring ops, which subsumes the reference's
+``inplace`` optimization (model.cc:2885-2919).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+
+from ..ffconst import DataType, OpType
+from ..core.op import LowerCtx, Op, register_op
+
+_UNARY_FNS: Dict[OpType, Callable] = {
+    OpType.EXP: jnp.exp,
+    OpType.RELU: lambda x: jnp.maximum(x, 0),
+    OpType.IDENTITY: lambda x: x,
+    OpType.SIGMOID: jax.nn.sigmoid,
+    OpType.TANH: jnp.tanh,
+    OpType.ELU: jax.nn.elu,
+    OpType.GELU: lambda x: jax.nn.gelu(x, approximate=False),
+    OpType.RSQRT: jax.lax.rsqrt,
+    OpType.SIN: jnp.sin,
+    OpType.COS: jnp.cos,
+}
+
+_SCALAR_FNS: Dict[OpType, Callable] = {
+    OpType.SCALAR_MULTIPLY: lambda x, s: x * s,
+    OpType.SCALAR_ADD: lambda x, s: x + s,
+    OpType.SCALAR_SUB: lambda x, s: x - s,
+    OpType.SCALAR_TRUE_DIV: lambda x, s: x / s,
+    OpType.SCALAR_FLOOR_DIV: lambda x, s: jnp.floor_divide(x, s),
+    OpType.POW: lambda x, s: jnp.power(x, s),
+}
+
+
+class _ElementUnaryBase(Op):
+    def infer_output_shapes(self):
+        return [(self.input_shapes[0].sizes, self.input_shapes[0].dtype)]
+
+    def flops(self) -> float:
+        n = 1
+        for s in self.input_shapes[0].sizes:
+            n *= s
+        return float(n)
+
+
+def _make_unary(op_type: OpType):
+    fn = _UNARY_FNS[op_type]
+
+    @register_op
+    class _Unary(_ElementUnaryBase):
+        pass
+
+    _Unary.op_type = op_type
+    _Unary.__name__ = f"ElementUnary_{op_type.value}"
+    _Unary.forward = lambda self, ctx, inputs, weights, _fn=fn: [_fn(inputs[0])]
+    return _Unary
+
+
+def _make_scalar(op_type: OpType):
+    fn = _SCALAR_FNS[op_type]
+
+    @register_op
+    class _Scalar(_ElementUnaryBase):
+        pass
+
+    _Scalar.op_type = op_type
+    _Scalar.__name__ = f"ElementUnary_{op_type.value}"
+    _Scalar.forward = lambda self, ctx, inputs, weights, _fn=fn: [
+        _fn(inputs[0], self.attrs["scalar"])
+    ]
+    return _Scalar
+
+
+for _t in _UNARY_FNS:
+    _make_unary(_t)
+for _t in _SCALAR_FNS:
+    _make_scalar(_t)

@@ -1,0 +1,100 @@
+"""Embedding and Gather operators.
+
+TPU-native equivalents of:
+* Embedding — reference: src/ops/embedding.cc, kernels/embedding_kernels.cu
+  (builder model.h:424; aggr NONE/SUM/AVG; weight partitioned on the vocab
+  dim for DLRM-style parameter parallelism — SURVEY.md §2.3).
+* Gather    — reference: src/ops/gather.cc, kernels/gather_kernels.cu
+  (builder model.h:433; torch.gather semantics along ``dim``).
+
+The embedding lookup lowers to ``jnp.take`` (XLA gather). With the weight
+sharded on the vocab dim over the ``model`` axis, GSPMD partitions the
+gather and emits the combining collectives — the TPU analog of the
+reference's vocab-partitioned embedding.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ffconst import AggrMode, DataType, OpType
+from ..core.op import Op, WeightSpec, register_op
+from ..core.parallel_tensor import ParallelDim, ParallelTensorShape
+from ..runtime.initializer import DefaultWeightInitializer
+
+
+@register_op
+class Embedding(Op):
+    op_type = OpType.EMBEDDING
+
+    def __init__(self, layer, input_shapes):
+        super().__init__(layer, input_shapes)
+        self.num_entries = self.attrs["num_entries"]
+        self.out_dim = self.attrs["out_dim"]
+        self.aggr: AggrMode = self.attrs.get("aggr", AggrMode.NONE)
+        self.out_dtype: DataType = self.attrs.get("dtype", DataType.FLOAT)
+
+    def infer_output_shapes(self):
+        in_sizes = self.input_shapes[0].sizes
+        if self.aggr is AggrMode.NONE:
+            out = in_sizes + (self.out_dim,)
+        else:
+            # SUM/AVG reduce the trailing multi-hot dim (reference:
+            # embedding.cc output dims under aggregation)
+            out = in_sizes[:-1] + (self.out_dim,)
+        return [(out, self.out_dtype)]
+
+    def weight_specs(self):
+        return [
+            WeightSpec(
+                "weight",
+                (self.num_entries, self.out_dim),
+                self.out_dtype,
+                self.attrs.get("kernel_initializer") or DefaultWeightInitializer(),
+                weight_decay=True,
+            )
+        ]
+
+    def forward(self, ctx, inputs, weights):
+        ids = inputs[0].astype(jnp.int32)
+        emb = jnp.take(weights["weight"], ids, axis=0)
+        if self.aggr is AggrMode.SUM:
+            emb = jnp.sum(emb, axis=-2)
+        elif self.aggr is AggrMode.AVG:
+            emb = jnp.mean(emb, axis=-2)
+        return [emb]
+
+    def propagate(self, input_shapes, strategy):
+        """strategy ``{"vocab": axis}`` shards the vocab dim (parameter
+        parallelism, the reference's DLRM embedding partitioning);
+        ``{"out": axis}`` shards the feature dim."""
+        out_shapes, weight_shapes = super().propagate(input_shapes, strategy)
+        axis_sizes = strategy.get("_axis_sizes", {})
+        w = weight_shapes["weight"]
+        if "vocab" in strategy:
+            ax = strategy["vocab"]
+            deg = axis_sizes.get(ax, 1)
+            if deg > 1 and self.num_entries % deg == 0:
+                weight_shapes["weight"] = w.partitioned(0, deg, ax)
+        elif "out" in strategy:
+            ax = strategy["out"]
+            deg = axis_sizes.get(ax, 1)
+            if deg > 1 and self.out_dim % deg == 0:
+                weight_shapes["weight"] = w.partitioned(1, deg, ax)
+                out = out_shapes[0]
+                out_shapes[0] = out.partitioned(len(out.dims) - 1, deg, ax)
+        return out_shapes, weight_shapes
+
+
+@register_op
+class Gather(Op):
+    op_type = OpType.GATHER
+
+    def infer_output_shapes(self):
+        # torch.gather: output has the index tensor's shape
+        return [(self.input_shapes[1].sizes, self.input_shapes[0].dtype)]
+
+    def forward(self, ctx, inputs, weights):
+        x, idx = inputs
+        dim = self.attrs["dim"] % x.ndim
+        return [jnp.take_along_axis(x, idx.astype(jnp.int32), axis=dim)]

@@ -1,0 +1,198 @@
+"""MoE operator family: TopK, GroupBy, Aggregate, AggregateSpec, Cache.
+
+TPU-native equivalents of the reference's MoE pipeline
+(reference: src/ops/topk.cc, group_by.cc, aggregate.cc, aggregate_spec.cc,
+cache.cc; composite FFModel::moe src/ops/moe.cc:20-45; SURVEY.md §2.2).
+
+Design translation: the reference scatters rows with data-dependent CUDA
+kernels. Under SPMD/XLA shapes must be static, so routing uses the
+capacity-based one-hot **dispatch/combine** formulation (cumsum position
+ranking): tokens beyond an expert's capacity are dropped, exactly matching
+the reference's fixed expert-tensor capacity
+``ceil(alpha * k / n * batch)`` (group_by.cc:143). GroupBy and Aggregate
+recompute the *same* routing from ``gate_assign``, so their row orders
+agree just like the reference's paired scatter/gather kernels.
+
+The load-balancing term (reference: aggregate.cu
+``agg_backward_kernel_gate`` — balance gradient
+``(lambda_bal * n / batch) * count[e]`` added to full_gate_grads, then
+zero-meaned per row) is reproduced exactly as an auxiliary straight-through
+loss collected via ``LowerCtx.aux_losses``: its gradient wrt
+``full_gate_preds`` equals the reference's kernel output. The combine-path
+gradient reaches the router through softmax(top-k) autodiff rather than the
+reference's direct injection into full_gate — the modern formulation of the
+same credit assignment.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ffconst import DataType, OpType
+from ..core.op import Op, register_op
+from ..core.parallel_tensor import ParallelDim, ParallelTensorShape
+
+
+@register_op
+class TopK(Op):
+    """reference: src/ops/topk.cc (builder model.h:537). Returns values and
+    int32 indices over the last dim."""
+
+    op_type = OpType.TOPK
+
+    def infer_output_shapes(self):
+        sizes = self.input_shapes[0].sizes
+        k = self.attrs["k"]
+        out = sizes[:-1] + (k,)
+        return [(out, self.input_shapes[0].dtype), (out, DataType.INT32)]
+
+    def forward(self, ctx, inputs, weights):
+        vals, idx = jax.lax.top_k(inputs[0], self.attrs["k"])
+        return [vals, idx.astype(jnp.int32)]
+
+
+def expert_capacity(batch: int, k: int, n: int, alpha: float) -> int:
+    """reference: group_by.cc:143 — ceil(alpha * k / n * batch)."""
+    return int(math.ceil(alpha * k / n * batch))
+
+
+def moe_dispatch_mask(assign: jnp.ndarray, n: int, capacity: int) -> jnp.ndarray:
+    """Routing shared by GroupBy and Aggregate.
+
+    ``assign``: (B, k) int expert ids. Returns dispatch one-hot
+    (T=B*k, n, capacity) float32: dispatch[t, e, c] = 1 iff flattened token
+    t is the c-th token routed to expert e (tokens past capacity dropped,
+    like the reference's fixed-size expert tensors).
+    """
+    flat = assign.reshape(-1).astype(jnp.int32)  # (T,)
+    onehot = jax.nn.one_hot(flat, n, dtype=jnp.int32)  # (T, n)
+    pos = jnp.cumsum(onehot, axis=0) - onehot  # rank of t within its expert
+    pos = jnp.sum(pos * onehot, axis=1)  # (T,)
+    keep = pos < capacity
+    poh = jax.nn.one_hot(pos, capacity, dtype=jnp.float32)  # (T, capacity)
+    return (onehot.astype(jnp.float32) * keep[:, None].astype(jnp.float32))[
+        :, :, None
+    ] * poh[:, None, :]
+
+
+@register_op
+class GroupBy(Op):
+    """reference: src/ops/group_by.cc — scatter input rows into n
+    fixed-capacity expert tensors according to gate assignment."""
+
+    op_type = OpType.GROUP_BY
+
+    def __init__(self, layer, input_shapes):
+        super().__init__(layer, input_shapes)
+        self.n = self.attrs["n"]
+        self.alpha = float(self.attrs["alpha"])
+        self.k = input_shapes[1].sizes[-1]
+        self.batch = input_shapes[0].sizes[0]
+        self.capacity = expert_capacity(self.batch, self.k, self.n, self.alpha)
+
+    def infer_output_shapes(self):
+        d = self.input_shapes[0].sizes[1:]
+        return [((self.capacity,) + d, self.input_shapes[0].dtype)] * self.n
+
+    def forward(self, ctx, inputs, weights):
+        x, assign = inputs
+        B = x.shape[0]
+        xf = x.reshape(B, -1)
+        # each sample is duplicated for each of its k expert picks
+        xk = jnp.repeat(xf, self.k, axis=0)  # (T, d)
+        dispatch = moe_dispatch_mask(assign, self.n, self.capacity)  # (T,n,c)
+        expert_rows = jnp.einsum("tnc,tf->ncf", dispatch, xk)  # (n,c,d)
+        out_shape = (self.capacity,) + x.shape[1:]
+        return [expert_rows[e].reshape(out_shape) for e in range(self.n)]
+
+
+class _AggregateBase(Op):
+    def __init__(self, layer, input_shapes):
+        super().__init__(layer, input_shapes)
+        self.n = self.attrs["n"]
+        self.lambda_bal = float(self.attrs["lambda_bal"])
+        self.k = input_shapes[0].sizes[-1]
+        self.batch = input_shapes[0].sizes[0]
+        self.capacity = input_shapes[4].sizes[0]
+        self.out_dim = input_shapes[4].sizes[-1]
+
+    def infer_output_shapes(self):
+        # (batch, out_dim) — reference: aggregate.cc:149-152
+        return [((self.batch, self.out_dim), self.input_shapes[4].dtype)]
+
+    def _combine(self, gate_weights, assign, exp_preds):
+        dispatch = moe_dispatch_mask(assign, self.n, self.capacity)  # (T,n,c)
+        stacked = jnp.stack([p.reshape(self.capacity, -1) for p in exp_preds])  # (n,c,d)
+        combine = dispatch * gate_weights.reshape(-1)[:, None, None]
+        out_flat = jnp.einsum("tnc,ncf->tf", combine, stacked)  # (T,d)
+        return out_flat.reshape(self.batch, self.k, -1).sum(axis=1)
+
+    def _balance_aux(self, full_gate, assign):
+        """Straight-through auxiliary loss whose gradient wrt ``full_gate``
+        is the reference's balance gradient: (lambda*n/B)*count[e],
+        zero-meaned per row (aggregate.cu agg_backward_kernel_gate)."""
+        if self.lambda_bal == 0.0:
+            return None
+        counts = jnp.sum(
+            jax.nn.one_hot(assign.reshape(-1), self.n, dtype=jnp.float32), axis=0
+        )
+        g = (self.lambda_bal * self.n / self.batch) * counts  # (n,)
+        g = g - jnp.mean(g)
+        return jnp.sum(jax.lax.stop_gradient(g)[None, :] * full_gate)
+
+
+@register_op
+class Aggregate(_AggregateBase):
+    """reference: src/ops/aggregate.cc — gate-weighted combine of expert
+    outputs + load-balancing gradient."""
+
+    op_type = OpType.AGGREGATE
+
+    def forward(self, ctx, inputs, weights):
+        gate_preds, assign, _true_assign, full_gate = inputs[:4]
+        exp_preds = inputs[4:]
+        out = self._combine(gate_preds, assign, exp_preds)
+        aux = self._balance_aux(full_gate, assign)
+        if aux is not None and hasattr(ctx, "aux_losses") and ctx.aux_losses is not None:
+            ctx.aux_losses.append(aux)
+        return [out]
+
+
+@register_op
+class AggregateSpec(_AggregateBase):
+    """reference: src/ops/aggregate_spec.cc — the variant used with
+    replicated labels; combines selected experts with uniform 1/k weight
+    (per-expert losses are formed downstream against replicated labels)."""
+
+    op_type = OpType.AGGREGATE_SPEC
+
+    def forward(self, ctx, inputs, weights):
+        gate_preds, assign, _true_assign, full_gate = inputs[:4]
+        exp_preds = inputs[4:]
+        uniform = jnp.full_like(gate_preds, 1.0 / self.k)
+        out = self._combine(uniform, assign, exp_preds)
+        aux = self._balance_aux(full_gate, assign)
+        if aux is not None and hasattr(ctx, "aux_losses") and ctx.aux_losses is not None:
+            ctx.aux_losses.append(aux)
+        return [out]
+
+
+@register_op
+class Cache(Op):
+    """reference: src/ops/cache.cc — caches an intermediate tensor (expert
+    assignments) across iterations, scored by a user function; pairs with
+    the recompile-on-condition hook (moe.cc:180-204). Under jit the cached
+    value is a pass-through; the trigger machinery lives in
+    runtime/recompile.py."""
+
+    op_type = OpType.CACHE
+
+    def infer_output_shapes(self):
+        return [(self.input_shapes[0].sizes, self.input_shapes[0].dtype)]
+
+    def forward(self, ctx, inputs, weights):
+        return [inputs[0]]

@@ -1,0 +1,56 @@
+"""LayerNorm operator.
+
+TPU-native equivalent of the reference's LayerNorm
+(reference: src/ops/layer_norm.cc + .cu — custom Welford kernels; builder
+model.h:472 with ``axes``/``elementwise_affine``/``eps``). XLA fuses the
+mean/variance/normalize chain into one pass, replacing the hand-written
+kernels.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ffconst import OpType
+from ..core.op import Op, WeightSpec, register_op
+from ..runtime.initializer import ConstantInitializer, ZeroInitializer
+
+
+@register_op
+class LayerNorm(Op):
+    op_type = OpType.LAYERNORM
+
+    def __init__(self, layer, input_shapes):
+        super().__init__(layer, input_shapes)
+        nd = len(input_shapes[0].sizes)
+        self.axes = tuple(a % nd for a in self.attrs["axes"])
+        self.eps = float(self.attrs.get("eps", 1e-5))
+        self.affine = bool(self.attrs.get("elementwise_affine", True))
+        self.norm_shape = tuple(input_shapes[0].sizes[a] for a in sorted(self.axes))
+
+    def infer_output_shapes(self):
+        return [(self.input_shapes[0].sizes, self.input_shapes[0].dtype)]
+
+    def weight_specs(self):
+        if not self.affine:
+            return []
+        dt = self.input_shapes[0].dtype
+        return [
+            WeightSpec("scale", self.norm_shape, dt, ConstantInitializer(1.0), weight_decay=False),
+            WeightSpec("bias", self.norm_shape, dt, ZeroInitializer(), weight_decay=False),
+        ]
+
+    def forward(self, ctx, inputs, weights):
+        (x,) = inputs
+        axes = sorted(self.axes)
+        mean = jnp.mean(x, axis=axes, keepdims=True)
+        var = jnp.var(x, axis=axes, keepdims=True)
+        y = (x - mean) * jax.lax.rsqrt(var + self.eps)
+        if self.affine:
+            # broadcast scale/bias over the normalized axes
+            shape = [1] * x.ndim
+            for a in axes:
+                shape[a] = x.shape[a]
+            y = y * weights["scale"].reshape(shape) + weights["bias"].reshape(shape)
+        return [y]

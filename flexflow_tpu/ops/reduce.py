@@ -1,0 +1,68 @@
+"""Reduction operators.
+
+TPU-native equivalents of the reference's Reduce/Mean
+(reference: src/ops/reduce.cc — cuDNN reduce-sum with keepdims;
+src/ops/mean.cc; builders model.h:529 ``reduce_sum`` and model.h:504
+``mean``).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ffconst import OpType
+from ..core.op import Op, register_op
+
+
+def _reduced_shape(sizes, axes, keepdims):
+    axes = [a % len(sizes) for a in axes]
+    out = []
+    for i, s in enumerate(sizes):
+        if i in axes:
+            if keepdims:
+                out.append(1)
+        else:
+            out.append(s)
+    return tuple(out) if out else (1,)
+
+
+@register_op
+class ReduceSum(Op):
+    op_type = OpType.REDUCE_SUM
+
+    def infer_output_shapes(self):
+        sizes = _reduced_shape(
+            self.input_shapes[0].sizes,
+            self.attrs["axes"],
+            self.attrs.get("keepdims", False),
+        )
+        return [(sizes, self.input_shapes[0].dtype)]
+
+    def forward(self, ctx, inputs, weights):
+        out = jnp.sum(
+            inputs[0],
+            axis=tuple(self.attrs["axes"]),
+            keepdims=self.attrs.get("keepdims", False),
+        )
+        return [out.reshape(self.infer_output_shapes()[0][0])]
+
+
+@register_op
+class Mean(Op):
+    op_type = OpType.MEAN
+
+    def infer_output_shapes(self):
+        sizes = _reduced_shape(
+            self.input_shapes[0].sizes,
+            self.attrs["axes"],
+            self.attrs.get("keepdims", False),
+        )
+        return [(sizes, self.input_shapes[0].dtype)]
+
+    def forward(self, ctx, inputs, weights):
+        out = jnp.mean(
+            inputs[0],
+            axis=tuple(self.attrs["axes"]),
+            keepdims=self.attrs.get("keepdims", False),
+        )
+        return [out.reshape(self.infer_output_shapes()[0][0])]

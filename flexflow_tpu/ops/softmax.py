@@ -1,0 +1,32 @@
+"""Softmax operator.
+
+TPU-native equivalent of the reference's Softmax
+(reference: src/ops/softmax.cc, kernels/softmax.cu — cuDNN softmax;
+builder model.h:524 with ``dim`` attribute).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ffconst import OpType
+from ..core.op import Op, register_op
+
+
+@register_op
+class Softmax(Op):
+    op_type = OpType.SOFTMAX
+
+    def infer_output_shapes(self):
+        return [(self.input_shapes[0].sizes, self.input_shapes[0].dtype)]
+
+    def forward(self, ctx, inputs, weights):
+        dim = self.attrs.get("dim", -1)
+        return [jax.nn.softmax(inputs[0], axis=dim)]
+
+    def flops(self) -> float:
+        n = 1
+        for s in self.input_shapes[0].sizes:
+            n *= s
+        return 5.0 * n

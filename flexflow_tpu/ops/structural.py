@@ -1,0 +1,152 @@
+"""Structural / data-movement operators.
+
+TPU-native equivalents of the reference ops:
+* Flat      — src/ops/flat.cc (flatten trailing dims; builder model.h:536)
+* Reshape   — src/ops/reshape.cc (model.h:522)
+* Transpose — src/ops/transpose.cc (model.h:531)
+* Reverse   — src/ops/reverse.cc (model.h:527)
+* Concat    — src/ops/concat.cc (model.h:501)
+* Split     — src/ops/split.cc (model.h:516)
+* Cast      — src/ops/cast.cc (model.h:499)
+
+These are pure layout ops; XLA lowers them to copies/bitcasts and usually
+fuses them away, which replaces the reference's dedicated CUDA kernels.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..ffconst import DataType, OpType
+from ..core.op import Op, register_op
+from ..core.parallel_tensor import ParallelDim, ParallelTensorShape
+
+
+@register_op
+class Flat(Op):
+    op_type = OpType.FLAT
+
+    def infer_output_shapes(self):
+        sizes = self.input_shapes[0].sizes
+        flat = 1
+        for s in sizes[1:]:
+            flat *= s
+        return [((sizes[0], flat), self.input_shapes[0].dtype)]
+
+    def forward(self, ctx, inputs, weights):
+        (x,) = inputs
+        return [x.reshape(x.shape[0], -1)]
+
+
+@register_op
+class Reshape(Op):
+    op_type = OpType.RESHAPE
+
+    def infer_output_shapes(self):
+        in_sizes = self.input_shapes[0].sizes
+        shape = list(self.attrs["shape"])
+        n = int(np.prod(in_sizes))
+        if -1 in shape:
+            i = shape.index(-1)
+            rest = int(np.prod([s for s in shape if s != -1]))
+            shape[i] = n // rest
+        assert int(np.prod(shape)) == n, f"reshape {in_sizes} -> {shape}"
+        return [(tuple(shape), self.input_shapes[0].dtype)]
+
+    def forward(self, ctx, inputs, weights):
+        shape = self.infer_output_shapes()[0][0]
+        return [inputs[0].reshape(shape)]
+
+
+@register_op
+class Transpose(Op):
+    op_type = OpType.TRANSPOSE
+
+    def infer_output_shapes(self):
+        perm = self.attrs["perm"]
+        sizes = self.input_shapes[0].sizes
+        return [(tuple(sizes[p] for p in perm), self.input_shapes[0].dtype)]
+
+    def forward(self, ctx, inputs, weights):
+        return [jnp.transpose(inputs[0], self.attrs["perm"])]
+
+    def propagate(self, input_shapes, strategy):
+        perm = self.attrs["perm"]
+        in0 = input_shapes[0]
+        dims = tuple(in0.dims[p] for p in perm)
+        return [ParallelTensorShape(dims, in0.dtype)], {}
+
+
+@register_op
+class Reverse(Op):
+    op_type = OpType.REVERSE
+
+    def infer_output_shapes(self):
+        return [(self.input_shapes[0].sizes, self.input_shapes[0].dtype)]
+
+    def forward(self, ctx, inputs, weights):
+        return [jnp.flip(inputs[0], axis=self.attrs["axis"])]
+
+
+@register_op
+class Concat(Op):
+    op_type = OpType.CONCAT
+
+    def infer_output_shapes(self):
+        axis = self.attrs["axis"]
+        sizes = list(self.input_shapes[0].sizes)
+        axis = axis % len(sizes)
+        sizes[axis] = sum(s.sizes[axis] for s in self.input_shapes)
+        return [(tuple(sizes), self.input_shapes[0].dtype)]
+
+    def forward(self, ctx, inputs, weights):
+        return [jnp.concatenate(inputs, axis=self.attrs["axis"])]
+
+
+@register_op
+class Split(Op):
+    op_type = OpType.SPLIT
+
+    def infer_output_shapes(self):
+        axis = self.attrs["axis"]
+        splits = self.attrs["splits"]  # list of sizes along axis
+        sizes = self.input_shapes[0].sizes
+        axis = axis % len(sizes)
+        assert sum(splits) == sizes[axis]
+        outs = []
+        for sp in splits:
+            s = list(sizes)
+            s[axis] = sp
+            outs.append((tuple(s), self.input_shapes[0].dtype))
+        return outs
+
+    def forward(self, ctx, inputs, weights):
+        axis = self.attrs["axis"]
+        splits = self.attrs["splits"]
+        offsets = np.cumsum(splits)[:-1].tolist()
+        return list(jnp.split(inputs[0], offsets, axis=axis))
+
+
+@register_op
+class Cast(Op):
+    op_type = OpType.CAST
+
+    def infer_output_shapes(self):
+        return [(self.input_shapes[0].sizes, self.attrs["dtype"])]
+
+    def forward(self, ctx, inputs, weights):
+        return [inputs[0].astype(self.attrs["dtype"].to_jnp())]
+
+
+@register_op
+class NoOp(Op):
+    """reference: src/ops/noop.cc — OP_INPUT/OP_WEIGHT anchors in the PCG."""
+
+    op_type = OpType.NOOP
+
+    def infer_output_shapes(self):
+        return [(s.sizes, s.dtype) for s in self.input_shapes]
+
+    def forward(self, ctx, inputs, weights):
+        return list(inputs)

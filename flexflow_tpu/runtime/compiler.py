@@ -1,0 +1,331 @@
+"""Compilation: lazy layer graph → sharded, jitted train/eval steps.
+
+TPU-native equivalent of ``FFModel::compile``
+(reference: src/runtime/model.cc:2803-3167; call stack in SURVEY.md §3.2).
+
+Translation of the reference pipeline:
+
+* ``create_operators_from_layers`` (model.cc:2785) → :func:`build_ops`:
+  instantiate an Op per Layer, run shape inference.
+* graph-optimize task / strategy search → :func:`assign_strategies`:
+  per-op strategy dicts (data-parallel default, per-layer overrides, or a
+  search-produced strategy map). Machine views → the global device mesh.
+* ``map_output_tensors`` / region+partition creation → sharding
+  propagation: each op's ``propagate`` produces ParallelTensorShapes whose
+  ``partition_spec()`` lowers to ``jax.lax.with_sharding_constraint``.
+* per-op Legion index launches + tracing → ONE jitted step function; XLA
+  fuses and the jit cache replays (Legion tracing's role —
+  flexflow_cffi.py:2098-2103 — comes for free).
+* NCCL communicator setup (model.cc:3129-3167) → nothing: the SPMD
+  partitioner emits ICI collectives from the shardings.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..ffconst import CompMode, DataType, LossType, MetricsType
+from ..config import FFConfig
+from ..core.layer import Layer
+from ..core.machine import DATA_AXIS, make_mesh, mesh_axis_sizes
+from ..core.op import LowerCtx, Op, create_op
+from ..core.parallel_tensor import ParallelDim, ParallelTensorShape
+from ..core.tensor import Tensor
+from .loss import compute_loss
+from .metrics import compute_batch_metrics
+from .optimizer import Optimizer
+
+
+@dataclasses.dataclass
+class CompiledModel:
+    """Result of compile: everything needed to run training/inference."""
+
+    config: FFConfig
+    mesh: Mesh
+    ops: List[Op]
+    input_tensors: List[Tensor]
+    label_tensor: Optional[Tensor]
+    logits_tensor: Tensor
+    loss_type: Optional[LossType]
+    metrics: List[MetricsType]
+    optimizer: Optional[Optimizer]
+    params: Dict[str, Dict[str, jax.Array]]
+    opt_state: Any
+    wd_mask: Dict[str, Dict[str, bool]]
+    param_shardings: Dict[str, Dict[str, NamedSharding]]
+    input_shardings: List[NamedSharding]
+    label_sharding: Optional[NamedSharding]
+    train_step: Any
+    eval_step: Any
+    forward_fn: Any
+    grad_step: Any
+    tensor_pshapes: Dict[int, ParallelTensorShape]
+    _iteration: int = 0
+
+
+def toposort_layers(layers: List[Layer]) -> List[Layer]:
+    """Builder order is already topological (each layer only consumes
+    previously-created tensors), mirroring the reference's operator list
+    ordering; validate rather than re-sort."""
+    seen = set()
+    for l in layers:
+        for t in l.inputs:
+            if t.owner_layer is not None and t.owner_layer.layer_guid not in seen:
+                raise ValueError(f"layer graph not topologically ordered at {l}")
+        seen.add(l.layer_guid)
+    return layers
+
+
+def build_ops(
+    layers: List[Layer],
+    input_pshapes: Dict[int, ParallelTensorShape],
+    axis_sizes: Dict[str, int],
+    strategies: Dict[str, Dict[str, str]],
+) -> Tuple[List[Op], Dict[int, ParallelTensorShape]]:
+    """Instantiate ops and propagate shardings through the graph."""
+    pshapes: Dict[int, ParallelTensorShape] = dict(input_pshapes)
+    ops: List[Op] = []
+    for layer in toposort_layers(layers):
+        in_shapes = [pshapes[t.tensor_id] for t in layer.inputs]
+        op = create_op(layer, in_shapes)
+        strategy = dict(strategies.get(layer.name, {}))
+        strategy["_axis_sizes"] = axis_sizes
+        out_shapes, weight_shapes = op.propagate(in_shapes, strategy)
+        op.output_shapes = out_shapes
+        op.weight_shapes = weight_shapes
+        # sanity: inferred logical sizes must match the declared outputs
+        declared = layer.outputs
+        for i, (t, ps) in enumerate(zip(declared, out_shapes)):
+            if tuple(t.dims) != tuple(ps.sizes):
+                raise ValueError(
+                    f"{layer.name} output {i}: declared {t.dims} vs propagated {ps.sizes}"
+                )
+            pshapes[t.tensor_id] = ps
+        ops.append(op)
+    return ops, pshapes
+
+
+def _named_sharding(mesh: Mesh, ps: ParallelTensorShape) -> NamedSharding:
+    return NamedSharding(mesh, ps.partition_spec())
+
+
+def init_params(
+    ops: List[Op],
+    mesh: Mesh,
+    seed: int,
+    dtype_override=None,
+) -> Tuple[Dict, Dict, Dict]:
+    """Initialize all weights on-device with their target shardings.
+
+    reference analog: per-op init tasks + initializer tasks
+    (src/runtime/initializer.cc); here a single jitted init per weight with
+    ``out_shardings`` so large weights are born sharded (no host round-trip).
+    """
+    root = jax.random.key(seed)
+    params: Dict[str, Dict[str, jax.Array]] = {}
+    shardings: Dict[str, Dict[str, NamedSharding]] = {}
+    wd_mask: Dict[str, Dict[str, bool]] = {}
+    for oi, op in enumerate(ops):
+        specs = op.weight_specs()
+        if not specs:
+            continue
+        params[op.name] = {}
+        shardings[op.name] = {}
+        wd_mask[op.name] = {}
+        for wi, ws in enumerate(specs):
+            key = jax.random.fold_in(jax.random.fold_in(root, oi), wi)
+            sh = _named_sharding(mesh, op.weight_shapes[ws.name])
+            jdtype = dtype_override or ws.dtype.to_jnp()
+            init_fn = ws.initializer
+
+            @functools.partial(jax.jit, out_shardings=sh)
+            def _init(key, _fn=init_fn, _shape=ws.shape, _dt=jdtype):
+                return _fn(key, _shape, _dt)
+
+            params[op.name][ws.name] = _init(key)
+            shardings[op.name][ws.name] = sh
+            wd_mask[op.name][ws.name] = ws.weight_decay
+    return params, shardings, wd_mask
+
+
+def _forward_graph(
+    ops: List[Op],
+    mesh: Mesh,
+    params: Dict,
+    inputs: Dict[int, jnp.ndarray],
+    training: bool,
+    rng: Optional[jax.Array],
+    seq_length: int = -1,
+):
+    """Run the op graph; returns (dict tensor_id -> activation, aux_losses).
+
+    Sharding constraints on op outputs realize the PCG's parallel-op
+    transitions (SURVEY.md §7: Partition/Combine/Replicate/Reduction map to
+    resharding)."""
+    ctx = LowerCtx(mesh=mesh, training=training, seq_length=seq_length, aux_losses=[])
+    acts: Dict[int, jnp.ndarray] = dict(inputs)
+    for oi, op in enumerate(ops):
+        ins = [acts[t.tensor_id] for t in op.layer.inputs]
+        ctx.rng = jax.random.fold_in(rng, oi) if rng is not None else None
+        outs = op.forward(ctx, ins, params.get(op.name, {}))
+        for out, t, ps in zip(outs, op.layer.outputs, op.output_shapes):
+            if mesh is not None and any(d.is_partitioned for d in ps.dims):
+                out = jax.lax.with_sharding_constraint(out, _named_sharding(mesh, ps))
+            acts[t.tensor_id] = out
+    return acts, ctx.aux_losses
+
+
+def compile_model(
+    config: FFConfig,
+    layers: List[Layer],
+    input_tensors: List[Tensor],
+    logits_tensor: Tensor,
+    optimizer: Optional[Optimizer],
+    loss_type: Optional[LossType],
+    metrics: List[MetricsType],
+    strategies: Optional[Dict[str, Dict[str, str]]] = None,
+    mesh: Optional[Mesh] = None,
+    comp_mode: CompMode = CompMode.TRAINING,
+) -> CompiledModel:
+    """The compile entry point (reference: FFModel::compile model.cc:2803)."""
+    if mesh is None:
+        mesh = make_mesh(config.mesh_shape)
+    axis_sizes = mesh_axis_sizes(mesh)
+    strategies = dict(strategies or {})
+
+    # --- input sharding: batch dim over the data axis (the reference's
+    # default Repartition-on-batch when only_data_parallel, model.cc:2638;
+    # with search enabled inputs still default to sample-parallel).
+    data_degree = axis_sizes.get(DATA_AXIS, 1)
+    input_pshapes: Dict[int, ParallelTensorShape] = {}
+    for t in input_tensors:
+        dims = []
+        for i, s in enumerate(t.dims):
+            if i == 0 and data_degree > 1 and s % data_degree == 0:
+                dims.append(ParallelDim(s, data_degree, DATA_AXIS))
+            else:
+                dims.append(ParallelDim(s))
+        input_pshapes[t.tensor_id] = ParallelTensorShape(tuple(dims), t.dtype)
+
+    ops, pshapes = build_ops(layers, input_pshapes, axis_sizes, strategies)
+
+    # --- label tensor (reference: model.cc:3085-3124 creates the label
+    # ParallelTensor matching the final op's batch partitioning)
+    label_tensor = None
+    label_sharding = None
+    if loss_type is not None:
+        logits_ps = pshapes[logits_tensor.tensor_id]
+        if loss_type is LossType.SPARSE_CATEGORICAL_CROSSENTROPY:
+            lab_sizes: Tuple[int, ...] = (logits_tensor.dims[0], 1)
+            lab_dtype = DataType.INT32
+        else:
+            lab_sizes = logits_tensor.dims
+            lab_dtype = logits_tensor.dtype
+        lab_dims = [ParallelDim(s) for s in lab_sizes]
+        if logits_ps.dims[0].is_partitioned and lab_sizes[0] == logits_ps.dims[0].size:
+            lab_dims[0] = ParallelDim(
+                lab_sizes[0], logits_ps.dims[0].degree, logits_ps.dims[0].axis
+            )
+        lab_ps = ParallelTensorShape(tuple(lab_dims), lab_dtype)
+        label_tensor = Tensor(lab_sizes, lab_dtype, name="label")
+        pshapes[label_tensor.tensor_id] = lab_ps
+        label_sharding = _named_sharding(mesh, lab_ps)
+
+    params, param_shardings, wd_mask = init_params(ops, mesh, config.seed)
+    opt_state = optimizer.init_state(params) if optimizer is not None else None
+
+    input_shardings = [
+        _named_sharding(mesh, input_pshapes[t.tensor_id]) for t in input_tensors
+    ]
+
+    n_inputs = len(input_tensors)
+    input_ids = [t.tensor_id for t in input_tensors]
+    logits_id = logits_tensor.tensor_id
+
+    # ---- train step --------------------------------------------------------
+    def train_step(params, opt_state, rng, *batch):
+        xs = batch[:n_inputs]
+        y = batch[n_inputs]
+
+        def loss_fn(params):
+            acts, aux = _forward_graph(
+                ops, mesh, params, dict(zip(input_ids, xs)), True, rng
+            )
+            logits = acts[logits_id]
+            loss = compute_loss(loss_type, logits, y)
+            for a in aux:
+                loss = loss + a
+            return loss, logits
+
+        (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        batch_metrics = compute_batch_metrics(metrics, loss_type, logits, y)
+        new_params, new_opt_state = optimizer.update(params, grads, opt_state, wd_mask)
+        return new_params, new_opt_state, loss, batch_metrics
+
+    # ---- standalone grad step (for the manual backward() verb) ------------
+    def grad_step(params, rng, *batch):
+        xs = batch[:n_inputs]
+        y = batch[n_inputs]
+
+        def loss_fn(params):
+            acts, aux = _forward_graph(
+                ops, mesh, params, dict(zip(input_ids, xs)), True, rng
+            )
+            loss = compute_loss(loss_type, acts[logits_id], y)
+            for a in aux:
+                loss = loss + a
+            return loss
+
+        return jax.grad(loss_fn)(params)
+
+    # ---- eval / forward ----------------------------------------------------
+    def eval_step(params, *batch):
+        xs = batch[:n_inputs]
+        y = batch[n_inputs]
+        acts, _ = _forward_graph(ops, mesh, params, dict(zip(input_ids, xs)), False, None)
+        logits = acts[logits_id]
+        loss = compute_loss(loss_type, logits, y) if loss_type else jnp.zeros(())
+        return loss, logits, compute_batch_metrics(metrics, loss_type, logits, y)
+
+    def forward_fn(params, *xs):
+        acts, _ = _forward_graph(ops, mesh, params, dict(zip(input_ids, xs)), False, None)
+        return acts[logits_id]
+
+    jit_train = None
+    jit_grad = None
+    if optimizer is not None and loss_type is not None:
+        jit_train = jax.jit(train_step, donate_argnums=(0, 1))
+        jit_grad = jax.jit(grad_step)
+    jit_eval = jax.jit(eval_step)
+    jit_forward = jax.jit(forward_fn)
+
+    return CompiledModel(
+        config=config,
+        mesh=mesh,
+        ops=ops,
+        input_tensors=list(input_tensors),
+        label_tensor=label_tensor,
+        logits_tensor=logits_tensor,
+        loss_type=loss_type,
+        metrics=list(metrics),
+        optimizer=optimizer,
+        params=params,
+        opt_state=opt_state,
+        wd_mask=wd_mask,
+        param_shardings=param_shardings,
+        input_shardings=input_shardings,
+        label_sharding=label_sharding,
+        train_step=jit_train,
+        eval_step=jit_eval,
+        forward_fn=jit_forward,
+        grad_step=jit_grad,
+        tensor_pshapes=pshapes,
+    )

@@ -1,0 +1,90 @@
+"""Data loading.
+
+TPU-native equivalent of the reference's ``SingleDataLoader``
+(reference: include/flexflow/dataloader.h:34-125, src/dataloader/
+dataloader.cc — full dataset resident in zero-copy DRAM, ``next_batch``
+index-launches per-device copy tasks that slice the batch for each shard).
+
+Here the full dataset stays in host numpy (the zero-copy-DRAM analog);
+``next_batch`` slices the global batch and ``jax.device_put``s it with the
+batch NamedSharding, so each device receives exactly its shard — the same
+per-device slicing the reference's copy tasks perform, but driven by the
+sharding instead of a task launch per device.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import NamedSharding
+
+
+class SingleDataLoader:
+    """One tensor's dataloader (reference: dataloader.h:34).
+
+    ``num_samples`` must be divisible into whole batches by the caller
+    (the reference truncates to full batches; we do the same).
+    """
+
+    def __init__(
+        self,
+        full_array: np.ndarray,
+        batch_size: int,
+        sharding: Optional[NamedSharding] = None,
+        dtype=None,
+    ):
+        self.data = np.ascontiguousarray(full_array if dtype is None else full_array.astype(dtype))
+        self.batch_size = batch_size
+        self.sharding = sharding
+        self.num_samples = self.data.shape[0]
+        self.next_index = 0
+
+    @property
+    def num_batches(self) -> int:
+        return self.num_samples // self.batch_size
+
+    def reset(self) -> None:
+        """reference: SingleDataLoader::reset."""
+        self.next_index = 0
+
+    def next_batch(self) -> jax.Array:
+        """reference: next_batch_xd_launcher (dataloader.cc:232)."""
+        i = self.next_index
+        if i + self.batch_size > self.num_samples:
+            i = 0
+            self.next_index = 0
+        batch = self.data[i : i + self.batch_size]
+        self.next_index = i + self.batch_size
+        return jax.device_put(batch, self.sharding)
+
+
+class DataLoaderGroup:
+    """Batched iteration over aligned input+label loaders with optional
+    shared shuffling (the reference shuffles via app-level random_shuffle
+    in examples' DataLoader::shuffle)."""
+
+    def __init__(self, loaders: List[SingleDataLoader], seed: int = 0, shuffle: bool = False):
+        assert loaders
+        n = {l.num_samples for l in loaders}
+        assert len(n) == 1, "all loaders must have the same sample count"
+        self.loaders = loaders
+        self.shuffle = shuffle
+        self._rng = np.random.default_rng(seed)
+
+    @property
+    def num_batches(self) -> int:
+        return self.loaders[0].num_batches
+
+    def reset(self, reshuffle: bool = True) -> None:
+        for l in self.loaders:
+            l.reset()
+        if self.shuffle and reshuffle:
+            perm = self._rng.permutation(self.loaders[0].num_samples)
+            for l in self.loaders:
+                l.data = l.data[perm]
+
+    def next_batch(self) -> List[jax.Array]:
+        return [l.next_batch() for l in self.loaders]

@@ -1,0 +1,62 @@
+"""Loss functions.
+
+TPU-native equivalent of the reference's Loss subsystem
+(reference: include/flexflow/loss_functions.h:27-86, src/loss_functions/ —
+sparse/categorical cross-entropy, MSE, identity; backward kernels scale by
+``1/global_batch_size``). Here the loss is a scalar jax function inside the
+jitted step; its gradient (the reference's hand-written backward kernels)
+comes from ``jax.grad``. The ``scale_factor = 1/global_batch`` semantics are
+preserved by taking the *mean* over the global batch.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ffconst import LossType
+
+
+def compute_loss(
+    loss_type: LossType, logits: jnp.ndarray, labels: jnp.ndarray
+) -> jnp.ndarray:
+    """Return scalar loss (mean over batch).
+
+    ``logits`` is the final op's output. For the cross-entropy losses the
+    final op is conventionally a Softmax (as in the reference, where
+    Loss::backward peels the softmax — loss_functions.cc); we therefore
+    treat the input as *probabilities* when the final op is softmax and
+    use a numerically-safe log.
+    """
+    if loss_type is LossType.SPARSE_CATEGORICAL_CROSSENTROPY:
+        labels = labels.reshape(labels.shape[0], -1)[:, 0].astype(jnp.int32)
+        probs = jnp.clip(logits, 1e-10, 1.0)
+        ll = jnp.take_along_axis(jnp.log(probs), labels[:, None], axis=-1)
+        return -jnp.mean(ll)
+    if loss_type is LossType.CATEGORICAL_CROSSENTROPY:
+        probs = jnp.clip(logits, 1e-10, 1.0)
+        return -jnp.mean(jnp.sum(labels * jnp.log(probs), axis=-1))
+    if loss_type is LossType.MEAN_SQUARED_ERROR_AVG_REDUCE:
+        # mean over batch*features (reference: loss_functions.cc AVG_REDUCE
+        # scale_factor = 2/volume)
+        return jnp.mean((logits - labels) ** 2)
+    if loss_type is LossType.MEAN_SQUARED_ERROR_SUM_REDUCE:
+        # sum over features, mean over batch (reference: scale 1/batch)
+        return jnp.mean(jnp.sum((logits - labels) ** 2, axis=-1))
+    if loss_type is LossType.IDENTITY:
+        return jnp.mean(logits)
+    raise ValueError(loss_type)
+
+
+def loss_from_string(s: str) -> LossType:
+    """reference: flexflow_cffi.py loss-type string mapping."""
+    m = {
+        "categorical_crossentropy": LossType.CATEGORICAL_CROSSENTROPY,
+        "sparse_categorical_crossentropy": LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        "mean_squared_error": LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+        "mse": LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+        "identity": LossType.IDENTITY,
+    }
+    return m[s]

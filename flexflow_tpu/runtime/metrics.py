@@ -1,0 +1,101 @@
+"""Training metrics.
+
+TPU-native equivalent of the reference's Metrics subsystem
+(reference: include/flexflow/metrics_functions.h:44-79,
+src/metrics_functions/ — PerfMetrics accumulated through a Legion future
+chain; accuracy/cce/scce/MSE/RMSE/MAE). Here per-batch metrics are computed
+inside the jitted step (a fused epilogue on the final op's output) and
+accumulated host-side in :class:`PerfMetrics`; the future chain is replaced
+by jax's async dispatch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List
+
+import jax.numpy as jnp
+
+from ..ffconst import LossType, MetricsType
+
+
+@dataclasses.dataclass
+class PerfMetrics:
+    """Accumulated metrics (reference: metrics_functions.h PerfMetrics)."""
+
+    train_all: int = 0
+    train_correct: int = 0
+    cce_loss: float = 0.0
+    sparse_cce_loss: float = 0.0
+    mse_loss: float = 0.0
+    rmse_loss: float = 0.0
+    mae_loss: float = 0.0
+
+    def update(self, batch: Dict[str, float]) -> None:
+        self.train_all += int(batch.get("count", 0))
+        self.train_correct += int(batch.get("correct", 0))
+        for k in ("cce_loss", "sparse_cce_loss", "mse_loss", "rmse_loss", "mae_loss"):
+            if k in batch:
+                setattr(self, k, getattr(self, k) + float(batch[k]))
+
+    @property
+    def accuracy(self) -> float:
+        return self.train_correct / max(1, self.train_all)
+
+    def report(self, metrics: List[MetricsType]) -> str:
+        parts = []
+        if MetricsType.ACCURACY in metrics:
+            parts.append(
+                f"accuracy: {100.0 * self.accuracy:.2f}% "
+                f"({self.train_correct} / {self.train_all})"
+            )
+        if MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY in metrics:
+            parts.append(f"sparse_cce: {self.sparse_cce_loss / max(1, self.train_all):.4f}")
+        if MetricsType.CATEGORICAL_CROSSENTROPY in metrics:
+            parts.append(f"cce: {self.cce_loss / max(1, self.train_all):.4f}")
+        if MetricsType.MEAN_SQUARED_ERROR in metrics:
+            parts.append(f"mse: {self.mse_loss / max(1, self.train_all):.4f}")
+        if MetricsType.ROOT_MEAN_SQUARED_ERROR in metrics:
+            parts.append(f"rmse: {self.rmse_loss / max(1, self.train_all):.4f}")
+        if MetricsType.MEAN_ABSOLUTE_ERROR in metrics:
+            parts.append(f"mae: {self.mae_loss / max(1, self.train_all):.4f}")
+        return "  ".join(parts)
+
+
+def compute_batch_metrics(
+    metrics: List[MetricsType],
+    loss_type: LossType,
+    logits: jnp.ndarray,
+    labels: jnp.ndarray,
+) -> Dict[str, jnp.ndarray]:
+    """Per-batch metric computation (reference: Metrics::compute kernels,
+    src/metrics_functions/metrics_functions.cu). Runs inside jit."""
+    out: Dict[str, jnp.ndarray] = {"count": jnp.asarray(logits.shape[0])}
+    sparse = loss_type is LossType.SPARSE_CATEGORICAL_CROSSENTROPY
+    if MetricsType.ACCURACY in metrics:
+        pred = jnp.argmax(logits, axis=-1)
+        if sparse:
+            true = labels.reshape(labels.shape[0], -1)[:, 0].astype(pred.dtype)
+        else:
+            true = jnp.argmax(labels, axis=-1)
+        out["correct"] = jnp.sum(pred == true)
+    if MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY in metrics and sparse:
+        lab = labels.reshape(labels.shape[0], -1)[:, 0].astype(jnp.int32)
+        probs = jnp.clip(logits, 1e-10, 1.0)
+        out["sparse_cce_loss"] = -jnp.sum(
+            jnp.take_along_axis(jnp.log(probs), lab[:, None], axis=-1)
+        )
+    if MetricsType.CATEGORICAL_CROSSENTROPY in metrics and not sparse:
+        probs = jnp.clip(logits, 1e-10, 1.0)
+        out["cce_loss"] = -jnp.sum(labels * jnp.log(probs))
+    if MetricsType.MEAN_SQUARED_ERROR in metrics:
+        out["mse_loss"] = jnp.sum((logits - labels) ** 2)
+    if MetricsType.ROOT_MEAN_SQUARED_ERROR in metrics:
+        # per-sample RMSE summed over the batch (reference:
+        # metrics_functions.cu RMSE accumulation)
+        out["rmse_loss"] = jnp.sum(
+            jnp.sqrt(jnp.mean((logits - labels) ** 2, axis=-1))
+        )
+    if MetricsType.MEAN_ABSOLUTE_ERROR in metrics:
+        out["mae_loss"] = jnp.sum(jnp.abs(logits - labels))
+    return out

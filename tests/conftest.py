@@ -1,0 +1,24 @@
+"""Test configuration: hermetic 8-device CPU mesh.
+
+The reference only tests multi-device behavior on real clusters
+(SURVEY.md §4 "what's missing"); we instead run every DP/TP/EP test on a
+virtual 8-device CPU platform via XLA's host-device emulation.
+"""
+
+import os
+
+# force-override: the dev environment pins JAX_PLATFORMS to the real TPU
+# tunnel (and sitecustomize imports jax at interpreter start, so the env
+# var alone is too late) — tests must run hermetically on the virtual CPU
+# mesh via jax.config.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402  (import after env setup)
+
+jax.config.update("jax_platforms", "cpu")
+assert len(jax.devices()) == 8, jax.devices()
