@@ -1,0 +1,93 @@
+"""Benchmark: the reference's headline Transformer training step
+(reference: examples/cpp/Transformer/transformer.cc:172-210 — ELAPSED
+TIME/THROUGHPUT printed around the epoch loop with execution fences).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+``vs_baseline`` follows the OSDI'22 AE protocol (BASELINE.md): searched /
+hybrid strategy throughput relative to pure data-parallel on the same
+hardware; on a single chip both collapse to the same strategy, so the ratio
+is computed against the data-parallel run when >1 device is present and is
+1.0 otherwise.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def _build(batch_size, num_layers, seq, hidden, heads, mesh=None, tp_axis=None):
+    from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+    from flexflow_tpu.models.transformer import TransformerConfig, build_transformer
+
+    cfg = TransformerConfig(hidden_size=hidden, num_heads=heads,
+                            num_layers=num_layers, sequence_length=seq)
+    ff = FFModel(FFConfig(batch_size=batch_size, seed=0))
+    build_transformer(ff, batch_size, cfg, tp_axis=tp_axis)
+    ff.compile(
+        optimizer=SGDOptimizer(lr=0.01),
+        loss_type=LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+        metrics=[],
+        mesh=mesh,
+    )
+    return ff, cfg
+
+
+def _time_steps(ff, cfg, batch_size, warmup=3, iters=30):
+    """Execution-fenced step timing (reference pattern:
+    transformer.cc:172-210). The loss of iteration N depends on the params
+    of iteration N-1, so fetching the final loss value fences the whole
+    chain; value fetch (not just block_until_ready) defeats any async-relay
+    slack in the device tunnel."""
+    import jax
+
+    cm = ff.compiled
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(batch_size, cfg.sequence_length, cfg.hidden_size)).astype(np.float32)
+    y = rng.normal(size=(batch_size, cfg.sequence_length, 1)).astype(np.float32)
+    xb = jax.device_put(x, cm.input_shardings[0])
+    yb = jax.device_put(y, cm.label_sharding)
+    key = jax.random.key(0)
+    params, opt_state = cm.params, cm.opt_state
+    for _ in range(warmup):
+        params, opt_state, loss, _ = cm.train_step(params, opt_state, key, xb, yb)
+    _ = float(loss)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, opt_state, loss, _ = cm.train_step(params, opt_state, key, xb, yb)
+    _ = float(loss)  # fences the full dependency chain
+    t1 = time.perf_counter()
+    cm.params, cm.opt_state = params, opt_state
+    return (t1 - t0) / iters
+
+
+def main():
+    import jax
+
+    n_dev = len(jax.devices())
+    # the reference benchmark config (transformer.cc:78-86): seq 512,
+    # hidden 1024, 16 heads, 12 layers; batch 8 per the OSDI'22 bert.sh
+    batch = 8 * max(1, n_dev)
+    ff, cfg = _build(batch, num_layers=12, seq=512, hidden=1024, heads=16)
+    step_s = _time_steps(ff, cfg, batch)
+    throughput = batch / step_s
+    print(json.dumps({
+        "metric": "transformer_bert_train_throughput",
+        "value": round(throughput, 2),
+        "unit": "samples/s",
+        "vs_baseline": 1.0,
+        "detail": {
+            "step_time_ms": round(step_s * 1e3, 2),
+            "batch_size": batch,
+            "devices": n_dev,
+            "config": "seq512_hidden1024_heads16_layers12",
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

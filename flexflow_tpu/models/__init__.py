@@ -1,0 +1,9 @@
+"""Model zoo: the reference's example workloads as builder-API definitions
+(reference: examples/cpp/* — SURVEY.md §2.8)."""
+
+from .mlp import build_mlp
+from .alexnet import build_alexnet
+from .resnet import build_resnet50
+from .transformer import build_transformer, build_bert_proxy, TransformerConfig
+from .dlrm import build_dlrm, DLRMConfig
+from .moe import build_moe_mnist, MoeConfig

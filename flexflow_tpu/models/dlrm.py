@@ -1,0 +1,64 @@
+"""DLRM (reference: examples/cpp/DLRM/dlrm.cc — sparse embedding tables +
+bottom/top MLPs with feature interaction; the OSDI'22 AE
+parameter-parallel workload: embedding tables partitioned on the vocab dim
+via ``--enable-parameter-parallel``)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+from ..ffconst import ActiMode, AggrMode, DataType
+from ..runtime.model import FFModel
+
+
+@dataclasses.dataclass
+class DLRMConfig:
+    """reference: dlrm.cc:27-41 defaults."""
+
+    sparse_feature_size: int = 64
+    embedding_size: List[int] = dataclasses.field(
+        default_factory=lambda: [1000000, 1000000, 1000000, 1000000]
+    )
+    embedding_bag_size: int = 1
+    mlp_bot: List[int] = dataclasses.field(default_factory=lambda: [4, 64, 64])
+    mlp_top: List[int] = dataclasses.field(default_factory=lambda: [64, 64, 2])
+    sigmoid_bot: int = -1
+    sigmoid_top: int = -1
+
+
+def _mlp(ff: FFModel, t, dims: List[int], sigmoid_layer: int, prefix: str):
+    """reference: create_mlp (dlrm.cc:44-60)."""
+    for i in range(len(dims) - 1):
+        act = ActiMode.SIGMOID if i == sigmoid_layer else ActiMode.RELU
+        t = ff.dense(t, dims[i + 1], act, name=f"{prefix}_{i}")
+    return t
+
+
+def build_dlrm(ff: FFModel, batch_size: int, cfg: Optional[DLRMConfig] = None,
+               param_axis: Optional[str] = None):
+    """``param_axis``: mesh axis for vocab-dim embedding partitioning (the
+    reference's parameter parallelism for DLRM — SURVEY.md §2.3)."""
+    cfg = cfg or DLRMConfig()
+    sparse_inputs = [
+        ff.create_tensor((batch_size, cfg.embedding_bag_size), DataType.INT32,
+                         name=f"sparse_{i}")
+        for i in range(len(cfg.embedding_size))
+    ]
+    dense_input = ff.create_tensor((batch_size, cfg.mlp_bot[0]),
+                                   DataType.FLOAT, name="dense_input")
+    # embeddings (reference: create_emb dlrm.cc:74-82, aggr SUM over the bag)
+    strategy = {"vocab": param_axis} if param_axis else None
+    ly = [
+        ff.embedding(inp, vocab, cfg.sparse_feature_size, AggrMode.SUM,
+                     name=f"emb_{i}", strategy=strategy)
+        for i, (inp, vocab) in enumerate(zip(sparse_inputs, cfg.embedding_size))
+    ]
+    # bottom MLP on the dense features
+    x = _mlp(ff, dense_input, cfg.mlp_bot, cfg.sigmoid_bot, "bot")
+    # interaction = concat (reference: interact_features dlrm.cc:84-96, "cat")
+    z = ff.concat(ly + [x], axis=-1)
+    # top MLP; final layer sigmoid per sigmoid_top=-1 ⇒ last index len-2
+    sigmoid_top = cfg.sigmoid_top if cfg.sigmoid_top >= 0 else len(cfg.mlp_top) - 2
+    p = _mlp(ff, z, [z.dims[-1]] + cfg.mlp_top[1:], sigmoid_top, "top")
+    return sparse_inputs + [dense_input], p

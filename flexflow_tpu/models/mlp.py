@@ -1,0 +1,25 @@
+"""MLP workload (reference: examples/cpp/MLP_Unify/mlp.cc — the OSDI'22 AE
+MLP config: stacked dense layers trained with SGD)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from ..ffconst import ActiMode, DataType
+from ..runtime.model import FFModel
+
+
+def build_mlp(
+    ff: FFModel,
+    batch_size: int,
+    in_dim: int = 1024,
+    hidden_dims: Sequence[int] = (2048, 2048, 2048, 2048),
+    num_classes: int = 10,
+):
+    x = ff.create_tensor((batch_size, in_dim), DataType.FLOAT, name="input")
+    t = x
+    for i, h in enumerate(hidden_dims):
+        t = ff.dense(t, h, ActiMode.RELU, name=f"mlp_dense{i}")
+    t = ff.dense(t, num_classes, name="mlp_head")
+    t = ff.softmax(t)
+    return x, t

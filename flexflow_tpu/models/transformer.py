@@ -1,0 +1,94 @@
+"""Transformer / BERT-proxy workloads.
+
+``build_transformer`` is the reference's headline benchmark model
+(reference: examples/cpp/Transformer/transformer.cc:33-45,139-160 — input
+(batch, seq=512, hidden=1024); 12 encoder layers of
+[MHA(hidden, 16 heads) → dense(hidden, RELU, no bias) → dense(hidden)];
+final dense(1, no bias); MSE-avg loss; SGD lr 0.01; also the OSDI'22 AE
+"bert.sh" config). ``build_bert_proxy`` adds the layer-norm/residual
+structure of examples/python/native/bert_proxy_native.py.
+
+TP strategy: pass ``tp_axis`` (e.g. ``"model"``) to shard attention heads
+and MLP hidden over that mesh axis — the replicate-attention-combine /
+replicate-linear-combine patterns of the Unity search
+(substitution.cc:1756-1770) expressed directly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from ..ffconst import ActiMode, DataType
+from ..runtime.model import FFModel
+
+
+@dataclasses.dataclass
+class TransformerConfig:
+    """reference: transformer.h TransformerConfig / transformer.cc:78-86."""
+
+    hidden_size: int = 1024
+    embedding_size: int = 1024
+    num_heads: int = 16
+    num_layers: int = 12
+    sequence_length: int = 512
+
+
+def _encoder_layer(ff: FFModel, t, cfg: TransformerConfig, i: int,
+                   tp_axis: Optional[str]):
+    """reference: create_attention_encoder (transformer.cc:33-45): MHA then
+    two dense layers, no residual/norm."""
+    attn_strategy = {"heads": tp_axis} if tp_axis else None
+    mlp_strategy1 = {"out": tp_axis} if tp_axis else None
+    mlp_strategy2 = {"in": tp_axis} if tp_axis else None
+    t = ff.multihead_attention(
+        t, t, t, cfg.hidden_size, cfg.num_heads,
+        name=f"enc{i}_attn", strategy=attn_strategy,
+    )
+    t = ff.dense(t, cfg.hidden_size, ActiMode.RELU, use_bias=False,
+                 name=f"enc{i}_ff1", strategy=mlp_strategy1)
+    t = ff.dense(t, cfg.hidden_size, name=f"enc{i}_ff2", strategy=mlp_strategy2)
+    return t
+
+
+def build_transformer(ff: FFModel, batch_size: int,
+                      cfg: Optional[TransformerConfig] = None,
+                      tp_axis: Optional[str] = None):
+    cfg = cfg or TransformerConfig()
+    x = ff.create_tensor(
+        (batch_size, cfg.sequence_length, cfg.hidden_size),
+        DataType.FLOAT, name="input",
+    )
+    t = x
+    for i in range(cfg.num_layers):
+        t = _encoder_layer(ff, t, cfg, i, tp_axis)
+    t = ff.dense(t, 1, use_bias=False, name="head")
+    return x, t
+
+
+def build_bert_proxy(ff: FFModel, batch_size: int,
+                     cfg: Optional[TransformerConfig] = None,
+                     tp_axis: Optional[str] = None):
+    """BERT-style encoder with residual + layer_norm
+    (reference: examples/python/native/bert_proxy_native.py)."""
+    cfg = cfg or TransformerConfig(hidden_size=768, num_heads=12,
+                                   num_layers=12, sequence_length=128)
+    x = ff.create_tensor(
+        (batch_size, cfg.sequence_length, cfg.hidden_size),
+        DataType.FLOAT, name="input",
+    )
+    t = x
+    for i in range(cfg.num_layers):
+        attn_strategy = {"heads": tp_axis} if tp_axis else None
+        a = ff.multihead_attention(
+            t, t, t, cfg.hidden_size, cfg.num_heads,
+            name=f"bert{i}_attn", strategy=attn_strategy,
+        )
+        t = ff.layer_norm(ff.add(t, a), axes=(-1,), name=f"bert{i}_ln1")
+        h = ff.dense(t, 4 * cfg.hidden_size, ActiMode.GELU,
+                     name=f"bert{i}_ff1",
+                     strategy={"out": tp_axis} if tp_axis else None)
+        h = ff.dense(h, cfg.hidden_size, name=f"bert{i}_ff2",
+                     strategy={"in": tp_axis} if tp_axis else None)
+        t = ff.layer_norm(ff.add(t, h), axes=(-1,), name=f"bert{i}_ln2")
+    return x, t

@@ -60,17 +60,17 @@ class _ElementBinaryBase(Op):
 
 def _make_binary(op_type: OpType):
     fn = _BINARY_FNS[op_type]
-
-    @register_op
-    class _Binary(_ElementBinaryBase):
-        pass
-
-    _Binary.op_type = op_type
-    _Binary.__name__ = f"ElementBinary_{op_type.value}"
-    _Binary.forward = lambda self, ctx, inputs, weights, _fn=fn: [
-        _fn(inputs[0], inputs[1])
-    ]
-    return _Binary
+    cls = type(
+        f"ElementBinary_{op_type.value}",
+        (_ElementBinaryBase,),
+        {
+            "op_type": op_type,
+            "forward": lambda self, ctx, inputs, weights, _fn=fn: [
+                _fn(inputs[0], inputs[1])
+            ],
+        },
+    )
+    return register_op(cls)
 
 
 for _t in _BINARY_FNS:

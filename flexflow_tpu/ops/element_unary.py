@@ -53,30 +53,30 @@ class _ElementUnaryBase(Op):
 
 def _make_unary(op_type: OpType):
     fn = _UNARY_FNS[op_type]
-
-    @register_op
-    class _Unary(_ElementUnaryBase):
-        pass
-
-    _Unary.op_type = op_type
-    _Unary.__name__ = f"ElementUnary_{op_type.value}"
-    _Unary.forward = lambda self, ctx, inputs, weights, _fn=fn: [_fn(inputs[0])]
-    return _Unary
+    cls = type(
+        f"ElementUnary_{op_type.value}",
+        (_ElementUnaryBase,),
+        {
+            "op_type": op_type,
+            "forward": lambda self, ctx, inputs, weights, _fn=fn: [_fn(inputs[0])],
+        },
+    )
+    return register_op(cls)
 
 
 def _make_scalar(op_type: OpType):
     fn = _SCALAR_FNS[op_type]
-
-    @register_op
-    class _Scalar(_ElementUnaryBase):
-        pass
-
-    _Scalar.op_type = op_type
-    _Scalar.__name__ = f"ElementUnary_{op_type.value}"
-    _Scalar.forward = lambda self, ctx, inputs, weights, _fn=fn: [
-        _fn(inputs[0], self.attrs["scalar"])
-    ]
-    return _Scalar
+    cls = type(
+        f"ElementUnary_{op_type.value}",
+        (_ElementUnaryBase,),
+        {
+            "op_type": op_type,
+            "forward": lambda self, ctx, inputs, weights, _fn=fn: [
+                _fn(inputs[0], self.attrs["scalar"])
+            ],
+        },
+    )
+    return register_op(cls)
 
 
 for _t in _UNARY_FNS:

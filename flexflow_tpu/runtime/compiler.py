@@ -67,6 +67,8 @@ class CompiledModel:
     eval_step: Any
     forward_fn: Any
     grad_step: Any
+    raw_forward: Any  # un-jitted forward (params, *xs) -> logits, for
+    #                   callers that want to jit/transform it themselves
     tensor_pshapes: Dict[int, ParallelTensorShape]
     _iteration: int = 0
 
@@ -327,5 +329,6 @@ def compile_model(
         eval_step=jit_eval,
         forward_fn=jit_forward,
         grad_step=jit_grad,
+        raw_forward=forward_fn,
         tensor_pshapes=pshapes,
     )

@@ -1,0 +1,113 @@
+"""Model-zoo smoke + convergence tests (reference analog:
+tests/multi_gpu_tests.sh running the example programs data-parallel)."""
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import (
+    AdamOptimizer,
+    DataType,
+    FFConfig,
+    FFModel,
+    LossType,
+    MetricsType,
+    SGDOptimizer,
+)
+from flexflow_tpu.models import (
+    DLRMConfig,
+    MoeConfig,
+    TransformerConfig,
+    build_alexnet,
+    build_dlrm,
+    build_mlp,
+    build_moe_mnist,
+    build_resnet50,
+    build_transformer,
+)
+
+
+def _step_once(ff, shapes_and_dtypes, label):
+    """Run one jitted train step with random data."""
+    import jax
+
+    cm = ff.compiled
+    rng = np.random.default_rng(0)
+    batch = []
+    for (shape, dt), sh in zip(shapes_and_dtypes, cm.input_shardings):
+        if dt == np.int32 or dt == np.int64:
+            arr = rng.integers(0, 100, size=shape).astype(dt)
+        else:
+            arr = rng.normal(size=shape).astype(dt)
+        batch.append(jax.device_put(arr, sh))
+    batch.append(jax.device_put(label, cm.label_sharding))
+    p, o, loss, m = cm.train_step(cm.params, cm.opt_state, jax.random.key(0), *batch)
+    assert np.isfinite(float(loss)), float(loss)
+    return float(loss)
+
+
+def test_alexnet_smoke():
+    bs = 8
+    ff = FFModel(FFConfig(batch_size=bs))
+    x, out = build_alexnet(ff, bs, image_size=64)  # small image for CPU test
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=[MetricsType.ACCURACY])
+    y = np.zeros((bs, 1), np.int32)
+    _step_once(ff, [((bs, 3, 64, 64), np.float32)], y)
+
+
+def test_transformer_smoke():
+    bs = 8
+    cfg = TransformerConfig(hidden_size=32, num_heads=4, num_layers=2,
+                            sequence_length=16)
+    ff = FFModel(FFConfig(batch_size=bs))
+    build_transformer(ff, bs, cfg)
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type=LossType.MEAN_SQUARED_ERROR_AVG_REDUCE, metrics=[])
+    y = np.zeros((bs, cfg.sequence_length, 1), np.float32)
+    _step_once(ff, [((bs, cfg.sequence_length, cfg.hidden_size), np.float32)], y)
+
+
+def test_dlrm_smoke():
+    bs = 16
+    cfg = DLRMConfig(embedding_size=[1000, 1000, 1000, 1000])
+    ff = FFModel(FFConfig(batch_size=bs))
+    inputs, out = build_dlrm(ff, bs, cfg)
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=[MetricsType.ACCURACY])
+    shapes = [((bs, 1), np.int32)] * 4 + [((bs, 4), np.float32)]
+    y = np.zeros((bs, 1), np.int32)
+    _step_once(ff, shapes, y)
+
+
+def test_moe_trains():
+    bs = 32
+    cfg = MoeConfig(input_dim=16, num_exp=4, num_select=2, expert_hidden_size=32)
+    ff = FFModel(FFConfig(batch_size=bs, epochs=15, seed=0))
+    build_moe_mnist(ff, bs, cfg)
+    ff.compile(optimizer=AdamOptimizer(alpha=0.01),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=[MetricsType.ACCURACY])
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(256, 16)).astype(np.float32)
+    w = rng.normal(size=(16, 10)).astype(np.float32)
+    y = np.argmax(x @ w, axis=1).astype(np.int32).reshape(-1, 1)
+    hist = ff.fit(x, y, verbose=False)
+    assert hist[-1].accuracy > 0.5, hist[-1].accuracy
+
+
+def test_resnet50_builds():
+    """Shape-inference check only (compile of 50 convs is slow on CPU)."""
+    bs = 4
+    ff = FFModel(FFConfig(batch_size=bs))
+    x, out = build_resnet50(ff, bs, image_size=229)
+    assert out.dims == (bs, 1000)
+    assert len([l for l in ff.layers if l.op_type.value == "conv2d"]) == 53
+
+
+def test_mlp_builder():
+    bs = 16
+    ff = FFModel(FFConfig(batch_size=bs))
+    x, out = build_mlp(ff, bs, in_dim=32, hidden_dims=(64, 64), num_classes=4)
+    assert out.dims == (bs, 4)
