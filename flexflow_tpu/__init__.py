@@ -45,6 +45,7 @@ from .ops import (  # noqa: F401
     linear,
     moe_ops,
     norm,
+    parallel_ops,
     reduce,
     softmax,
     structural,

@@ -86,6 +86,9 @@ class MultiHeadAttention(Op):
         self.q_in = input_shapes[0].sizes[-1]
         self.k_in = input_shapes[1].sizes[-1]
         self.v_in = input_shapes[2].sizes[-1]
+        self.causal = bool(a.get("causal", False))
+        # set by propagate when the strategy sequence-shards this op
+        self.seq_axis: str | None = None
 
     def infer_output_shapes(self):
         q = self.input_shapes[0].sizes
@@ -121,13 +124,22 @@ class MultiHeadAttention(Op):
             kh = kh + weights["bk"]
             vh = vh + weights["bv"]
         scale = 1.0 / math.sqrt(self.head_dim)
-        logits = jnp.einsum("bqhd,bkhd->bhqk", qh, kh) * scale
-        probs = jax.nn.softmax(logits, axis=-1)
-        if ctx.training and self.dropout > 0.0 and ctx.rng is not None:
-            keep = 1.0 - self.dropout
-            mask = jax.random.bernoulli(ctx.rng, keep, probs.shape)
-            probs = jnp.where(mask, probs / keep, 0.0)
-        ctxv = jnp.einsum("bhqk,bkhd->bqhd", probs, vh)
+        drop = self.dropout if (ctx.training and ctx.rng is not None) else 0.0
+        from ..parallel.ring_attention import ring_attention, single_device_attention
+
+        if self.seq_axis is not None and ctx.mesh is not None:
+            # sequence parallelism: exact attention over seq-sharded q/k/v
+            # with a collective-permute ring over ICI (no reference
+            # equivalent — SURVEY.md §5 names this the TPU-native plan)
+            ctxv = ring_attention(
+                qh, kh, vh, ctx.mesh, self.seq_axis,
+                causal=self.causal, scale=scale,
+                dropout_rate=drop, rng=ctx.rng,
+            )
+        else:
+            ctxv = single_device_attention(
+                qh, kh, vh, self.causal, scale, drop, ctx.rng
+            )
         out = jnp.einsum("bqhd,hde->bqe", ctxv, weights["wo"])
         if self.use_bias:
             out = out + weights["bo"]
@@ -146,6 +158,15 @@ class MultiHeadAttention(Op):
                 for bn in ("bq", "bk", "bv"):
                     if bn in weight_shapes:
                         weight_shapes[bn] = weight_shapes[bn].partitioned(0, deg, ax)
+        sax = strategy.get("seq")
+        if sax:
+            deg = axis_sizes.get(sax, 1)
+            seqs = {s.sizes[1] for s in input_shapes[:3]}
+            seq = input_shapes[0].sizes[1]
+            # self-attention-shaped only: q/k/v seq equal and divisible
+            if deg > 1 and len(seqs) == 1 and seq % deg == 0:
+                self.seq_axis = sax
+                out_shapes[0] = out_shapes[0].partitioned(1, deg, sax)
         return out_shapes, weight_shapes
 
     def flops(self) -> float:

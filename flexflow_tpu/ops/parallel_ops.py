@@ -1,0 +1,121 @@
+"""Parallel operators: the PCG communication algebra as first-class IR.
+
+TPU-native equivalents of the reference's ``src/parallel_ops``
+(reference: include/flexflow/parallel_ops/parallel_op.h:17-37 and
+partition.cc / combine.cc / replicate.cc / reduction.cc /
+fused_parallel_op.cc — SURVEY.md §2.3).
+
+Translation: the reference realizes each primitive as a Legion
+LogicalPartition plus copy/sum kernels. Here each primitive is a *sharding
+transition*: the op's ``propagate`` rewrites the ParallelTensorShape
+(degree/axis/replica bookkeeping identical to the reference's
+ParallelDim algebra) and the compiler's ``with_sharding_constraint``
+lowering makes GSPMD emit the data movement:
+
+| reference op | PCG semantics                       | XLA lowering         |
+|--------------|-------------------------------------|----------------------|
+| Repartition  | raise partition degree of a dim     | dynamic-slice (scatter) |
+| Combine      | lower partition degree (gather)     | all-gather           |
+| Replicate    | add replica dim                     | broadcast; bwd: all-reduce of grads |
+| Reduction    | reduce replica dim (sum)            | all-reduce / reduce-scatter |
+
+Gradient pairing (parallel_tensor.h:70 ``is_replica_dim`` ↔ reduction)
+comes from autodiff: the transpose of broadcast is sum, of slice is pad —
+XLA inserts the paired collectives in the backward pass automatically.
+
+An AllReduce op is also provided for explicit gradient-sync placement
+(reference: the NCCL allreduce inside optimizer update tasks,
+optimizer_kernel.cu:88,196) though the standard path gets it implicitly
+from sharding propagation.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ffconst import OpType
+from ..core.op import Op, register_op
+from ..core.parallel_tensor import ParallelDim, ParallelTensorShape
+
+
+class _ParallelOpBase(Op):
+    """Identity compute; all semantics live in ``propagate``.
+
+    ``force_constraint`` makes the compiler emit the sharding constraint
+    even when the result is fully replicated (e.g. Combine back to
+    degree 1 must force the all-gather at this point in the graph)."""
+
+    force_constraint = True
+
+    def infer_output_shapes(self):
+        return [(self.input_shapes[0].sizes, self.input_shapes[0].dtype)]
+
+    def forward(self, ctx, inputs, weights):
+        return [inputs[0]]
+
+
+@register_op
+class Repartition(_ParallelOpBase):
+    """reference: src/parallel_ops/partition.cc — split a tensor dim across
+    a mesh axis. attrs: dim (int), axis (str), degree (int, optional —
+    defaults to the mesh axis size)."""
+
+    op_type = OpType.REPARTITION
+
+    def propagate(self, input_shapes, strategy):
+        in0 = input_shapes[0]
+        dim = self.attrs["dim"] % len(in0.dims)
+        axis = self.attrs["axis"]
+        axis_sizes = strategy.get("_axis_sizes", {})
+        degree = self.attrs.get("degree") or axis_sizes.get(axis, 1)
+        out = in0.partitioned(dim, degree, axis)
+        return [out], {}
+
+
+@register_op
+class Combine(_ParallelOpBase):
+    """reference: src/parallel_ops/combine.cc — gather a partitioned dim
+    back to full (degree -> 1). attrs: dim (int)."""
+
+    op_type = OpType.COMBINE
+
+    def propagate(self, input_shapes, strategy):
+        in0 = input_shapes[0]
+        dim = self.attrs["dim"] % len(in0.dims)
+        return [in0.combined(dim)], {}
+
+
+@register_op
+class Replicate(_ParallelOpBase):
+    """reference: src/parallel_ops/replicate.cc — replicate over a mesh
+    axis; backward sums replica gradients (via autodiff transpose).
+    attrs: axis (str)."""
+
+    op_type = OpType.REPLICATE
+
+    def propagate(self, input_shapes, strategy):
+        return [input_shapes[0].replicated(self.attrs["axis"])], {}
+
+
+@register_op
+class Reduction(_ParallelOpBase):
+    """reference: src/parallel_ops/reduction.cc — sum-reduce a replica
+    axis. With GSPMD the partial-sum state that the reference represents
+    explicitly is produced by ops whose contraction dim is sharded; psum
+    over the axis materializes the full sum. attrs: axis (str)."""
+
+    op_type = OpType.REDUCTION
+
+    def propagate(self, input_shapes, strategy):
+        return [input_shapes[0].reduced(self.attrs["axis"])], {}
+
+
+@register_op
+class AllReduce(_ParallelOpBase):
+    """Explicit all-reduce marker (reference: NCCL allreduce in
+    optimizer_kernel.cu). Identity under GSPMD lowering — the sharding
+    transition from a partial-sum producer already emits the collective;
+    kept for strategy-IR parity and the simulator's comm-cost accounting."""
+
+    op_type = OpType.ALLREDUCE

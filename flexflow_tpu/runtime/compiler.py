@@ -179,7 +179,10 @@ def _forward_graph(
         ctx.rng = jax.random.fold_in(rng, oi) if rng is not None else None
         outs = op.forward(ctx, ins, params.get(op.name, {}))
         for out, t, ps in zip(outs, op.layer.outputs, op.output_shapes):
-            if mesh is not None and any(d.is_partitioned for d in ps.dims):
+            if mesh is not None and (
+                any(d.is_partitioned for d in ps.dims)
+                or getattr(op, "force_constraint", False)
+            ):
                 out = jax.lax.with_sharding_constraint(out, _named_sharding(mesh, ps))
             acts[t.tensor_id] = out
     return acts, ctx.aux_losses

@@ -373,11 +373,13 @@ class FFModel:
         add_bias_kv: bool = False,
         add_zero_attn: bool = False,
         kernel_initializer=None,
+        causal: bool = False,
         name=None,
         strategy: Optional[Dict[str, str]] = None,
     ) -> Tensor:
         """reference: FFModel::multihead_attention (model.h:542,
-        src/ops/attention.cc — cuDNN multihead attention)."""
+        src/ops/attention.cc — cuDNN multihead attention). ``causal`` is a
+        TPU-native extension (the reference has no causal masking)."""
         attrs = dict(
             embed_dim=embed_dim,
             num_heads=num_heads,
@@ -388,6 +390,7 @@ class FFModel:
             add_bias_kv=add_bias_kv,
             add_zero_attn=add_zero_attn,
             kernel_initializer=kernel_initializer,
+            causal=causal,
         )
         if strategy:
             attrs["strategy"] = strategy
@@ -441,6 +444,56 @@ class FFModel:
             agg_inputs.append(self.softmax(h))
         return self.aggregate(agg_inputs, num_exp, lambda_bal, name=f"{nm}_agg")
 
+    # ---- parallel ops (reference: src/parallel_ops — SURVEY.md §2.3) ----- #
+    def repartition(self, input: Tensor, dim: int, axis: str,
+                    degree: Optional[int] = None, name=None) -> Tensor:
+        """reference: Repartition (src/parallel_ops/partition.cc)."""
+        attrs = dict(dim=dim, axis=axis)
+        if degree:
+            attrs["degree"] = degree
+        return self._infer_and_add(OpType.REPARTITION, [input], attrs, name)
+
+    def combine(self, input: Tensor, dim: int, name=None) -> Tensor:
+        """reference: Combine (src/parallel_ops/combine.cc)."""
+        return self._infer_and_add(OpType.COMBINE, [input], dict(dim=dim), name)
+
+    def replicate(self, input: Tensor, axis: str, name=None) -> Tensor:
+        """reference: Replicate (src/parallel_ops/replicate.cc)."""
+        return self._infer_and_add(OpType.REPLICATE, [input], dict(axis=axis), name)
+
+    def reduction(self, input: Tensor, axis: str, name=None) -> Tensor:
+        """reference: Reduction (src/parallel_ops/reduction.cc)."""
+        return self._infer_and_add(OpType.REDUCTION, [input], dict(axis=axis), name)
+
+    def allreduce(self, input: Tensor, name=None) -> Tensor:
+        return self._infer_and_add(OpType.ALLREDUCE, [input], {}, name)
+
+    # ---- strategy import/export (reference: --import-strategy /
+    # --export-strategy, model.cc:3609-3618, src/runtime/strategy.cc) ------ #
+    def export_strategy(self, path: str) -> None:
+        import json
+
+        strat = {}
+        for layer in self.layers:
+            if "strategy" in layer.attrs and layer.attrs["strategy"]:
+                strat[layer.name] = {
+                    k: v for k, v in layer.attrs["strategy"].items()
+                    if not k.startswith("_")
+                }
+        with open(path, "w") as f:
+            json.dump({"version": 1, "strategies": strat}, f, indent=2)
+
+    def import_strategy(self, path: str) -> Dict[str, Dict[str, str]]:
+        import json
+
+        with open(path) as f:
+            data = json.load(f)
+        strat = data.get("strategies", data)
+        for layer in self.layers:
+            if layer.name in strat:
+                layer.attrs["strategy"] = dict(strat[layer.name])
+        return strat
+
     # ------------------------------------------------------------------ #
     # compile & training verbs                                           #
     # ------------------------------------------------------------------ #
@@ -478,6 +531,11 @@ class FFModel:
         # only_data_parallel drops all overrides (reference: model.cc:2638)
         if self.config.only_data_parallel:
             strat = {}
+        # write merged strategies back onto layers so export_strategy sees
+        # search/compile-supplied maps, not only builder-time overrides
+        for layer in self.layers:
+            if layer.name in strat:
+                layer.attrs["strategy"] = dict(strat[layer.name])
         self.compiled = compile_model(
             self.config,
             self.layers,
