@@ -35,6 +35,9 @@ class DataType(enum.Enum):
     def from_jnp(dtype) -> "DataType":
         return DataType(jnp.dtype(dtype).name)
 
+    def itemsize(self) -> int:
+        return int(self.to_jnp().itemsize)
+
 
 class ActiMode(enum.Enum):
     """Fused activation modes (reference: ffconst.h AC_MODE_*)."""

@@ -100,6 +100,7 @@ def build_ops(
         op = create_op(layer, in_shapes)
         strategy = dict(strategies.get(layer.name, {}))
         strategy["_axis_sizes"] = axis_sizes
+        op.axis_sizes = dict(axis_sizes)  # single source for sim/search costs
         out_shapes, weight_shapes = op.propagate(in_shapes, strategy)
         op.output_shapes = out_shapes
         op.weight_shapes = weight_shapes

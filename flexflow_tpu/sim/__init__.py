@@ -1,0 +1,36 @@
+"""Execution simulator / cost model.
+
+TPU-native equivalent of the reference's profiling-based simulator
+(reference: include/flexflow/simulator.h, src/runtime/simulator.cc,
+src/runtime/machine_model.cc — SURVEY.md §2.6): per-op cost measurement
+(memoized), an analytic machine/network model, and full-step simulation
+used by the auto-parallelization search.
+"""
+
+from .machine_model import (
+    TPUChipSpec,
+    MachineModel,
+    SimpleMachineModel,
+    TorusMachineModel,
+    MultiSliceMachineModel,
+    CHIP_PRESETS,
+    detect_machine_model,
+)
+from .cost_model import CostMetrics, OpCostModel, ProfilingCostModel
+from .simulator import MemoryUsage, SimTask, Simulator
+
+__all__ = [
+    "TPUChipSpec",
+    "MachineModel",
+    "SimpleMachineModel",
+    "TorusMachineModel",
+    "MultiSliceMachineModel",
+    "CHIP_PRESETS",
+    "detect_machine_model",
+    "CostMetrics",
+    "OpCostModel",
+    "ProfilingCostModel",
+    "MemoryUsage",
+    "SimTask",
+    "Simulator",
+]

@@ -1,0 +1,240 @@
+"""Per-operator cost measurement.
+
+TPU-native equivalent of the reference's
+``Simulator::measure_operator_cost`` (reference: simulator.h:691-778,
+memoized by ProfilingRecordKey simulator.h:689/750; per-op
+``measure_operator_cost`` timing real kernels via cudaEvents,
+src/runtime/model.cu:17-53).
+
+Two backends, both memoized by (op-params, strategy) hash exactly like the
+reference's ``hash_to_operator_cost``:
+
+* :class:`OpCostModel` — **analytic roofline**: per-device time =
+  max(flops / effective-MXU-FLOP/s, bytes / effective-HBM-bandwidth).
+  This replaces on-device microbenchmarks for search inner loops, where the
+  reference pays kernel-launch latency per candidate and we cannot afford
+  an XLA compile per candidate (SURVEY.md §7 hard-part 4).
+* :class:`ProfilingCostModel` — **measured**: jit the op's forward on the
+  real device at the sharded per-device shape, time it (warmup + repeats,
+  the reference's inner_measure_operator_cost protocol), and fall back to
+  the analytic model on failure. Used to calibrate/validate the analytic
+  numbers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..ffconst import DataType
+from ..core.op import Op
+from ..core.parallel_tensor import ParallelTensorShape
+from .machine_model import MachineModel
+
+
+@dataclasses.dataclass
+class CostMetrics:
+    """reference: CostMetrics (simulator.h:54-88)."""
+
+    forward_time: float = 0.0
+    backward_time: float = 0.0
+    sync_time: float = 0.0          # gradient sync (allreduce) time
+    inputs_memory: int = 0          # per-device bytes
+    outputs_memory: int = 0
+    weights_memory: int = 0
+
+    @property
+    def total_time(self) -> float:
+        return self.forward_time + self.backward_time + self.sync_time
+
+    @property
+    def total_memory(self) -> int:
+        return self.inputs_memory + self.outputs_memory + self.weights_memory
+
+
+def _pshape_local_bytes(ps: ParallelTensorShape) -> int:
+    """Per-device bytes of a sharded tensor."""
+    n = 1
+    for d in ps.dims:
+        n *= d.size // d.degree
+    return n * ps.dtype.itemsize()
+
+
+def _op_strategy_key(op: Op) -> Tuple:
+    """Memoization key: op type, attrs, and the full sharding signature
+    (reference: ProfilingRecordKey = (params-hash, machine-view))."""
+    def ps_key(ps: ParallelTensorShape):
+        return (
+            tuple((d.size, d.degree, d.axis) for d in ps.dims)
+            + (ps.dtype,)
+            + tuple(sorted(ps.replica_axes))
+        )
+
+    attrs = tuple(
+        (k, v if isinstance(v, (int, float, str, bool, tuple, type(None))) else str(v))
+        for k, v in sorted(op.attrs.items(), key=lambda kv: kv[0])
+        if not k.startswith("_")
+    )
+    return (
+        op.op_type,
+        attrs,
+        tuple(sorted(_axis_sizes_from(op).items())),
+        tuple(ps_key(p) for p in op.input_shapes),
+        tuple(ps_key(p) for p in op.output_shapes),
+        tuple(sorted((n, ps_key(p)) for n, p in op.weight_shapes.items())),
+    )
+
+
+class OpCostModel:
+    """Analytic roofline cost, memoized.
+
+    The backward pass of a matmul-dominated op costs ~2× forward (dgrad +
+    wgrad GEMMs); elementwise ops ~1×. We use 2× uniformly like the
+    reference's simulator does when an op provides no backward measurement —
+    the constant cancels in strategy comparisons.
+    """
+
+    BWD_FACTOR = 2.0
+
+    def __init__(self, machine: MachineModel):
+        self.machine = machine
+        self._cache: Dict[Tuple, CostMetrics] = {}
+
+    def measure(self, op: Op) -> CostMetrics:
+        key = _op_strategy_key(op)
+        hit = self._cache.get(key)
+        if hit is not None:
+            return hit
+        cm = self._measure_uncached(op)
+        self._cache[key] = cm
+        return cm
+
+    # -- hooks a subclass can override ---------------------------------------
+    def _forward_time(self, op: Op, flops_per_dev: float, bytes_per_dev: float) -> float:
+        chip = self.machine.chip
+        compute = flops_per_dev / (chip.peak_bf16_flops * chip.mxu_efficiency)
+        memory = bytes_per_dev / (chip.hbm_bandwidth * chip.hbm_efficiency)
+        return max(compute, memory) + chip.kernel_overhead
+
+    def _measure_uncached(self, op: Op) -> CostMetrics:
+        in_bytes = sum(_pshape_local_bytes(p) for p in op.input_shapes)
+        out_bytes = sum(_pshape_local_bytes(p) for p in op.output_shapes)
+        w_bytes = sum(_pshape_local_bytes(p) for p in op.weight_shapes.values())
+
+        # per-device flops: total flops divided by every distinct mesh axis
+        # that partitions the computation — output-sharding axes AND
+        # contraction axes (an input/weight dim sharded on an axis absent
+        # from the output splits the reduction; each device computes a
+        # partial sum of full output shape but over 1/degree of the work).
+        # Replication re-does work: replica axes give no credit.
+        total_flops = float(op.flops())
+        axis_deg: Dict[str, int] = {}
+        for ps in op.output_shapes:
+            for d in ps.dims:
+                if d.is_partitioned:
+                    axis_deg[d.axis] = max(axis_deg.get(d.axis, 1), d.degree)
+        for ps in list(op.input_shapes) + list(op.weight_shapes.values()):
+            for d in ps.dims:
+                if d.is_partitioned:
+                    axis_deg[d.axis] = max(axis_deg.get(d.axis, 1), d.degree)
+        parts = 1
+        for deg in axis_deg.values():
+            parts *= deg
+        flops_per_dev = total_flops / max(parts, 1)
+
+        fwd = self._forward_time(op, flops_per_dev, in_bytes + out_bytes + w_bytes)
+        bwd = self.BWD_FACTOR * fwd
+
+        # gradient sync: any weight replicated across an axis must be
+        # all-reduced over that axis's degree (reference: nccl_update_task
+        # allreduce per weight, optimizer_kernel.cu:88)
+        sync = 0.0
+        axis_sizes = _axis_sizes_from(op)
+        for ps in op.weight_shapes.values():
+            sharded_axes = {d.axis for d in ps.dims if d.is_partitioned}
+            wb = _pshape_local_bytes(ps)
+            for axis, deg in axis_sizes.items():
+                if deg > 1 and axis not in sharded_axes:
+                    sync += self.machine.allreduce_time(wb, deg, axis)
+        return CostMetrics(fwd, bwd, sync, in_bytes, out_bytes, w_bytes)
+
+
+def _axis_sizes_from(op: Op) -> Dict[str, int]:
+    # ``build_ops`` stamps ``op.axis_sizes`` on every op (the one canonical
+    # channel); ops built outside the compiler fall back to scanning dims +
+    # replica axes, which misses axes the op doesn't touch at all.
+    sizes = getattr(op, "axis_sizes", None)
+    if sizes:
+        return dict(sizes)
+    out: Dict[str, int] = {}
+    for ps in list(op.input_shapes) + list(op.output_shapes) + list(op.weight_shapes.values()):
+        for d in ps.dims:
+            if d.is_partitioned and d.axis:
+                out[d.axis] = max(out.get(d.axis, 1), d.degree)
+        for a in ps.replica_axes:
+            out.setdefault(a, 1)
+    return out
+
+
+class ProfilingCostModel(OpCostModel):
+    """Times the op's jitted forward at the per-device local shape on the
+    real device (reference: inner_measure_operator_cost warmup+repeat
+    protocol, model.cu:17-53). Results are memoized; comm/sync costs remain
+    analytic (they depend on the mesh, which one chip can't measure)."""
+
+    def __init__(self, machine: MachineModel, warmup: int = 2, repeats: int = 5):
+        super().__init__(machine)
+        self.warmup = warmup
+        self.repeats = repeats
+
+    def _measure_uncached(self, op: Op) -> CostMetrics:
+        analytic = super()._measure_uncached(op)
+        try:
+            measured = self._profile_forward(op)
+        except Exception:
+            return analytic
+        if measured is None:
+            return analytic
+        return CostMetrics(
+            measured,
+            self.BWD_FACTOR * measured,
+            analytic.sync_time,
+            analytic.inputs_memory,
+            analytic.outputs_memory,
+            analytic.weights_memory,
+        )
+
+    def _profile_forward(self, op: Op) -> Optional[float]:
+        import jax
+        import jax.numpy as jnp
+
+        from ..core.op import LowerCtx
+
+        def local_shape(ps: ParallelTensorShape):
+            return tuple(d.size // d.degree for d in ps.dims)
+
+        rng = np.random.default_rng(0)
+
+        def sample(ps: ParallelTensorShape):
+            shp = local_shape(ps)
+            if ps.dtype in (DataType.INT32, DataType.INT64):
+                return jnp.asarray(rng.integers(0, 2, size=shp), dtype=ps.dtype.to_jnp())
+            return jnp.asarray(rng.standard_normal(shp), dtype=ps.dtype.to_jnp())
+
+        ins = [sample(p) for p in op.input_shapes]
+        weights = {n: sample(p) for n, p in op.weight_shapes.items()}
+        ctx = LowerCtx(mesh=None, training=False, rng=jax.random.key(0))
+
+        fn = jax.jit(lambda i, w: op.forward(ctx, i, w))
+        out = fn(ins, weights)  # compile + warmup 1
+        jax.block_until_ready(out)
+        for _ in range(self.warmup):
+            jax.block_until_ready(fn(ins, weights))
+        t0 = time.perf_counter()
+        for _ in range(self.repeats):
+            out = fn(ins, weights)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / self.repeats

@@ -1,0 +1,237 @@
+"""Machine models: TPU chip + interconnect analytic cost.
+
+TPU-native re-design of the reference's machine-model hierarchy
+(reference: simulator.h:212-606 — SimpleMachineModel /
+EnhancedMachineModel / NetworkedMachineModel; src/runtime/machine_model.cc;
+network topology + routing in src/runtime/network.cc). Where the reference
+models PCIe/NVLink/NIC segments and simulates NCCL rings, the TPU model is
+built around the hardware that actually exists here:
+
+* a **chip spec** (MXU peak FLOP/s, HBM bandwidth/capacity, vector-unit
+  throughput) — plays the role of the reference's per-GPU microbenchmarks;
+* an **ICI torus** within a slice (per-link bandwidth + per-hop latency,
+  bidirectional links, 2D/3D wrap-around) — plays NVLink/GPUDirect;
+* **DCN** across slices (per-host bandwidth, much higher latency) — plays
+  the inter-node NIC model.
+
+Collective costs use the standard ring/torus lower-bound formulas (the same
+algebra the scaling literature uses): an all-reduce of S bytes over an axis
+of n chips moves ``2*(n-1)/n * S`` bytes through each link, etc. These are
+the costs XLA's collectives approach on ICI, which is what makes an
+analytic model viable where the reference needed event-level NCCL
+simulation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class TPUChipSpec:
+    """Peak numbers for one TPU chip (public figures; the profiling cost
+    model recalibrates against measured kernels — reference analog:
+    Op::inner_measure_operator_cost's cudaEvent timing, model.cu:17-53)."""
+
+    name: str
+    peak_bf16_flops: float          # FLOP/s on the MXU, bf16 inputs
+    hbm_bandwidth: float            # bytes/s
+    hbm_capacity: float             # bytes
+    ici_link_bandwidth: float       # bytes/s per link per direction
+    ici_num_links: int              # links per chip (torus degree)
+    ici_latency: float = 1e-6      # per-hop seconds
+    dcn_bandwidth: float = 25e9     # bytes/s per host across slices
+    dcn_latency: float = 10e-6
+    # achievable fractions of peak (roofline knee calibration)
+    mxu_efficiency: float = 0.55
+    hbm_efficiency: float = 0.8
+    kernel_overhead: float = 2e-6   # fixed per-fused-region launch cost
+
+
+CHIP_PRESETS: Dict[str, TPUChipSpec] = {
+    # Figures from public spec sheets / the scaling-book tables (approximate).
+    "v4": TPUChipSpec("v4", 275e12, 1.23e12, 32 << 30, 45e9, 6),
+    "v5e": TPUChipSpec("v5e", 197e12, 0.82e12, 16 << 30, 45e9, 4),
+    "v5p": TPUChipSpec("v5p", 459e12, 2.77e12, 95 << 30, 90e9, 6),
+    "v6e": TPUChipSpec("v6e", 918e12, 1.64e12, 32 << 30, 90e9, 4),
+    # hermetic-test chip: round numbers so expected costs are exact
+    # (SURVEY.md §4: the reference has no deterministic machine-model tests;
+    # we add them)
+    "test": TPUChipSpec(
+        "test", 1e12, 1e11, 8 << 30, 1e10, 4,
+        ici_latency=1e-6, dcn_bandwidth=1e9, dcn_latency=1e-5,
+        mxu_efficiency=1.0, hbm_efficiency=1.0, kernel_overhead=0.0,
+    ),
+}
+
+
+class MachineModel:
+    """Interface: collective + point-to-point costs over a named mesh.
+
+    reference: MachineModel base (simulator.h:212-…) exposing
+    get_*_bandwidth / latency used by simulate_runtime's comm-task sizing.
+    Axis degrees come from the mesh the strategy targets; the model decides
+    what fabric each axis rides (ICI vs DCN).
+    """
+
+    chip: TPUChipSpec
+
+    def num_devices(self) -> int:
+        raise NotImplementedError
+
+    # every cost takes per-participant payload bytes and the axis degree
+    def allreduce_time(self, bytes_per_device: float, degree: int, axis: str = "") -> float:
+        raise NotImplementedError
+
+    def allgather_time(self, bytes_per_device: float, degree: int, axis: str = "") -> float:
+        raise NotImplementedError
+
+    def reducescatter_time(self, bytes_per_device: float, degree: int, axis: str = "") -> float:
+        raise NotImplementedError
+
+    def alltoall_time(self, bytes_per_device: float, degree: int, axis: str = "") -> float:
+        raise NotImplementedError
+
+    def permute_time(self, bytes_per_device: float, degree: int, axis: str = "") -> float:
+        raise NotImplementedError
+
+
+class SimpleMachineModel(MachineModel):
+    """v0 model: every mesh axis rides ICI with the same per-link bandwidth
+    (reference analog: SimpleMachineModel's flat intra-node bandwidth,
+    simulator.h:212-260). Good default for a single slice where the mesh is
+    laid out on the torus by the XLA runtime.
+    """
+
+    def __init__(self, chip: TPUChipSpec = CHIP_PRESETS["v5e"], n_devices: int = 1):
+        self.chip = chip
+        self._n = n_devices
+
+    def num_devices(self) -> int:
+        return self._n
+
+    # ring formulas; ICI links are bidirectional so a ring all-gather can use
+    # both directions → effective per-link bandwidth ×2.
+    def _bw(self, axis: str) -> float:
+        return self.chip.ici_link_bandwidth * 2.0
+
+    def _bw_unidir(self, axis: str) -> float:
+        """One-direction bandwidth (a permute shifts data one way only)."""
+        return self._bw(axis) / 2.0
+
+    def _lat(self, axis: str) -> float:
+        return self.chip.ici_latency
+
+    def allgather_time(self, bytes_per_device, degree, axis=""):
+        if degree <= 1:
+            return 0.0
+        return (degree - 1) * (bytes_per_device / self._bw(axis) + self._lat(axis))
+
+    def reducescatter_time(self, bytes_per_device, degree, axis=""):
+        # same volume pattern as all-gather (each device ends with 1/degree)
+        if degree <= 1:
+            return 0.0
+        shard = bytes_per_device / degree
+        return (degree - 1) * (shard / self._bw(axis) + self._lat(axis))
+
+    def allreduce_time(self, bytes_per_device, degree, axis=""):
+        # reduce-scatter + all-gather of the scattered shard
+        if degree <= 1:
+            return 0.0
+        shard = bytes_per_device / degree
+        return 2 * (degree - 1) * (shard / self._bw(axis) + self._lat(axis))
+
+    def alltoall_time(self, bytes_per_device, degree, axis=""):
+        if degree <= 1:
+            return 0.0
+        # each device exchanges (degree-1)/degree of its payload; on a
+        # bidirectional ring average hop distance degree/4 over degree
+        # concurrent links → effective time ≈ vol / (2·bw)
+        vol = bytes_per_device * (degree - 1) / degree
+        return vol / (2.0 * self._bw(axis)) + self._lat(axis) * degree / 2
+
+    def permute_time(self, bytes_per_device, degree, axis=""):
+        if degree <= 1:
+            return 0.0
+        return bytes_per_device / self._bw_unidir(axis) + self._lat(axis)
+
+
+class TorusMachineModel(SimpleMachineModel):
+    """Slice-topology-aware model: mesh axes are assigned to torus
+    dimensions; an axis folded over k torus dims gets k× link bandwidth
+    (reference analog: NetworkedMachineModel's topology matrix + routing,
+    simulator.h:421-499, network.cc).
+
+    ``axis_links``: mesh-axis name → number of torus links serving it
+    (e.g. on a v4 4x4x4 slice with mesh {data:16, model:4}: the model axis
+    mapped to one torus ring gets 1, data folded over two torus dims 2).
+    """
+
+    def __init__(
+        self,
+        chip: TPUChipSpec,
+        axis_degrees: Dict[str, int],
+        axis_links: Optional[Dict[str, int]] = None,
+        wraparound: bool = True,
+    ):
+        n = 1
+        for d in axis_degrees.values():
+            n *= d
+        super().__init__(chip, n)
+        self.axis_degrees = dict(axis_degrees)
+        self.axis_links = dict(axis_links or {})
+        self.wraparound = wraparound
+
+    def _bw(self, axis: str) -> float:
+        links = self.axis_links.get(axis, 1)
+        dirs = 2.0 if self.wraparound else 1.0
+        return self.chip.ici_link_bandwidth * links * dirs
+
+
+class MultiSliceMachineModel(TorusMachineModel):
+    """Multi-slice: one designated mesh axis (usually the outermost data
+    axis) crosses DCN; everything else is ICI within a slice (reference
+    analog: inter-node bandwidth in SimpleMachineModel / the NIC segments of
+    EnhancedMachineModel)."""
+
+    def __init__(self, chip, axis_degrees, dcn_axes: Tuple[str, ...] = ("data_dcn",), **kw):
+        super().__init__(chip, axis_degrees, **kw)
+        self.dcn_axes = tuple(dcn_axes)
+
+    def _bw(self, axis: str) -> float:
+        if axis in self.dcn_axes:
+            return self.chip.dcn_bandwidth
+        return super()._bw(axis)
+
+    def _bw_unidir(self, axis: str) -> float:
+        if axis in self.dcn_axes:
+            return self.chip.dcn_bandwidth
+        return super()._bw_unidir(axis)
+
+    def _lat(self, axis: str) -> float:
+        if axis in self.dcn_axes:
+            return self.chip.dcn_latency
+        return super()._lat(axis)
+
+
+def detect_machine_model(n_devices: Optional[int] = None) -> MachineModel:
+    """Best-effort detection of the current platform (reference analog:
+    FFConfig querying the Realm machine, model.cc:3501)."""
+    import jax
+
+    devs = jax.devices()
+    n = n_devices if n_devices is not None else len(devs)
+    kind = getattr(devs[0], "device_kind", "").lower() if devs else ""
+    compact = kind.replace(" ", "")
+    # device_kind strings: "TPU v4", "TPU v5 lite"/"TPU v5e", "TPU v5p",
+    # "TPU v6 lite" (Trillium)
+    if "v6" in compact or "trillium" in compact:
+        chip = CHIP_PRESETS["v6e"]
+    elif "v5p" in compact:
+        chip = CHIP_PRESETS["v5p"]
+    elif "v4" in compact:
+        chip = CHIP_PRESETS["v4"]
+    else:
+        chip = CHIP_PRESETS["v5e"]
+    return SimpleMachineModel(chip, n)
