@@ -48,6 +48,7 @@ class FFConfig:
     workers_per_node: int = 0  # 0 => autodetect
     search_budget: int = 0
     search_alpha: float = 1.2
+    search_method: str = "unity"  # "unity" (DP, default) | "mcmc" (MLSys'19)
     search_overlap_backward_update: bool = False
     only_data_parallel: bool = False
     enable_sample_parallel: bool = True

@@ -131,6 +131,16 @@ class Op:
         """Forward FLOPs estimate for the analytic cost model."""
         return 0.0
 
+    def input_contraction_dims(self) -> List[Tuple[int, int, Optional[str], int]]:
+        """Contraction structure for comm-cost modeling: tuples of
+        (input_index, input_dim, weight_name, weight_dim) where input_dim is
+        summed against weight_dim. Lets the simulator distinguish a sharded
+        contraction (partial sums → all-reduce) from a sharding mismatch
+        (→ all-gather of the input) — the cost difference between the
+        reference's partition-linear-combine and replicate-linear-combine
+        patterns (substitution.cc:77-108)."""
+        return []
+
     def __repr__(self) -> str:
         return f"{type(self).__name__}({self.name})"
 

@@ -94,9 +94,37 @@ class Conv2D(Op):
             y = y + weights["bias"][None, :, None, None]
         return [apply_activation(y, self.activation)]
 
+    def propagate(self, input_shapes, strategy):
+        """Channel parallelism: ``{"out_channels": axis}`` shards the kernel
+        O-dim and the output channel dim (the reference's conv channel
+        partition xfers, OptCNN patterns in generate_all_pcg_xfers;
+        attribute parallelism on non-batch dims, model.cc:3627)."""
+        out_shapes, weight_shapes = super().propagate(input_shapes, strategy)
+        axis = strategy.get("out_channels")
+        if axis:
+            deg = strategy.get("_axis_sizes", {}).get(axis, 1)
+            if deg > 1 and self.out_channels % deg == 0:
+                ps = out_shapes[0]
+                out_shapes[0] = ps.with_dim(
+                    1, ParallelDim(self.out_channels, deg, axis)
+                )
+                k = weight_shapes["kernel"]
+                weight_shapes["kernel"] = k.with_dim(
+                    0, ParallelDim(self.out_channels, deg, axis)
+                )
+                if self.use_bias:
+                    weight_shapes["bias"] = ParallelTensorShape(
+                        (ParallelDim(self.out_channels, deg, axis),),
+                        weight_shapes["bias"].dtype,
+                    )
+        return out_shapes, weight_shapes
+
     def flops(self) -> float:
         (n, co, oh, ow), _ = self.infer_output_shapes()[0]
         return 2.0 * n * co * oh * ow * (self.in_channels // self.groups) * self.kernel[0] * self.kernel[1]
+
+    def input_contraction_dims(self):
+        return [(0, 1, "kernel", 1)]  # input C contracts with kernel I
 
 
 @register_op

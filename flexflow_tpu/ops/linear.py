@@ -137,3 +137,6 @@ class Linear(Op):
         for s in self.input_shapes[0].sizes[:-1]:
             batch *= s
         return 2.0 * batch * self.in_dim * self.out_dim
+
+    def input_contraction_dims(self):
+        return [(0, len(self.input_shapes[0].dims) - 1, "kernel", 0)]

@@ -58,6 +58,8 @@ class FFModel:
         self.input_tensors: List[Tensor] = []
         self.optimizer: Optional[Optimizer] = None
         self.compiled: Optional[CompiledModel] = None
+        self.search_result = None  # GraphSearchResult from the last search
+        self._search_strategies: Dict[str, Dict[str, str]] = {}
         self.iter_config = FFIterationConfig()
         self._param_index: Dict[int, Tuple[str, str]] = {}  # tensor_id -> (op, weight)
         self._label_np: Optional[np.ndarray] = None
@@ -474,12 +476,14 @@ class FFModel:
         import json
 
         strat = {}
+        merged = dict(self._search_strategies)
         for layer in self.layers:
             if "strategy" in layer.attrs and layer.attrs["strategy"]:
-                strat[layer.name] = {
-                    k: v for k, v in layer.attrs["strategy"].items()
-                    if not k.startswith("_")
-                }
+                merged[layer.name] = layer.attrs["strategy"]
+        for name, s in merged.items():
+            clean = {k: v for k, v in s.items() if not k.startswith("_")}
+            if clean:
+                strat[name] = clean
         with open(path, "w") as f:
             json.dump({"version": 1, "strategies": strat}, f, indent=2)
 
@@ -531,11 +535,20 @@ class FFModel:
         # only_data_parallel drops all overrides (reference: model.cc:2638)
         if self.config.only_data_parallel:
             strat = {}
-        # write merged strategies back onto layers so export_strategy sees
-        # search/compile-supplied maps, not only builder-time overrides
-        for layer in self.layers:
-            if layer.name in strat:
-                layer.attrs["strategy"] = dict(strat[layer.name])
+        elif self.config.import_strategy_file:
+            # imported strategy replaces the search entirely (reference:
+            # --import-strategy, model.cc:3609)
+            strat.update(self.import_strategy(self.config.import_strategy_file))
+        elif self.config.search_budget != 0 and not strat:
+            # auto-parallelization search (reference: the GRAPH_OPTIMIZE_TASK
+            # launched inside compile, model.cc:2824-2831). Unity DP by
+            # default; config.search_method="mcmc" selects the MLSys'19
+            # annealing fallback bounded by search_budget/search_alpha.
+            # Explicit per-layer strategies (builder overrides) win over
+            # search. Results are kept off layer.attrs so a re-compile
+            # after a config change re-runs the search.
+            strat, mesh = self._run_search(mesh)
+            self._search_strategies = dict(strat)
         self.compiled = compile_model(
             self.config,
             self.layers,
@@ -562,6 +575,66 @@ class FFModel:
                 )
                 op.layer.weights.append(p)
                 self._param_index[p.tensor_id] = (op.name, ws.name)
+
+    def _run_search(self, mesh):
+        """Run the auto-parallelization search (reference: §2.5 — Unity DP
+        by default via ``graph_optimize``; ``config.search_method="mcmc"``
+        selects the MLSys'19 annealing path bounded by
+        ``search_budget``/``search_alpha``). Returns (strategies, mesh)."""
+        from ..search.mcmc import mcmc_optimize
+        from ..search.unity import full_search, graph_optimize
+        from ..sim import OpCostModel, Simulator, detect_machine_model
+        from ..core.machine import mesh_axis_sizes
+        from ..core.parallel_tensor import ParallelDim, ParallelTensorShape
+
+        inputs = self._used_inputs()
+        use_mcmc = getattr(self.config, "search_method", "unity") == "mcmc"
+        if mesh is not None or self.config.mesh_shape:
+            # mesh pinned by the user: search strategies on it only
+            if mesh is None:
+                mesh = make_mesh(self.config.mesh_shape)
+            axis_sizes = mesh_axis_sizes(mesh)
+            machine = detect_machine_model(mesh.devices.size)
+            sim = Simulator(machine, OpCostModel(machine))
+            data_deg = axis_sizes.get("data", 1)
+            input_pshapes = {}
+            for t in inputs:
+                dims = [
+                    ParallelDim(s, data_deg, "data")
+                    if i == 0 and data_deg > 1 and s % data_deg == 0
+                    else ParallelDim(s)
+                    for i, s in enumerate(t.dims)
+                ]
+                input_pshapes[t.tensor_id] = ParallelTensorShape(tuple(dims), t.dtype)
+            if use_mcmc:
+                result = mcmc_optimize(
+                    self.layers, input_pshapes, axis_sizes, sim, self.config,
+                    seed=self.config.seed,
+                )
+            else:
+                result = graph_optimize(
+                    self.layers, input_pshapes, axis_sizes, sim, self.config,
+                    beam_width=max(self.config.base_optimize_threshold, 8),
+                )
+        else:
+            machine = detect_machine_model()
+            result = full_search(
+                self.layers, inputs, machine, self.config,
+                beam_width=max(self.config.base_optimize_threshold, 8),
+            )
+            self.config.mesh_shape = result.mesh_shape
+            mesh = make_mesh(result.mesh_shape)
+        self.search_result = result
+        if self.config.profiling:
+            print(
+                f"[search] mesh={result.mesh_shape} est_step={result.est_step_time*1e3:.3f}ms "
+                f"mem={result.est_memory/2**20:.1f}MiB states={result.states_explored}",
+                flush=True,
+            )
+        if self.config.export_strategy_file:
+            self._search_strategies = dict(result.strategies)
+            self.export_strategy(self.config.export_strategy_file)
+        return result.strategies, mesh
 
     def _used_inputs(self) -> List[Tensor]:
         used = set()

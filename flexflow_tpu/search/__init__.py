@@ -1,0 +1,31 @@
+"""Auto-parallelization search.
+
+TPU-native equivalent of the reference's two search generations
+(SURVEY.md §2.5):
+
+* Unity DP search (``Graph::graph_optimize_task`` src/runtime/graph.cc:2046,
+  ``GraphSearchHelper`` + ``GraphXfer`` substitutions
+  src/runtime/substitution.cc) → :mod:`.unity` — dynamic programming over
+  the layer graph with frontier-sharding memoization, candidates generated
+  by the substitution library in :mod:`.substitution`.
+* Legacy MCMC search (``FFModel::mcmc_optimize`` model.cc:3286) →
+  :mod:`.mcmc` — simulated annealing over per-op strategies.
+
+Both are driven by the simulator (:mod:`..sim`) exactly as the reference's
+are, and both emit a plain per-layer strategy dict the compiler consumes —
+the analog of the serialized PCG + machine views the reference ships back
+from its search task.
+"""
+
+from .substitution import candidate_strategies, load_substitution_json
+from .unity import GraphSearchResult, enumerate_mesh_shapes, graph_optimize
+from .mcmc import mcmc_optimize
+
+__all__ = [
+    "candidate_strategies",
+    "load_substitution_json",
+    "GraphSearchResult",
+    "enumerate_mesh_shapes",
+    "graph_optimize",
+    "mcmc_optimize",
+]

@@ -1,0 +1,124 @@
+"""The substitution library: per-op parallelization candidates.
+
+TPU-native equivalent of the reference's graph-substitution generators
+(reference: ``generate_all_pcg_xfers`` src/runtime/substitution.cc:1726-1869
+and the JSON rule loader src/runtime/substitution_loader.cc).
+
+Translation: a reference substitution rewrites the PCG — e.g.
+*partition-linear-combine* inserts ``Repartition(in-dim) → Linear →
+Combine`` around a dense layer (substitution.cc:77-108). Under GSPMD the
+Partition/Combine halves are implicit resharding, so each xfer collapses to
+a **strategy assignment** on the compute op itself:
+
+| reference xfer (substitution.cc)            | strategy here            |
+|---------------------------------------------|--------------------------|
+| create_partition_linear_combine (:77)       | Linear {"in": axis}      |
+| create_replicate_linear_combine (:1756)     | Linear {"out": axis}     |
+| create_partition_attention_combine (:87)    | Attention {"heads": axis}|
+| create_replicate_attention_reduce (:1763)   | Attention {"heads": axis} (grad path differs only in GSPMD-chosen collective) |
+| embedding vocab partition (DLRM pattern)    | Embedding {"vocab": axis}|
+| data-parallel partition on batch (:1726)    | {} (batch dim inherited) |
+| conv2d channel partition (OptCNN patterns)  | Conv2D {"out_channels": axis} |
+| sequence-dim partition (absent in reference, SURVEY §5) | Attention {"seq": axis} |
+
+Custom rules can still be loaded from JSON (the reference's
+``--substitution-json`` path): a rule maps an op-type name to extra
+strategy dicts.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+from ..ffconst import OpType
+from ..config import FFConfig
+from ..core.layer import Layer
+
+# extra rules loaded from JSON: op-type name -> list of strategy templates,
+# each value either a literal axis name or "$model"/"$data"/... placeholders
+_JSON_RULES: Dict[str, List[Dict[str, str]]] = {}
+
+
+def load_substitution_json(path: str) -> int:
+    """Load extra candidate rules (reference: substitution_loader.cc:78,
+    ``--substitution-json-path``). Returns number of rules loaded."""
+    with open(path) as f:
+        data = json.load(f)
+    n = 0
+    for op_name, cands in data.get("rules", {}).items():
+        _JSON_RULES.setdefault(op_name, []).extend(cands)
+        n += len(cands)
+    return n
+
+
+def _expand(template: Dict[str, str], axis_sizes: Dict[str, int]) -> Optional[Dict[str, str]]:
+    out = {}
+    for k, v in template.items():
+        if isinstance(v, str) and v.startswith("$"):
+            axis = v[1:]
+            if axis_sizes.get(axis, 1) <= 1:
+                return None
+            v = axis
+        out[k] = v
+    return out
+
+
+def candidate_strategies(
+    layer: Layer,
+    axis_sizes: Dict[str, int],
+    config: Optional[FFConfig] = None,
+) -> List[Dict[str, str]]:
+    """All parallelization candidates for one layer on the given mesh.
+
+    The first candidate is always ``{}`` (pure inherited/data parallelism —
+    the reference's default partition-on-batch xfer). Gating flags mirror
+    ``--enable-parameter-parallel`` / ``--enable-attribute-parallel``
+    (model.cc:3623-3627); both default on here because the search itself
+    decides profitability.
+    """
+    param_ok = config is None or config.enable_parameter_parallel or config.search_budget != 0
+    attr_ok = config is None or config.enable_attribute_parallel or config.search_budget != 0
+
+    cands: List[Dict[str, str]] = [{}]
+    model_axes = [
+        a for a, n in axis_sizes.items() if n > 1 and a not in ("data", "pipe")
+    ]
+    t = layer.op_type
+    if t is OpType.LINEAR and param_ok:
+        out_dim = layer.attrs.get("out_dim", 0)
+        in_dim = layer.inputs[0].dims[-1] if layer.inputs else 0
+        for a in model_axes:
+            n = axis_sizes[a]
+            if out_dim % n == 0:
+                cands.append({"out": a})
+            if in_dim % n == 0:
+                cands.append({"in": a})
+    elif t is OpType.MULTIHEAD_ATTENTION and attr_ok:
+        heads = layer.attrs.get("num_heads", 0)
+        for a in model_axes:
+            if heads % axis_sizes[a] == 0:
+                cands.append({"heads": a})
+        seq_deg = axis_sizes.get("seq", 1)
+        if seq_deg > 1:
+            cands.append({"seq": "seq"})
+    elif t is OpType.EMBEDDING and param_ok:
+        vocab = layer.attrs.get("num_entries", 0)
+        out_dim = layer.attrs.get("out_dim", 0)
+        for a in model_axes:
+            n = axis_sizes[a]
+            if vocab % n == 0:
+                cands.append({"vocab": a})
+            if out_dim % n == 0:
+                cands.append({"out": a})
+    elif t is OpType.CONV2D and param_ok:
+        out_c = layer.attrs.get("out_channels", 0)
+        for a in model_axes:
+            if out_c % axis_sizes[a] == 0:
+                cands.append({"out_channels": a})
+
+    for template in _JSON_RULES.get(t.name, []):
+        c = _expand(template, axis_sizes)
+        if c is not None and c not in cands:
+            cands.append(c)
+    return cands

@@ -1,0 +1,241 @@
+"""Unity-style DP search over per-op parallelization strategies.
+
+TPU-native equivalent of the reference's default search path
+(reference: ``GraphSearchHelper::graph_optimize`` substitution.cc:1898,
+``generic_sequence_optimize`` recursive split DP substitution.h:279,
+``SearchHelper::graph_cost`` DP graph.h:174-196 with ``dp_state_hash``
+memoization graph.h:149, machine-view enumeration
+``register_all_machine_views`` graph.cc:2329).
+
+Translation of the algorithm, not the code:
+
+* The reference recursively splits the graph at dominator bottlenecks and
+  memoizes subproblems by (graph-hash, input/output machine view). Here the
+  DP walks the layer list topologically carrying a **frontier signature** —
+  the sharding of every tensor still live (needed by a later layer). Two
+  partial assignments with equal frontiers are interchangeable for the
+  future, so only the cheaper survives: that IS the bottleneck-split
+  memoization, at per-layer granularity (every layer is a split point, not
+  just dominators, because our state is cheap to hash).
+* Candidate enumeration per layer comes from the substitution library
+  (:mod:`.substitution`), playing GraphXfer generation.
+* Machine-view enumeration over device counts becomes mesh-shape
+  enumeration (:func:`enumerate_mesh_shapes`).
+* ``base_optimize_threshold`` → ``beam_width``: frontier states kept per
+  layer (the reference bounds its best-first queue the same way,
+  config.h:156).
+* The memory-aware variant (graph_optimize_with_memory, graph.cc:2056)
+  becomes a hard HBM-capacity prune on states plus a per-byte penalty.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..config import FFConfig
+from ..core.layer import Layer
+from ..core.op import create_op
+from ..core.parallel_tensor import ParallelDim, ParallelTensorShape
+from ..core.tensor import Tensor
+from ..sim.cost_model import OpCostModel, _pshape_local_bytes
+from ..sim.machine_model import MachineModel
+from ..sim.simulator import Simulator
+from .substitution import candidate_strategies
+
+
+@dataclasses.dataclass
+class GraphSearchResult:
+    strategies: Dict[str, Dict[str, str]]
+    mesh_shape: Dict[str, int]
+    est_step_time: float
+    est_memory: int
+    states_explored: int = 0
+
+
+def _ps_sig(ps: ParallelTensorShape) -> Tuple:
+    return tuple((d.degree, d.axis) for d in ps.dims) + tuple(sorted(ps.replica_axes))
+
+
+@dataclasses.dataclass
+class _State:
+    cost: float
+    weight_mem: int
+    act_mem: int
+    pshapes: Dict[int, ParallelTensorShape]
+    strategies: Dict[str, Dict[str, str]]
+
+    @property
+    def memory(self) -> int:
+        return self.weight_mem + self.act_mem
+
+
+def graph_optimize(
+    layers: List[Layer],
+    input_pshapes: Dict[int, ParallelTensorShape],
+    axis_sizes: Dict[str, int],
+    simulator: Simulator,
+    config: Optional[FFConfig] = None,
+    beam_width: int = 64,
+) -> GraphSearchResult:
+    """DP over the layer graph for one fixed mesh shape.
+
+    reference: Graph::graph_optimize_task → optimal strategies + views
+    (graph.cc:2046-2327). Returns the best per-layer strategy dict.
+    """
+    # consumer bookkeeping to compute live frontiers
+    last_use: Dict[int, int] = {}
+    for li, layer in enumerate(layers):
+        for t in layer.inputs:
+            last_use[t.tensor_id] = li
+
+    memory_cap = simulator.machine.chip.hbm_capacity
+    cm = simulator.cost_model
+
+    states: Dict[Tuple, _State] = {
+        (): _State(0.0, 0, 0, dict(input_pshapes), {})
+    }
+    explored = 0
+    for li, layer in enumerate(layers):
+        cands = candidate_strategies(layer, axis_sizes, config)
+        nxt: Dict[Tuple, _State] = {}
+        for st in states.values():
+            in_shapes = [st.pshapes[t.tensor_id] for t in layer.inputs]
+            for cand in cands:
+                explored += 1
+                op = create_op(layer, in_shapes)
+                strategy = dict(cand)
+                strategy["_axis_sizes"] = axis_sizes
+                op.axis_sizes = dict(axis_sizes)
+                try:
+                    out_shapes, weight_shapes = op.propagate(in_shapes, strategy)
+                except Exception:
+                    continue
+                op.output_shapes = out_shapes
+                op.weight_shapes = weight_shapes
+                c = cm.measure(op)
+                comm = simulator._comm_time(op, False) + simulator._comm_time(op, True)
+                step = c.forward_time + c.backward_time + c.sync_time + comm
+                new_w = st.weight_mem + c.weights_memory
+                new_a = st.act_mem + c.outputs_memory
+                # full footprint = weights + optimizer states + activations
+                # (same accounting as Simulator.memory_usage, so the DP and
+                # fits_memory can never disagree; graph.cc:2056 hard bound)
+                footprint = (
+                    new_w * (1.0 + simulator.optimizer_state_mult) + new_a
+                )
+                if footprint > memory_cap:
+                    continue
+                pshapes = dict(st.pshapes)
+                for t, ps in zip(layer.outputs, out_shapes):
+                    pshapes[t.tensor_id] = ps
+                # frontier: tensors any later layer still reads
+                live = tuple(
+                    _ps_sig(pshapes[tid])
+                    for tid in sorted(pshapes)
+                    if last_use.get(tid, -1) > li
+                )
+                cand_state = _State(
+                    st.cost + step,
+                    new_w,
+                    new_a,
+                    pshapes,
+                    {**st.strategies, layer.name: dict(cand)},
+                )
+                old = nxt.get(live)
+                if old is None or cand_state.cost < old.cost:
+                    nxt[live] = cand_state
+        if not nxt:
+            raise RuntimeError(f"search dead-ended at layer {layer.name}")
+        # beam prune (reference: base_optimize_threshold bound)
+        if len(nxt) > beam_width:
+            nxt = dict(
+                sorted(nxt.items(), key=lambda kv: kv[1].cost)[:beam_width]
+            )
+        states = nxt
+
+    best = min(states.values(), key=lambda s: s.cost)
+    footprint = int(
+        best.weight_mem * (1.0 + simulator.optimizer_state_mult) + best.act_mem
+    )
+    return GraphSearchResult(
+        best.strategies, dict(axis_sizes), best.cost, footprint, explored
+    )
+
+
+def enumerate_mesh_shapes(
+    n_devices: int,
+    has_moe: bool = False,
+    has_attention: bool = False,
+) -> List[Dict[str, int]]:
+    """Candidate mesh layouts (reference: register_all_machine_views
+    graph.cc:2329 — 1-D views over every divisor of the GPU count; here 2-D
+    named meshes {data×model} plus expert/seq axes when the graph can use
+    them)."""
+    shapes: List[Dict[str, int]] = []
+    for d in range(1, n_devices + 1):
+        if n_devices % d != 0:
+            continue
+        m = n_devices // d
+        shape: Dict[str, int] = {}
+        if d > 1 or m == 1:
+            shape["data"] = d
+        if m > 1:
+            shape["model"] = m
+        shapes.append(shape or {"data": 1})
+        if has_moe and m > 1:
+            shapes.append({"expert": m} if d == 1 else {"data": d, "expert": m})
+        if has_attention and m > 1:
+            shapes.append({"seq": m} if d == 1 else {"data": d, "seq": m})
+    # dedup, preserve order
+    seen, out = set(), []
+    for s in shapes:
+        key = tuple(sorted(s.items()))
+        if key not in seen:
+            seen.add(key)
+            out.append(s)
+    return out
+
+
+def full_search(
+    layers: List[Layer],
+    input_tensors: Sequence[Tensor],
+    machine: MachineModel,
+    config: Optional[FFConfig] = None,
+    beam_width: int = 64,
+    mesh_shapes: Optional[List[Dict[str, int]]] = None,
+) -> GraphSearchResult:
+    """Outer loop over mesh shapes × inner DP (reference: the top-level
+    try_one_lambda / machine-mapping enumeration in graph_optimize_task)."""
+    from ..ffconst import OpType
+
+    n = machine.num_devices()
+    if mesh_shapes is None:
+        has_moe = any(l.op_type is OpType.GROUP_BY for l in layers)
+        has_attn = any(l.op_type is OpType.MULTIHEAD_ATTENTION for l in layers)
+        mesh_shapes = enumerate_mesh_shapes(n, has_moe, has_attn)
+    best: Optional[GraphSearchResult] = None
+    for shape in mesh_shapes:
+        axis_sizes = dict(shape)
+        sim = Simulator(machine, OpCostModel(machine))
+        input_pshapes = {}
+        data_deg = axis_sizes.get("data", 1)
+        for t in input_tensors:
+            dims = []
+            for i, s in enumerate(t.dims):
+                if i == 0 and data_deg > 1 and s % data_deg == 0:
+                    dims.append(ParallelDim(s, data_deg, "data"))
+                else:
+                    dims.append(ParallelDim(s))
+            input_pshapes[t.tensor_id] = ParallelTensorShape(tuple(dims), t.dtype)
+        try:
+            r = graph_optimize(
+                layers, input_pshapes, axis_sizes, sim, config, beam_width
+            )
+        except RuntimeError:
+            continue
+        if best is None or r.est_step_time < best.est_step_time:
+            best = r
+    if best is None:
+        raise RuntimeError("no feasible mesh/strategy found")
+    return best

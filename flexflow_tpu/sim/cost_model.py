@@ -125,21 +125,41 @@ class OpCostModel:
         w_bytes = sum(_pshape_local_bytes(p) for p in op.weight_shapes.values())
 
         # per-device flops: total flops divided by every distinct mesh axis
-        # that partitions the computation — output-sharding axes AND
-        # contraction axes (an input/weight dim sharded on an axis absent
-        # from the output splits the reduction; each device computes a
-        # partial sum of full output shape but over 1/degree of the work).
+        # that genuinely partitions the computation:
+        #   * axes sharding an output dim (each device produces its shard);
+        #   * axes sharding a weight dim (XLA reshards the small activation
+        #     to match the weight rather than gathering the weight);
+        #   * a contraction axis ONLY when input and weight shardings match
+        #     (sharded contraction → partial sums). A contraction dim
+        #     sharded on the input but NOT on the weight is all-gathered
+        #     (charged by the simulator's comm model) and every device then
+        #     does the FULL computation — no credit.
         # Replication re-does work: replica axes give no credit.
         total_flops = float(op.flops())
         axis_deg: Dict[str, int] = {}
+        mismatched: set = set()
+        for ii, dim, wname, wdim in op.input_contraction_dims():
+            ips = op.input_shapes[ii]
+            d = ips.dims[dim % len(ips.dims)]
+            if not d.is_partitioned:
+                continue
+            w = op.weight_shapes.get(wname) if wname else None
+            if w is not None and w.dims[wdim].axis == d.axis:
+                axis_deg[d.axis] = max(axis_deg.get(d.axis, 1), d.degree)
+            else:
+                mismatched.add((ii, dim % len(ips.dims)))
         for ps in op.output_shapes:
             for d in ps.dims:
                 if d.is_partitioned:
                     axis_deg[d.axis] = max(axis_deg.get(d.axis, 1), d.degree)
-        for ps in list(op.input_shapes) + list(op.weight_shapes.values()):
+        for ps in op.weight_shapes.values():
             for d in ps.dims:
                 if d.is_partitioned:
                     axis_deg[d.axis] = max(axis_deg.get(d.axis, 1), d.degree)
+        for ii, ips in enumerate(op.input_shapes):
+            for di, d in enumerate(ips.dims):
+                if d.is_partitioned and (ii, di) not in mismatched:
+                    axis_deg.setdefault(d.axis, d.degree)
         parts = 1
         for deg in axis_deg.values():
             parts *= deg

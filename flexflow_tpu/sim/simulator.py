@@ -135,11 +135,40 @@ class Simulator:
             local = _pshape_local_bytes(in0)
             return m.allreduce_time(local, deg, axis or "") if not backward else 0.0
 
-        # compute op: charge contracted-axis all-reduces
-        colls, out_bytes = _collective_axes(op)
+        # compute op: explicit contraction structure first (Linear/Conv/…)
+        out_bytes = sum(_pshape_local_bytes(p) for p in op.output_shapes)
+        out_axes = {
+            d.axis for ps in op.output_shapes for d in ps.dims if d.is_partitioned
+        }
         time = 0.0
+        handled = set()
+        for ii, dim, wname, wdim in op.input_contraction_dims():
+            ips = op.input_shapes[ii]
+            d = ips.dims[dim % len(ips.dims)]
+            if not d.is_partitioned:
+                continue
+            handled.add(d.axis)
+            w = op.weight_shapes.get(wname) if wname else None
+            if w is not None and w.dims[wdim].axis == d.axis:
+                # sharded contraction → partial sums. Reduce-scatter if the
+                # output stays sharded on this axis, else full all-reduce
+                # (the partition-linear-combine Reduction, substitution.cc:77)
+                if d.axis in out_axes:
+                    time += m.reducescatter_time(out_bytes * d.degree, d.degree, d.axis)
+                else:
+                    time += m.allreduce_time(out_bytes, d.degree, d.axis)
+            else:
+                # contraction dim sharded but weight not sharded to match:
+                # XLA all-gathers the activation before the GEMM
+                time += m.allgather_time(_pshape_local_bytes(ips), d.degree, d.axis)
+        # generic fallback for axes the explicit structure didn't cover
+        # (e.g. embedding vocab partition): any axis sharding an input or
+        # weight dim but absent from the outputs leaves partial/partitioned
+        # state that must be reduced
+        colls, _ = _collective_axes(op)
         for axis, deg, kind in colls:
-            time += m.allreduce_time(out_bytes, deg, axis)
+            if axis not in handled:
+                time += m.allreduce_time(out_bytes, deg, axis)
         return time  # same magnitude both directions (transpose collective)
 
     # ------------------------------------------------------------ task graph

@@ -1,0 +1,135 @@
+"""Auto-parallelization search regression tests with the deterministic
+machine model (SURVEY.md §4: the reference has no search regression tests —
+we add them)."""
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import DataType, FFConfig, FFModel, LossType, MetricsType, SGDOptimizer
+from flexflow_tpu.core.parallel_tensor import ParallelDim, ParallelTensorShape
+from flexflow_tpu.search import graph_optimize, mcmc_optimize, candidate_strategies
+from flexflow_tpu.search.unity import enumerate_mesh_shapes, full_search
+from flexflow_tpu.sim import CHIP_PRESETS, OpCostModel, SimpleMachineModel, Simulator
+
+
+def _transformer_ish(B=64, D=128, H=8, layers=2):
+    ff = FFModel(FFConfig(batch_size=B))
+    x = ff.create_tensor((B, 16, D), DataType.FLOAT, name="x")
+    h = x
+    for i in range(layers):
+        a = ff.multihead_attention(h, h, h, D, H, name=f"attn{i}")
+        h = ff.add(a, h, name=f"res{i}")
+        f = ff.dense(h, 4 * D, name=f"ff{i}_up")
+        f = ff.dense(f, D, name=f"ff{i}_down")
+        h = ff.add(f, h, name=f"res{i}b")
+    return ff, x
+
+
+def _input_ps(t, data_deg):
+    dims = [
+        ParallelDim(s, data_deg, "data") if i == 0 and data_deg > 1 else ParallelDim(s)
+        for i, s in enumerate(t.dims)
+    ]
+    return {t.tensor_id: ParallelTensorShape(tuple(dims), t.dtype)}
+
+
+def test_candidate_strategies_linear():
+    ff = FFModel(FFConfig(batch_size=8))
+    x = ff.create_tensor((8, 64), DataType.FLOAT, name="x")
+    ff.dense(x, 128, name="fc")
+    layer = ff.layers[0]
+    cands = candidate_strategies(layer, {"data": 2, "model": 4})
+    assert {} in cands
+    assert {"out": "model"} in cands
+    assert {"in": "model"} in cands
+    # indivisible degree is filtered
+    cands3 = candidate_strategies(layer, {"model": 3})
+    assert cands3 == [{}]
+
+
+def test_graph_optimize_runs_and_memoizes():
+    ff, x = _transformer_ish()
+    machine = SimpleMachineModel(CHIP_PRESETS["test"], 8)
+    sim = Simulator(machine, OpCostModel(machine))
+    axis = {"data": 2, "model": 4}
+    r = graph_optimize(ff.layers, _input_ps(x, 2), axis, sim, beam_width=16)
+    assert r.est_step_time > 0
+    assert r.est_memory > 0
+    # every layer got a decision (possibly {})
+    assert set(r.strategies) == {l.name for l in ff.layers}
+    # DP must explore more states than layers but stay bounded by beam
+    assert r.states_explored >= len(ff.layers)
+
+
+def test_search_beats_or_matches_data_parallel():
+    """The searched strategy's simulated time must never exceed pure DP on
+    the same mesh — the Unity paper's core claim, and our BASELINE.md
+    metric."""
+    ff, x = _transformer_ish(B=32, D=256, H=8)
+    machine = SimpleMachineModel(CHIP_PRESETS["test"], 8)
+    sim = Simulator(machine, OpCostModel(machine))
+    axis = {"data": 2, "model": 4}
+    from flexflow_tpu.runtime.compiler import build_ops
+
+    r = graph_optimize(ff.layers, _input_ps(x, 2), axis, sim, beam_width=32)
+    ops_dp, _ = build_ops(ff.layers, _input_ps(x, 2), axis, {})
+    ops_best, _ = build_ops(ff.layers, _input_ps(x, 2), axis, r.strategies)
+    t_dp = sim.simulate_runtime(ops_dp)
+    t_best = sim.simulate_runtime(ops_best)
+    assert t_best <= t_dp + 1e-12
+
+
+def test_enumerate_mesh_shapes():
+    shapes = enumerate_mesh_shapes(8)
+    assert {"data": 8} in shapes
+    assert {"model": 8} in shapes
+    assert {"data": 2, "model": 4} in shapes
+    assert {"data": 4, "model": 2} in shapes
+    with_moe = enumerate_mesh_shapes(8, has_moe=True)
+    assert {"data": 2, "expert": 4} in with_moe
+
+
+def test_full_search_picks_a_mesh():
+    ff, x = _transformer_ish(B=64, D=128)
+    machine = SimpleMachineModel(CHIP_PRESETS["test"], 8)
+    r = full_search(ff.layers, [x], machine, beam_width=8)
+    n = 1
+    for v in r.mesh_shape.values():
+        n *= v
+    assert n == 8
+    assert r.est_step_time > 0
+
+
+def test_mcmc_never_worse_than_start():
+    ff, x = _transformer_ish(B=32, D=128)
+    machine = SimpleMachineModel(CHIP_PRESETS["test"], 8)
+    sim = Simulator(machine, OpCostModel(machine))
+    axis = {"data": 2, "model": 4}
+    from flexflow_tpu.search.mcmc import _evaluate
+
+    start = _evaluate(ff.layers, _input_ps(x, 2), axis, {}, sim)
+    r = mcmc_optimize(
+        ff.layers, _input_ps(x, 2), axis, sim, budget=60, seed=1
+    )
+    assert r.est_step_time <= start + 1e-12
+
+
+def test_compile_with_search_end_to_end():
+    """search_budget triggers the search inside compile; the model still
+    trains (hermetic 8-device CPU mesh)."""
+    cfg = FFConfig(batch_size=32, search_budget=1, mesh_shape={"data": 2, "model": 4})
+    ff = FFModel(cfg)
+    x = ff.create_tensor((32, 64), DataType.FLOAT, name="x")
+    h = ff.dense(x, 128, name="fc1")
+    h = ff.relu(h)
+    logits = ff.dense(h, 8, name="fc2")
+    ff.compile(
+        SGDOptimizer(ff, 0.05),
+        LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        [MetricsType.ACCURACY],
+    )
+    assert ff.search_result is not None
+    X = np.random.default_rng(0).normal(size=(64, 64)).astype(np.float32)
+    Y = np.random.default_rng(1).integers(0, 8, size=(64, 1)).astype(np.int32)
+    hist = ff.fit(X, Y, epochs=1, verbose=False)
+    assert len(hist) == 1
