@@ -1,0 +1,51 @@
+"""Pallas TPU kernels for the hot ops XLA fuses poorly.
+
+The reference implements these as handwritten CUDA kernels (SURVEY.md §2.2:
+attention.cu, group_by.cu, aggregate.cu); here they are Pallas TPU kernels
+that keep the working set in VMEM and feed the MXU directly:
+
+* :mod:`flash_attention` — fused scaled-dot-product attention that never
+  materializes the (S, S) logits in HBM (reference: src/ops/attention.cu
+  uses cuDNN MultiHeadAttn for the same reason).
+* :mod:`moe_kernels` — row gather / weighted row-gather-sum with
+  scalar-prefetched indices, realizing the MoE dispatch/combine data
+  movement (reference: src/ops/group_by.cu, aggregate.cu scatter kernels)
+  without one-hot matmuls.
+
+Dispatch policy: kernels engage automatically on TPU backends; on CPU the
+jnp reference paths run instead (identical math). ``FLEXFLOW_TPU_PALLAS``
+overrides: ``off`` disables kernels everywhere, ``interpret`` runs them in
+the Pallas interpreter (used by the hermetic CPU test suite to validate
+kernel numerics).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def pallas_mode() -> str | None:
+    """Returns ``"compiled"``, ``"interpret"``, or None (kernels disabled)."""
+    v = os.environ.get("FLEXFLOW_TPU_PALLAS", "auto")
+    if v == "off":
+        return None
+    if v == "interpret":
+        return "interpret"
+    if v == "compiled" or jax.default_backend() == "tpu":
+        return "compiled"
+    return None
+
+
+def interpret_flag() -> bool:
+    return pallas_mode() == "interpret"
+
+
+def use_pallas(ctx) -> bool:
+    """Shared op-level gate: Pallas kernels engage on single-device
+    lowerings only; multi-device meshes keep the jnp paths, which GSPMD
+    partitions (a pallas_call there would need shard_map wrapping)."""
+    return pallas_mode() is not None and (
+        getattr(ctx, "mesh", None) is None or ctx.mesh.size == 1
+    )

@@ -1,0 +1,216 @@
+"""Fused (flash-style) attention as a Pallas TPU kernel.
+
+Replaces the reference's cuDNN MultiHeadAttn device path
+(reference: src/ops/attention.cu:35-128) with a TPU kernel that tiles
+queries into ``block_q`` rows, holds K/V for one (batch, head) in VMEM, and
+computes softmax(QKᵀ)V per tile without ever writing the (S, S) logits to
+HBM. The backward pass is the standard two-kernel flash recomputation
+(dq over q-tiles; dk/dv over k-tiles) using the saved log-sum-exp.
+
+Layout: public entry takes (B, S, H, D) — the framework's bshd convention
+(ops/attention.py) — and transposes to (B*H, S, D) for the kernel grid.
+Compute is float32 on the MXU regardless of input dtype; outputs are cast
+back.
+
+VMEM budget: one (S, D) K/V panel plus a (block_q, S) logits tile; fits
+~16 MB VMEM for S·D ≤ ~1M, i.e. any shape short enough not to want ring
+attention (parallel/ring_attention.py) anyway.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from . import pallas_mode
+
+NEG_INF = -1e30  # large-negative instead of -inf: keeps exp()/max() NaN-free
+
+
+def _causal_mask(block_q: int, skv: int, q_offset):
+    qpos = q_offset + jax.lax.broadcasted_iota(jnp.int32, (block_q, skv), 0)
+    kpos = jax.lax.broadcasted_iota(jnp.int32, (block_q, skv), 1)
+    return qpos >= kpos
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_q):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale           # (block_q, D)
+    k = k_ref[0].astype(jnp.float32)                   # (Skv, D)
+    v = v_ref[0].astype(jnp.float32)
+    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # (block_q, Skv)
+    if causal:
+        s = jnp.where(_causal_mask(block_q, k.shape[0], qi * block_q), s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.dot(p, v, preferred_element_type=jnp.float32) / l
+    o_ref[0] = o.astype(o_ref.dtype)
+    lse_ref[0, 0] = (m + jnp.log(l))[:, 0]
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, o_ref, g_ref, lse_ref, dq_ref,
+               *, scale, causal, block_q):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    g = g_ref[0].astype(jnp.float32)
+    o = o_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, 0]                                 # (block_q,)
+    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+    if causal:
+        s = jnp.where(_causal_mask(block_q, k.shape[0], qi * block_q), s, NEG_INF)
+    p = jnp.exp(s - lse[:, None])                       # softmax probabilities
+    dp = jnp.dot(g, v.T, preferred_element_type=jnp.float32)
+    delta = jnp.sum(g * o, axis=-1, keepdims=True)      # rowsum(dO ∘ O)
+    ds = p * (dp - delta)
+    dq_ref[0] = (jnp.dot(ds, k, preferred_element_type=jnp.float32) * scale
+                 ).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, o_ref, g_ref, lse_ref, dk_ref, dv_ref,
+                *, scale, causal, block_k):
+    ki = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale            # (Sq, D)
+    k = k_ref[0].astype(jnp.float32)                    # (block_k, D)
+    v = v_ref[0].astype(jnp.float32)
+    g = g_ref[0].astype(jnp.float32)
+    o = o_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, 0]                                 # (Sq,)
+    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # (Sq, block_k)
+    if causal:
+        sq = q.shape[0]
+        qpos = jax.lax.broadcasted_iota(jnp.int32, (sq, block_k), 0)
+        kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (sq, block_k), 1)
+        s = jnp.where(qpos >= kpos, s, NEG_INF)
+    p = jnp.exp(s - lse[:, None])
+    dv_ref[0] = jnp.dot(p.T, g, preferred_element_type=jnp.float32).astype(dv_ref.dtype)
+    dp = jnp.dot(g, v.T, preferred_element_type=jnp.float32)
+    delta = jnp.sum(g * o, axis=-1, keepdims=True)
+    ds = p * (dp - delta)
+    dk_ref[0] = (jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+                 ).astype(dk_ref.dtype)  # q already carries `scale`
+
+
+def _pick_block(s: int, pref: int) -> Optional[int]:
+    for b in (pref, 256, 128, 64, 32, 16, 8):
+        if b <= s and s % b == 0:
+            return b
+    return None
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, scale, block_q, interpret):
+    out, _ = _flash_fwd(q, k, v, causal, scale, block_q, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, interpret):
+    bh, sq, d = q.shape
+    skv = k.shape[1]
+    grid = (bh, sq // block_q)
+    qspec = pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0))
+    kvspec = pl.BlockSpec((1, skv, d), lambda b, i: (b, 0, 0))
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal, block_q=block_q),
+        grid=grid,
+        in_specs=[qspec, kvspec, kvspec],
+        out_specs=[qspec, pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i))],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, 1, sq), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, scale, block_q, interpret, res, g):
+    q, k, v, out, lse = res
+    bh, sq, d = q.shape
+    skv = k.shape[1]
+    block_k = _pick_block(skv, block_q)
+    qspec = pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0))
+    kvfull = pl.BlockSpec((1, skv, d), lambda b, i: (b, 0, 0))
+    lspec = pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i))
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal, block_q=block_q),
+        grid=(bh, sq // block_q),
+        in_specs=[qspec, kvfull, kvfull, qspec, qspec, lspec],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        interpret=interpret,
+    )(q, k, v, out, g, lse)
+    qfull = pl.BlockSpec((1, sq, d), lambda b, i: (b, 0, 0))
+    kspec = pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0))
+    lfull = pl.BlockSpec((1, 1, sq), lambda b, i: (b, 0, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal, block_k=block_k),
+        grid=(bh, skv // block_k),
+        in_specs=[qfull, kspec, kspec, qfull, qfull, lfull],
+        out_specs=[kspec, kspec],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, skv, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, skv, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(q, k, v, out, g, lse)
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+VMEM_BUDGET_BYTES = 12 * 1024 * 1024  # leave headroom under the ~16 MB core
+
+
+def supported(q_shape, k_shape, causal_ok: bool = True) -> bool:
+    """Whether the kernel path handles these (B, S, H, D) shapes.
+
+    Checks block divisibility and the VMEM working set (K/V panels +
+    per-tile q/o/g and logits, float32); longer sequences fall back to the
+    jnp path / ring attention rather than failing at Mosaic compile.
+    """
+    if pallas_mode() is None:
+        return False
+    sq, skv = q_shape[1], k_shape[1]
+    d = q_shape[3]
+    bq = _pick_block(sq, 128)
+    bk = _pick_block(skv, 128)
+    if bq is None or bk is None:
+        return False
+    # worst case is the dkv backward: full q/g/o panels + one k/v tile +
+    # the (sq, block_k) logits tile, all float32
+    working = 4 * (3 * sq * d + 2 * bk * d + 2 * sq * bk)
+    fwd = 4 * (2 * skv * d + 3 * bq * d + 2 * bq * skv)
+    return max(working, fwd) <= VMEM_BUDGET_BYTES
+
+
+def flash_attention(q, k, v, causal: bool = False,
+                    scale: Optional[float] = None,
+                    block_q: int = 128) -> jax.Array:
+    """Fused attention. q/k/v: (B, S, H, D) (framework bshd convention).
+
+    Differentiable (custom VJP). Caller is responsible for checking
+    :func:`supported` and falling back to
+    ``parallel.ring_attention.single_device_attention`` otherwise (e.g.
+    with attention dropout, which this kernel does not implement).
+    """
+    b, sq, h, d = q.shape
+    skv = k.shape[1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    bq = _pick_block(sq, block_q)
+    interpret = pallas_mode() == "interpret"
+    # (B, S, H, D) -> (B*H, S, D)
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * h, skv, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * h, skv, d)
+    ot = _flash(qt, kt, vt, causal, scale, bq, interpret)
+    return ot.reshape(b, h, sq, d).transpose(0, 2, 1, 3)

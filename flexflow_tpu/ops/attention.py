@@ -137,9 +137,18 @@ class MultiHeadAttention(Op):
                 dropout_rate=drop, rng=ctx.rng,
             )
         else:
-            ctxv = single_device_attention(
-                qh, kh, vh, self.causal, scale, drop, ctx.rng
-            )
+            from ..kernels import flash_attention as fa, use_pallas
+
+            if drop == 0.0 and use_pallas(ctx) and fa.supported(qh.shape, kh.shape):
+                # Pallas fused attention: (S,S) logits never touch HBM.
+                # Multi-device meshes keep the jnp path (GSPMD partitions
+                # the einsums; a pallas_call would need shard_map wrapping).
+                ctxv = fa.flash_attention(qh, kh, vh, causal=self.causal,
+                                          scale=scale)
+            else:
+                ctxv = single_device_attention(
+                    qh, kh, vh, self.causal, scale, drop, ctx.rng
+                )
         out = jnp.einsum("bqhd,hde->bqe", ctxv, weights["wo"])
         if self.use_bias:
             out = out + weights["bo"]

@@ -60,6 +60,12 @@ def expert_capacity(batch: int, k: int, n: int, alpha: float) -> int:
     return int(math.ceil(alpha * k / n * batch))
 
 
+def _use_pallas(ctx) -> bool:
+    from ..kernels import use_pallas
+
+    return use_pallas(ctx)
+
+
 def moe_dispatch_mask(assign: jnp.ndarray, n: int, capacity: int) -> jnp.ndarray:
     """Routing shared by GroupBy and Aggregate.
 
@@ -100,6 +106,11 @@ class GroupBy(Op):
 
     def forward(self, ctx, inputs, weights):
         x, assign = inputs
+        if _use_pallas(ctx):
+            from ..kernels.moe_kernels import moe_dispatch
+
+            rows = moe_dispatch(x, assign, self.n, self.capacity)  # (n,c,…)
+            return [rows[e] for e in range(self.n)]
         B = x.shape[0]
         xf = x.reshape(B, -1)
         # each sample is duplicated for each of its k expert picks
@@ -124,9 +135,14 @@ class _AggregateBase(Op):
         # (batch, out_dim) — reference: aggregate.cc:149-152
         return [((self.batch, self.out_dim), self.input_shapes[4].dtype)]
 
-    def _combine(self, gate_weights, assign, exp_preds):
-        dispatch = moe_dispatch_mask(assign, self.n, self.capacity)  # (T,n,c)
+    def _combine(self, gate_weights, assign, exp_preds, ctx=None):
         stacked = jnp.stack([p.reshape(self.capacity, -1) for p in exp_preds])  # (n,c,d)
+        if ctx is not None and _use_pallas(ctx):
+            from ..kernels.moe_kernels import moe_combine
+
+            return moe_combine(stacked, assign,
+                               gate_weights.reshape(self.batch, self.k))
+        dispatch = moe_dispatch_mask(assign, self.n, self.capacity)  # (T,n,c)
         combine = dispatch * gate_weights.reshape(-1)[:, None, None]
         out_flat = jnp.einsum("tnc,ncf->tf", combine, stacked)  # (T,d)
         return out_flat.reshape(self.batch, self.k, -1).sum(axis=1)
@@ -155,7 +171,7 @@ class Aggregate(_AggregateBase):
     def forward(self, ctx, inputs, weights):
         gate_preds, assign, _true_assign, full_gate = inputs[:4]
         exp_preds = inputs[4:]
-        out = self._combine(gate_preds, assign, exp_preds)
+        out = self._combine(gate_preds, assign, exp_preds, ctx)
         aux = self._balance_aux(full_gate, assign)
         if aux is not None and hasattr(ctx, "aux_losses") and ctx.aux_losses is not None:
             ctx.aux_losses.append(aux)
@@ -174,7 +190,7 @@ class AggregateSpec(_AggregateBase):
         gate_preds, assign, _true_assign, full_gate = inputs[:4]
         exp_preds = inputs[4:]
         uniform = jnp.full_like(gate_preds, 1.0 / self.k)
-        out = self._combine(uniform, assign, exp_preds)
+        out = self._combine(uniform, assign, exp_preds, ctx)
         aux = self._balance_aux(full_gate, assign)
         if aux is not None and hasattr(ctx, "aux_losses") and ctx.aux_losses is not None:
             ctx.aux_losses.append(aux)

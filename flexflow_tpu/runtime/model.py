@@ -548,7 +548,9 @@ class FFModel:
             # search. Results are kept off layer.attrs so a re-compile
             # after a config change re-runs the search.
             strat, mesh = self._run_search(mesh)
-            self._search_strategies = dict(strat)
+        # record the strategies actually in effect (search-found, imported,
+        # or compile(strategies=...)-supplied) so export_strategy sees them
+        self._search_strategies = dict(strat)
         self.compiled = compile_model(
             self.config,
             self.layers,
@@ -582,10 +584,10 @@ class FFModel:
         selects the MLSys'19 annealing path bounded by
         ``search_budget``/``search_alpha``). Returns (strategies, mesh)."""
         from ..search.mcmc import mcmc_optimize
-        from ..search.unity import full_search, graph_optimize
+        from ..search.unity import (data_parallel_input_pshapes, full_search,
+                                    graph_optimize)
         from ..sim import OpCostModel, Simulator, detect_machine_model
         from ..core.machine import mesh_axis_sizes
-        from ..core.parallel_tensor import ParallelDim, ParallelTensorShape
 
         inputs = self._used_inputs()
         use_mcmc = getattr(self.config, "search_method", "unity") == "mcmc"
@@ -596,16 +598,7 @@ class FFModel:
             axis_sizes = mesh_axis_sizes(mesh)
             machine = detect_machine_model(mesh.devices.size)
             sim = Simulator(machine, OpCostModel(machine))
-            data_deg = axis_sizes.get("data", 1)
-            input_pshapes = {}
-            for t in inputs:
-                dims = [
-                    ParallelDim(s, data_deg, "data")
-                    if i == 0 and data_deg > 1 and s % data_deg == 0
-                    else ParallelDim(s)
-                    for i, s in enumerate(t.dims)
-                ]
-                input_pshapes[t.tensor_id] = ParallelTensorShape(tuple(dims), t.dtype)
+            input_pshapes = data_parallel_input_pshapes(inputs, axis_sizes)
             if use_mcmc:
                 result = mcmc_optimize(
                     self.layers, input_pshapes, axis_sizes, sim, self.config,

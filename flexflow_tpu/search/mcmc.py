@@ -49,14 +49,16 @@ def mcmc_optimize(
     simulator: Simulator,
     config: Optional[FFConfig] = None,
     budget: int = 200,
-    alpha: float = 0.05,
+    alpha: float = 1.2,
     seed: int = 0,
 ) -> GraphSearchResult:
     """Simulated annealing; returns the best strategy assignment found.
 
     ``alpha`` matches the reference's acceptance sharpness (model.cc:3335:
-    accept if diff<0 else with prob exp(-alpha*diff), diff in simulated
-    time units — we scale to microseconds so defaults behave).
+    accept if diff<0 else with prob exp(-alpha*diff)). Our simulated costs
+    are seconds; diff is scaled to *milliseconds* so the reference's
+    default ``--search-alpha`` 1.2 (config.py:50) gives a sane acceptance
+    curve (a 1 ms/iter regression is accepted with p≈0.30).
     """
     if config is not None:
         budget = config.search_budget if config.search_budget > 0 else budget
@@ -78,9 +80,9 @@ def mcmc_optimize(
         proposal[layer.name] = rng.choice(cands)
         cost = _evaluate(layers, input_pshapes, axis_sizes, proposal, simulator)
         explored += 1
-        diff_us = (cost - cur_cost) * 1e6
+        diff_ms = (cost - cur_cost) * 1e3
         if cost < cur_cost or (
-            math.isfinite(diff_us) and rng.random() < math.exp(-alpha * diff_us)
+            math.isfinite(diff_ms) and rng.random() < math.exp(-alpha * diff_ms)
         ):
             current, cur_cost = proposal, cost
             if cur_cost < best_cost:

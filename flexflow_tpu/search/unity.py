@@ -38,7 +38,7 @@ from ..core.layer import Layer
 from ..core.op import create_op
 from ..core.parallel_tensor import ParallelDim, ParallelTensorShape
 from ..core.tensor import Tensor
-from ..sim.cost_model import OpCostModel, _pshape_local_bytes
+from ..sim.cost_model import OpCostModel
 from ..sim.machine_model import MachineModel
 from ..sim.simulator import Simulator
 from .substitution import candidate_strategies
@@ -197,6 +197,23 @@ def enumerate_mesh_shapes(
     return out
 
 
+def data_parallel_input_pshapes(input_tensors, axis_sizes):
+    """Batch-dim-on-"data" input shardings (the single policy shared by the
+    search paths and FFModel._run_search): shard dim 0 over the data axis
+    when divisible, replicate otherwise."""
+    data_deg = axis_sizes.get("data", 1)
+    input_pshapes = {}
+    for t in input_tensors:
+        dims = [
+            ParallelDim(s, data_deg, "data")
+            if i == 0 and data_deg > 1 and s % data_deg == 0
+            else ParallelDim(s)
+            for i, s in enumerate(t.dims)
+        ]
+        input_pshapes[t.tensor_id] = ParallelTensorShape(tuple(dims), t.dtype)
+    return input_pshapes
+
+
 def full_search(
     layers: List[Layer],
     input_tensors: Sequence[Tensor],
@@ -218,16 +235,7 @@ def full_search(
     for shape in mesh_shapes:
         axis_sizes = dict(shape)
         sim = Simulator(machine, OpCostModel(machine))
-        input_pshapes = {}
-        data_deg = axis_sizes.get("data", 1)
-        for t in input_tensors:
-            dims = []
-            for i, s in enumerate(t.dims):
-                if i == 0 and data_deg > 1 and s % data_deg == 0:
-                    dims.append(ParallelDim(s, data_deg, "data"))
-                else:
-                    dims.append(ParallelDim(s))
-            input_pshapes[t.tensor_id] = ParallelTensorShape(tuple(dims), t.dtype)
+        input_pshapes = data_parallel_input_pshapes(input_tensors, axis_sizes)
         try:
             r = graph_optimize(
                 layers, input_pshapes, axis_sizes, sim, config, beam_width
