@@ -171,7 +171,7 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 VMEM_BUDGET_BYTES = 12 * 1024 * 1024  # leave headroom under the ~16 MB core
 
 
-def supported(q_shape, k_shape, causal_ok: bool = True) -> bool:
+def supported(q_shape, k_shape) -> bool:
     """Whether the kernel path handles these (B, S, H, D) shapes.
 
     Checks block divisibility and the VMEM working set (K/V panels +
@@ -207,6 +207,12 @@ def flash_attention(q, k, v, causal: bool = False,
     skv = k.shape[1]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
     bq = _pick_block(sq, block_q)
+    if bq is None or _pick_block(skv, block_q) is None:
+        raise ValueError(
+            f"flash_attention: seq lengths ({sq}, {skv}) have no valid "
+            f"block size (must be divisible by 8); check supported() and "
+            f"fall back to single_device_attention"
+        )
     interpret = pallas_mode() == "interpret"
     # (B, S, H, D) -> (B*H, S, D)
     qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)

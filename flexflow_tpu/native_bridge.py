@@ -1,0 +1,243 @@
+"""ctypes bindings to the native runtime library.
+
+Plays the role of the reference's cffi layer over its flat C API
+(reference: python/flexflow/core/flexflow_cffi.py binding
+include/flexflow/flexflow_c.h). The native library
+(``native/`` → libflexflow_tpu_native.so) provides:
+
+* :func:`sim_taskgraph` — event-driven task-graph replay (the hot loop of
+  the strategy search's simulator);
+* :func:`toposort` / :func:`dominators` / :func:`transitive_reduction` —
+  graph algorithms backing the search;
+* :class:`NativeLoader` — threaded shuffle/gather/prefetch batch assembly.
+
+Every entry point has a pure-Python caller-side fallback (the callers check
+:func:`available`), so the framework works without a C++ toolchain; with
+one, the library is auto-built on first import.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_LIB_PATH = os.path.join(_REPO, "flexflow_tpu", "native",
+                         "libflexflow_tpu_native.so")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    makefile_dir = os.path.join(_REPO, "native")
+    if not os.path.isdir(makefile_dir):
+        return False
+    try:
+        subprocess.run(["make", "-C", makefile_dir, "-s"], check=True,
+                       capture_output=True, timeout=120)
+        return os.path.exists(_LIB_PATH)
+    except Exception:
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("FLEXFLOW_TPU_NATIVE", "auto") == "off":
+            return None
+        if not os.path.exists(_LIB_PATH) and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            return None
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        f64p = ctypes.POINTER(ctypes.c_double)
+        lib.fftpu_version.restype = ctypes.c_int
+        lib.fftpu_sim_taskgraph.restype = ctypes.c_double
+        lib.fftpu_sim_taskgraph.argtypes = [
+            ctypes.c_int32, f64p, i32p, ctypes.c_int32, i32p, i32p, f64p]
+        lib.fftpu_toposort.restype = ctypes.c_int
+        lib.fftpu_toposort.argtypes = [
+            ctypes.c_int32, ctypes.c_int32, i32p, i32p, i32p]
+        lib.fftpu_dominators.restype = ctypes.c_int
+        lib.fftpu_dominators.argtypes = [
+            ctypes.c_int32, ctypes.c_int32, i32p, i32p, ctypes.c_int32, i32p]
+        lib.fftpu_transitive_reduction.restype = ctypes.c_int32
+        lib.fftpu_transitive_reduction.argtypes = [
+            ctypes.c_int32, ctypes.c_int32, i32p, i32p,
+            ctypes.POINTER(ctypes.c_uint8)]
+        lib.fftpu_loader_create.restype = ctypes.c_void_p
+        lib.fftpu_loader_create.argtypes = [
+            ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int32, ctypes.c_uint64, ctypes.c_int32]
+        lib.fftpu_loader_destroy.argtypes = [ctypes.c_void_p]
+        lib.fftpu_loader_num_batches.restype = ctypes.c_int64
+        lib.fftpu_loader_num_batches.argtypes = [ctypes.c_void_p]
+        lib.fftpu_loader_reset.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        lib.fftpu_loader_reset_with_perm.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64)]
+        lib.fftpu_loader_next.restype = ctypes.c_int64
+        lib.fftpu_loader_next.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p)]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _i32(a) -> np.ndarray:
+    return np.ascontiguousarray(a, dtype=np.int32)
+
+
+def _as_i32p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def sim_taskgraph(durations: Sequence[float], devices: Sequence[int],
+                  edges: Sequence[Tuple[int, int]],
+                  want_starts: bool = False):
+    """Returns makespan (and per-task start times when requested)."""
+    lib = _load()
+    assert lib is not None
+    dur = np.ascontiguousarray(durations, dtype=np.float64)
+    dev = _i32(devices)
+    n = len(dur)
+    es = _i32([e[0] for e in edges])
+    ed = _i32([e[1] for e in edges])
+    starts = np.zeros(n, np.float64) if want_starts else None
+    res = lib.fftpu_sim_taskgraph(
+        n, dur.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        _as_i32p(dev), len(edges), _as_i32p(es), _as_i32p(ed),
+        starts.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+        if starts is not None else None)
+    if res < 0:
+        raise ValueError("task graph has a cycle or invalid edges")
+    return (res, starts) if want_starts else res
+
+
+def toposort(n: int, edges: Sequence[Tuple[int, int]]) -> List[int]:
+    lib = _load()
+    assert lib is not None
+    es = _i32([e[0] for e in edges])
+    ed = _i32([e[1] for e in edges])
+    out = np.zeros(n, np.int32)
+    if lib.fftpu_toposort(n, len(edges), _as_i32p(es), _as_i32p(ed),
+                          _as_i32p(out)) != 0:
+        raise ValueError("graph has a cycle")
+    return out.tolist()
+
+
+def dominators(n: int, edges: Sequence[Tuple[int, int]], root: int) -> List[int]:
+    """Immediate dominator per node (root maps to itself, unreachable → -1)."""
+    lib = _load()
+    assert lib is not None
+    es = _i32([e[0] for e in edges])
+    ed = _i32([e[1] for e in edges])
+    out = np.zeros(n, np.int32)
+    if lib.fftpu_dominators(n, len(edges), _as_i32p(es), _as_i32p(ed), root,
+                            _as_i32p(out)) != 0:
+        raise ValueError("invalid dominator input")
+    return out.tolist()
+
+
+def transitive_reduction(n: int, edges: Sequence[Tuple[int, int]]
+                         ) -> List[Tuple[int, int]]:
+    lib = _load()
+    assert lib is not None
+    es = _i32([e[0] for e in edges])
+    ed = _i32([e[1] for e in edges])
+    kept = np.zeros(len(edges), np.uint8)
+    r = lib.fftpu_transitive_reduction(
+        n, len(edges), _as_i32p(es), _as_i32p(ed),
+        kept.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+    if r < 0:
+        raise ValueError("graph has a cycle")
+    return [e for e, k in zip(edges, kept) if k]
+
+
+class NativeLoader:
+    """Threaded shuffle/gather/prefetch over host numpy datasets
+    (reference: SingleDataLoader, src/dataloader/dataloader.cc).
+
+    Shuffle permutations come from ``np.random.default_rng(seed)`` on the
+    Python side (pushed via ``fftpu_loader_reset_with_perm``), so a run is
+    bit-identical whether or not the native library is in use.
+    """
+
+    def __init__(self, arrays: Sequence[np.ndarray], batch_size: int,
+                 shuffle: bool = False, seed: int = 0):
+        lib = _load()
+        assert lib is not None
+        self._lib = lib
+        # keep C-contiguous copies alive for the loader's lifetime
+        self._arrays = [np.ascontiguousarray(a) for a in arrays]
+        n = self._arrays[0].shape[0]
+        assert all(a.shape[0] == n for a in self._arrays)
+        self.batch_size = batch_size
+        self.num_samples = n
+        self.shuffle = shuffle
+        self._rng = np.random.default_rng(seed)
+        self._row_bytes = [a.nbytes // n for a in self._arrays]
+        datas = (ctypes.c_void_p * len(self._arrays))(
+            *[a.ctypes.data_as(ctypes.c_void_p).value for a in self._arrays])
+        rb = (ctypes.c_int64 * len(self._arrays))(*self._row_bytes)
+        self._h = lib.fftpu_loader_create(
+            n, batch_size, len(self._arrays), datas, rb, 0, seed, 1)
+        if not self._h:
+            raise RuntimeError("fftpu_loader_create failed")
+        # note: no shuffle until the first reset(reshuffle=True) — matching
+        # the numpy fallback path so the two are batch-for-batch identical
+
+    def _push_perm(self) -> None:
+        perm = np.ascontiguousarray(
+            self._rng.permutation(self.num_samples), dtype=np.int64)
+        self._lib.fftpu_loader_reset_with_perm(
+            self._h, perm.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+
+    @property
+    def num_batches(self) -> int:
+        return int(self._lib.fftpu_loader_num_batches(self._h))
+
+    def reset(self, reshuffle: bool = True) -> None:
+        if self.shuffle and reshuffle:
+            self._push_perm()
+        else:
+            self._lib.fftpu_loader_reset(self._h, 0)
+
+    def next_batch(self) -> Optional[List[np.ndarray]]:
+        # fresh buffers each call: the C side memcpys straight into them and
+        # they are handed to the caller without another host copy
+        outs_np = [
+            np.empty((self.batch_size,) + a.shape[1:], a.dtype)
+            for a in self._arrays
+        ]
+        outs = (ctypes.c_void_p * len(outs_np))(
+            *[o.ctypes.data_as(ctypes.c_void_p).value for o in outs_np])
+        b = self._lib.fftpu_loader_next(self._h, outs)
+        if b < 0:
+            return None
+        return outs_np
+
+    def close(self) -> None:
+        if getattr(self, "_h", None):
+            self._lib.fftpu_loader_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

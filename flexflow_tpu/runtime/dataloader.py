@@ -41,6 +41,10 @@ class SingleDataLoader:
         self.sharding = sharding
         self.num_samples = self.data.shape[0]
         self.next_index = 0
+        # optional row permutation (set by DataLoaderGroup shuffling); kept
+        # as indices over the pristine dataset so the order for a given
+        # seed+epoch matches the native loader exactly
+        self.perm: Optional[np.ndarray] = None
 
     @property
     def num_batches(self) -> int:
@@ -56,7 +60,10 @@ class SingleDataLoader:
         if i + self.batch_size > self.num_samples:
             i = 0
             self.next_index = 0
-        batch = self.data[i : i + self.batch_size]
+        if self.perm is not None:
+            batch = self.data[self.perm[i : i + self.batch_size]]
+        else:
+            batch = self.data[i : i + self.batch_size]
         self.next_index = i + self.batch_size
         return jax.device_put(batch, self.sharding)
 
@@ -64,7 +71,14 @@ class SingleDataLoader:
 class DataLoaderGroup:
     """Batched iteration over aligned input+label loaders with optional
     shared shuffling (the reference shuffles via app-level random_shuffle
-    in examples' DataLoader::shuffle)."""
+    in examples' DataLoader::shuffle).
+
+    When the native runtime library is available, shuffle + row gathering +
+    one-batch-ahead prefetch run on a C++ worker thread
+    (native/src/dataloader.cc), overlapping host batch assembly with device
+    step time — the reference's ahead-of-compute copy-task pattern. The
+    pure-numpy path below is the fallback.
+    """
 
     def __init__(self, loaders: List[SingleDataLoader], seed: int = 0, shuffle: bool = False):
         assert loaders
@@ -73,18 +87,43 @@ class DataLoaderGroup:
         self.loaders = loaders
         self.shuffle = shuffle
         self._rng = np.random.default_rng(seed)
+        self._native = None
+        try:
+            from .. import native_bridge
+
+            if native_bridge.available():
+                self._native = native_bridge.NativeLoader(
+                    [l.data for l in loaders],
+                    loaders[0].batch_size,
+                    shuffle=shuffle,
+                    seed=seed,
+                )
+        except Exception:
+            self._native = None
 
     @property
     def num_batches(self) -> int:
         return self.loaders[0].num_batches
 
     def reset(self, reshuffle: bool = True) -> None:
+        if self._native is not None:
+            self._native.reset(reshuffle)
+            return
         for l in self.loaders:
             l.reset()
         if self.shuffle and reshuffle:
             perm = self._rng.permutation(self.loaders[0].num_samples)
             for l in self.loaders:
-                l.data = l.data[perm]
+                l.perm = perm
 
     def next_batch(self) -> List[jax.Array]:
+        if self._native is not None:
+            rows = self._native.next_batch()
+            if rows is None:  # epoch end: wrap like SingleDataLoader does
+                self._native.reset(reshuffle=False)
+                rows = self._native.next_batch()
+            return [
+                jax.device_put(r, l.sharding)
+                for r, l in zip(rows, self.loaders)
+            ]
         return [l.next_batch() for l in self.loaders]

@@ -206,26 +206,72 @@ class Simulator:
         return tasks
 
     # ------------------------------------------------------------- simulate
+    def _effective_runtime(self, task: SimTask, bwd_total: float) -> float:
+        run = task.run_time
+        if task.name == "grad_sync" and self.overlap_grad_sync:
+            # XLA's latency-hiding scheduler overlaps grad all-reduce
+            # with backward compute; only the un-hidden tail is paid
+            run = max(run - 0.5 * bwd_total, run * 0.1)
+        return run
+
     def simulate_runtime(self, ops: List[Op]) -> float:
         """Estimated per-iteration seconds (reference:
         Simulator::simulate_runtime, simulator.cc:822) — replays the
-        SimTask graph from :meth:`build_task_graph` so the inspectable
-        graph and the reported time can never disagree."""
+        SimTask graph from :meth:`build_task_graph`. The replay runs in the
+        native event engine (native/src/sim_engine.cc, the reference's
+        event-driven TaskManager loop) when built, with compute and
+        network on separate lanes; pure-Python fallback otherwise."""
         tasks = self.build_task_graph(ops)
         bwd_total = sum(t.run_time for t in tasks if t.kind == "bwd")
-        finish = [0.0] * len(tasks)
+        durations = [self._effective_runtime(t, bwd_total) for t in tasks]
+        # one compute lane (every device runs the same SPMD program, so the
+        # per-device timeline is shared) + one network lane that comm tasks
+        # overlap compute on — identical semantics in both engines
+        lanes = [1 if t.kind == "comm" else 0 for t in tasks]
+
+        from ..native_bridge import available, sim_taskgraph
+
+        if available():
+            edges = [(d, i) for i, t in enumerate(tasks) for d in t.deps]
+            total, starts = sim_taskgraph(durations, lanes, edges,
+                                          want_starts=True)
+            finish = [float(s) + durations[i] for i, s in enumerate(starts)]
+            for i, t in enumerate(tasks):
+                t.start_time = float(starts[i])
+                t.ready_time = max((finish[d] for d in t.deps), default=0.0)
+            return float(total)
+
+        # Python fallback: the same event-driven replay as the native
+        # engine (pop by (dep-ready time, task id), serialize per lane) so
+        # both paths produce identical schedules
+        import heapq
+
+        n = len(tasks)
+        succ: List[List[int]] = [[] for _ in range(n)]
+        indeg = [0] * n
+        for i, t in enumerate(tasks):
+            for d in t.deps:
+                succ[d].append(i)
+                indeg[i] += 1
+        ready = [0.0] * n
+        finish = [0.0] * n
+        lane_free: Dict[int, float] = {}
+        heap = [(0.0, i) for i in range(n) if indeg[i] == 0]
+        heapq.heapify(heap)
         total = 0.0
-        for i, task in enumerate(tasks):
-            run = task.run_time
-            if task.name == "grad_sync" and self.overlap_grad_sync:
-                # XLA's latency-hiding scheduler overlaps grad all-reduce
-                # with backward compute; only the un-hidden tail is paid
-                run = max(run - 0.5 * bwd_total, run * 0.1)
-            ready = max((finish[d] for d in task.deps), default=0.0)
-            task.ready_time = ready
-            task.start_time = ready
-            finish[i] = ready + run
+        while heap:
+            rdy, i = heapq.heappop(heap)
+            start = max(rdy, lane_free.get(lanes[i], 0.0))
+            tasks[i].ready_time = rdy
+            tasks[i].start_time = start
+            finish[i] = start + durations[i]
+            lane_free[lanes[i]] = finish[i]
             total = max(total, finish[i])
+            for s in succ[i]:
+                ready[s] = max(ready[s], finish[i])
+                indeg[s] -= 1
+                if indeg[s] == 0:
+                    heapq.heappush(heap, (ready[s], s))
         return total
 
     def memory_usage(self, ops: List[Op]) -> MemoryUsage:

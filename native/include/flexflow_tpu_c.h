@@ -1,0 +1,107 @@
+/* Flat C API for flexflow_tpu's native runtime components.
+ *
+ * Role parity with the reference's C surface (reference:
+ * include/flexflow/flexflow_c.h — a flat C89 wrapper consumed by the
+ * Python cffi frontend). The TPU-native compute path is jitted XLA, so
+ * model building stays in Python; the native surface instead covers the
+ * runtime pieces that are C++ in the reference:
+ *
+ *   - task-graph execution simulation (reference: src/runtime/simulator.cc
+ *     event-driven SimTask replay, simulator.cc:822-1250)
+ *   - graph algorithms backing the search (reference:
+ *     include/flexflow/dominators.h, basic_graph.h)
+ *   - the training dataloader's shuffle/gather/prefetch machinery
+ *     (reference: src/dataloader/dataloader.cc SingleDataLoader)
+ *
+ * All functions are exported with C linkage for ctypes.
+ */
+
+#ifndef FLEXFLOW_TPU_C_H
+#define FLEXFLOW_TPU_C_H
+
+#include <stdint.h>
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* ----------------------------------------------------------------- version */
+int fftpu_version(void);
+
+/* ----------------------------------------------------- task-graph simulator
+ * Tasks are numbered 0..n-1 in topological submission order. Each task has
+ * a duration (seconds), a device lane id, and dependency edges. The engine
+ * runs event-driven list scheduling: a task starts when all deps finished
+ * AND its device lane is free; lanes run one task at a time.
+ * Returns the makespan; start_times (len n) is filled if non-NULL.
+ * Returns -1.0 on cycle/invalid input. */
+double fftpu_sim_taskgraph(int32_t n_tasks,
+                           const double *durations,
+                           const int32_t *devices,
+                           int32_t n_edges,
+                           const int32_t *edge_src,
+                           const int32_t *edge_dst,
+                           double *start_times);
+
+/* ------------------------------------------------------------- graph algos
+ * Graphs are edge lists over nodes 0..n-1. */
+
+/* Topological order into `order` (len n). Returns 0, or -1 on cycle. */
+int fftpu_toposort(int32_t n_nodes, int32_t n_edges,
+                   const int32_t *edge_src, const int32_t *edge_dst,
+                   int32_t *order);
+
+/* Immediate dominators w.r.t. `root` into `idom` (len n; idom[root]=root,
+ * unreachable=-1). Cooper-Harvey-Kennedy iterative algorithm. Returns 0 on
+ * success. */
+int fftpu_dominators(int32_t n_nodes, int32_t n_edges,
+                     const int32_t *edge_src, const int32_t *edge_dst,
+                     int32_t root, int32_t *idom);
+
+/* Transitive reduction: marks kept[e]=1 for edges not implied by longer
+ * paths (DAG only). Returns number kept, or -1 on cycle. */
+int32_t fftpu_transitive_reduction(int32_t n_nodes, int32_t n_edges,
+                                   const int32_t *edge_src,
+                                   const int32_t *edge_dst,
+                                   uint8_t *kept);
+
+/* ---------------------------------------------------------------- dataloader
+ * A loader owns references to one or more host datasets (row-major, row
+ * stride in bytes) and serves shuffled batches by gathering rows into
+ * caller-provided buffers on a background thread pool (double-buffered
+ * prefetch, like the reference's per-device load tasks ahead of
+ * next_batch). The caller keeps dataset memory alive for the loader's
+ * lifetime. */
+
+typedef struct fftpu_loader fftpu_loader;
+
+fftpu_loader *fftpu_loader_create(int64_t num_samples, int32_t batch_size,
+                                  int32_t num_arrays,
+                                  const void *const *datas,
+                                  const int64_t *row_bytes,
+                                  int32_t shuffle, uint64_t seed,
+                                  int32_t num_threads);
+void fftpu_loader_destroy(fftpu_loader *);
+
+int64_t fftpu_loader_num_batches(const fftpu_loader *);
+
+/* Reset to epoch start; reshuffles when shuffle was requested. */
+void fftpu_loader_reset(fftpu_loader *, int32_t reshuffle);
+
+/* Reset to epoch start with a caller-supplied permutation (len
+ * num_samples), so Python-side RNG keeps run-for-run reproducibility
+ * independent of whether the native loader is in use. Pass NULL to keep
+ * the current permutation. */
+void fftpu_loader_reset_with_perm(fftpu_loader *, const int64_t *perm);
+
+/* Gather the next batch into outs[i] (each batch_size*row_bytes[i] bytes).
+ * Blocks until the prefetched batch is ready. Returns the batch index, or
+ * -1 at epoch end. */
+int64_t fftpu_loader_next(fftpu_loader *, void *const *outs);
+
+#ifdef __cplusplus
+} /* extern "C" */
+#endif
+
+#endif /* FLEXFLOW_TPU_C_H */

@@ -1,0 +1,195 @@
+// Threaded prefetching dataloader.
+//
+// Native core behind flexflow_tpu.runtime.dataloader (reference:
+// src/dataloader/dataloader.cc — SingleDataLoader keeps the full dataset
+// in zero-copy DRAM and `next_batch` index-launches per-device copy tasks
+// that run ahead of compute). Here: the full dataset lives in host numpy
+// buffers; a worker pool gathers shuffled rows for batch b+1 while batch b
+// is being consumed (double-buffered), so host-side batch assembly
+// overlaps device step time.
+
+#include "flexflow_tpu_c.h"
+
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <cstring>
+#include <mutex>
+#include <random>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Slot {
+  std::vector<std::vector<uint8_t>> bufs;  // one per array
+  int64_t batch_idx = -1;
+  bool full = false;
+};
+
+}  // namespace
+
+struct fftpu_loader {
+  int64_t num_samples;
+  int32_t batch_size;
+  std::vector<const uint8_t *> datas;
+  std::vector<int64_t> row_bytes;
+  bool shuffle;
+  std::mt19937_64 rng;
+
+  std::vector<int64_t> perm;
+  int64_t num_batches = 0;
+
+  // double-buffered prefetch
+  Slot slots[2];
+  int64_t next_produce = 0;  // batch index the worker fills next
+  int64_t next_consume = 0;  // batch index the caller reads next
+  std::mutex mu;
+  std::condition_variable cv_produce, cv_consume;
+  std::thread worker;
+  std::atomic<bool> stop{false};
+  bool reset_requested = false;
+  bool filling = false;  // worker is gathering outside the lock
+
+  void fill(Slot &slot, int64_t b) {
+    // pure gather; slot/loader metadata is updated under the lock by work()
+    int64_t begin = b * batch_size;
+    for (size_t a = 0; a < datas.size(); ++a) {
+      int64_t rb = row_bytes[a];
+      uint8_t *dst = slot.bufs[a].data();
+      for (int32_t i = 0; i < batch_size; ++i) {
+        int64_t row = perm[begin + i];
+        std::memcpy(dst + (int64_t)i * rb, datas[a] + row * rb, rb);
+      }
+    }
+  }
+
+  void work() {
+    std::unique_lock<std::mutex> lk(mu);
+    while (!stop.load()) {
+      if (reset_requested) {
+        // reset() owns the transition; park until it completes
+        cv_produce.wait(lk, [&] { return stop.load() || !reset_requested; });
+        continue;
+      }
+      if (next_produce >= num_batches || slots[next_produce % 2].full) {
+        cv_produce.wait(lk, [&] {
+          return stop.load() || reset_requested ||
+                 (next_produce < num_batches &&
+                  !slots[next_produce % 2].full);
+        });
+        continue;
+      }
+      Slot &slot = slots[next_produce % 2];
+      int64_t b = next_produce;
+      filling = true;
+      lk.unlock();
+      fill(slot, b);  // gather outside the lock; slot is exclusively ours
+      lk.lock();
+      filling = false;
+      if (!reset_requested) {
+        slot.batch_idx = b;
+        slot.full = true;
+        next_produce = b + 1;
+        cv_consume.notify_all();
+      }
+      cv_produce.notify_all();  // reset() may be waiting on !filling
+    }
+  }
+};
+
+extern "C" fftpu_loader *fftpu_loader_create(
+    int64_t num_samples, int32_t batch_size, int32_t num_arrays,
+    const void *const *datas, const int64_t *row_bytes, int32_t shuffle,
+    uint64_t seed, int32_t /*num_threads: reserved; one worker suffices for
+                             memcpy-bound gathering*/) {
+  if (num_samples <= 0 || batch_size <= 0 || num_arrays <= 0) return nullptr;
+  auto *L = new fftpu_loader();
+  L->num_samples = num_samples;
+  L->batch_size = batch_size;
+  L->shuffle = shuffle != 0;
+  L->rng.seed(seed);
+  for (int32_t a = 0; a < num_arrays; ++a) {
+    L->datas.push_back(static_cast<const uint8_t *>(datas[a]));
+    L->row_bytes.push_back(row_bytes[a]);
+  }
+  L->num_batches = num_samples / batch_size;  // drop ragged tail, like the
+                                              // reference's fixed batch runs
+  L->perm.resize(num_samples);
+  for (int64_t i = 0; i < num_samples; ++i) L->perm[i] = i;
+  if (L->shuffle)
+    std::shuffle(L->perm.begin(), L->perm.end(), L->rng);
+  for (auto &slot : L->slots) {
+    slot.bufs.resize(num_arrays);
+    for (int32_t a = 0; a < num_arrays; ++a)
+      slot.bufs[a].resize((size_t)batch_size * row_bytes[a]);
+  }
+  L->worker = std::thread([L] { L->work(); });
+  return L;
+}
+
+extern "C" void fftpu_loader_destroy(fftpu_loader *L) {
+  if (!L) return;
+  {
+    std::lock_guard<std::mutex> lk(L->mu);
+    L->stop.store(true);
+  }
+  L->cv_produce.notify_all();
+  L->worker.join();
+  delete L;
+}
+
+extern "C" int64_t fftpu_loader_num_batches(const fftpu_loader *L) {
+  return L ? L->num_batches : 0;
+}
+
+namespace {
+
+// Park the worker, apply `apply_perm` (if any), rewind positions. The
+// worker is guaranteed idle while the transition runs, so the consumer can
+// never observe a half-reset loader.
+template <typename F>
+void reset_impl(fftpu_loader *L, F &&apply_perm) {
+  std::unique_lock<std::mutex> lk(L->mu);
+  L->reset_requested = true;
+  L->cv_produce.notify_all();
+  L->cv_produce.wait(lk, [&] { return !L->filling; });
+  apply_perm();
+  L->slots[0].full = L->slots[1].full = false;
+  L->slots[0].batch_idx = L->slots[1].batch_idx = -1;
+  L->next_produce = 0;
+  L->next_consume = 0;
+  L->reset_requested = false;
+  L->cv_produce.notify_all();
+}
+
+}  // namespace
+
+extern "C" void fftpu_loader_reset(fftpu_loader *L, int32_t reshuffle) {
+  reset_impl(L, [&] {
+    if (L->shuffle && reshuffle)
+      std::shuffle(L->perm.begin(), L->perm.end(), L->rng);
+  });
+}
+
+extern "C" void fftpu_loader_reset_with_perm(fftpu_loader *L,
+                                             const int64_t *perm) {
+  reset_impl(L, [&] {
+    if (perm)
+      std::copy(perm, perm + L->num_samples, L->perm.begin());
+  });
+}
+
+extern "C" int64_t fftpu_loader_next(fftpu_loader *L, void *const *outs) {
+  std::unique_lock<std::mutex> lk(L->mu);
+  if (L->next_consume >= L->num_batches) return -1;
+  int64_t b = L->next_consume;
+  Slot &slot = L->slots[b % 2];
+  L->cv_consume.wait(lk, [&] { return slot.full && slot.batch_idx == b; });
+  for (size_t a = 0; a < L->datas.size(); ++a)
+    std::memcpy(outs[a], slot.bufs[a].data(), slot.bufs[a].size());
+  slot.full = false;
+  L->next_consume = b + 1;
+  L->cv_produce.notify_all();
+  return b;
+}

@@ -1,0 +1,160 @@
+// Graph algorithms backing the auto-parallelization search.
+//
+// Native equivalents of the reference's header-only graph machinery
+// (reference: include/flexflow/basic_graph.h, dominators.h:488 —
+// dominator computation used to find sequential "bottleneck" split nodes
+// in GraphSearchHelper::generic_sequence_optimize, and transitive
+// reduction used when simplifying parallel computation graphs).
+
+#include "flexflow_tpu_c.h"
+
+#include <algorithm>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+bool toposort_impl(int32_t n, int32_t n_edges, const int32_t *esrc,
+                   const int32_t *edst, std::vector<int32_t> &order) {
+  std::vector<std::vector<int32_t>> succ(n);
+  std::vector<int32_t> indeg(n, 0);
+  for (int32_t e = 0; e < n_edges; ++e) {
+    if (esrc[e] < 0 || esrc[e] >= n || edst[e] < 0 || edst[e] >= n)
+      return false;
+    succ[esrc[e]].push_back(edst[e]);
+    indeg[edst[e]]++;
+  }
+  // Kahn with a sorted frontier: stable, deterministic order
+  std::vector<int32_t> frontier;
+  for (int32_t i = 0; i < n; ++i)
+    if (indeg[i] == 0) frontier.push_back(i);
+  order.clear();
+  order.reserve(n);
+  size_t head = 0;
+  while (head < frontier.size()) {
+    int32_t u = frontier[head++];
+    order.push_back(u);
+    for (int32_t v : succ[u])
+      if (--indeg[v] == 0) frontier.push_back(v);
+  }
+  return (int32_t)order.size() == n;
+}
+
+}  // namespace
+
+extern "C" int fftpu_toposort(int32_t n, int32_t n_edges, const int32_t *esrc,
+                              const int32_t *edst, int32_t *out) {
+  std::vector<int32_t> order;
+  if (!toposort_impl(n, n_edges, esrc, edst, order)) return -1;
+  std::memcpy(out, order.data(), sizeof(int32_t) * n);
+  return 0;
+}
+
+extern "C" int fftpu_dominators(int32_t n, int32_t n_edges,
+                                const int32_t *esrc, const int32_t *edst,
+                                int32_t root, int32_t *idom) {
+  if (root < 0 || root >= n) return -1;
+  std::vector<std::vector<int32_t>> pred(n);
+  for (int32_t e = 0; e < n_edges; ++e) {
+    if (esrc[e] < 0 || esrc[e] >= n || edst[e] < 0 || edst[e] >= n) return -1;
+    pred[edst[e]].push_back(esrc[e]);
+  }
+  // reverse-postorder from root
+  std::vector<std::vector<int32_t>> succ(n);
+  for (int32_t e = 0; e < n_edges; ++e) succ[esrc[e]].push_back(edst[e]);
+  std::vector<int32_t> post;
+  std::vector<int8_t> state(n, 0);  // 0 unvisited, 1 on stack, 2 done
+  std::vector<std::pair<int32_t, size_t>> stack;
+  stack.push_back({root, 0});
+  state[root] = 1;
+  while (!stack.empty()) {
+    auto &[u, ci] = stack.back();
+    if (ci < succ[u].size()) {
+      int32_t v = succ[u][ci++];
+      if (state[v] == 0) {
+        state[v] = 1;
+        stack.push_back({v, 0});
+      }
+    } else {
+      state[u] = 2;
+      post.push_back(u);
+      stack.pop_back();
+    }
+  }
+  std::vector<int32_t> rpo_num(n, -1);
+  std::vector<int32_t> rpo(post.rbegin(), post.rend());
+  for (size_t i = 0; i < rpo.size(); ++i) rpo_num[rpo[i]] = (int32_t)i;
+
+  // Cooper-Harvey-Kennedy "engineered" iterative dominators
+  std::vector<int32_t> dom(n, -1);
+  dom[root] = root;
+  auto intersect = [&](int32_t a, int32_t b) {
+    while (a != b) {
+      while (rpo_num[a] > rpo_num[b]) a = dom[a];
+      while (rpo_num[b] > rpo_num[a]) b = dom[b];
+    }
+    return a;
+  };
+  bool changed = true;
+  while (changed) {
+    changed = false;
+    for (int32_t u : rpo) {
+      if (u == root) continue;
+      int32_t new_idom = -1;
+      for (int32_t p : pred[u]) {
+        if (dom[p] == -1) continue;  // unreachable or not yet processed
+        new_idom = (new_idom == -1) ? p : intersect(p, new_idom);
+      }
+      if (new_idom != -1 && dom[u] != new_idom) {
+        dom[u] = new_idom;
+        changed = true;
+      }
+    }
+  }
+  std::memcpy(idom, dom.data(), sizeof(int32_t) * n);
+  return 0;
+}
+
+extern "C" int32_t fftpu_transitive_reduction(int32_t n, int32_t n_edges,
+                                              const int32_t *esrc,
+                                              const int32_t *edst,
+                                              uint8_t *kept) {
+  std::vector<int32_t> order;
+  if (!toposort_impl(n, n_edges, esrc, edst, order)) return -1;
+  std::vector<std::vector<int32_t>> succ(n);
+  for (int32_t e = 0; e < n_edges; ++e) succ[esrc[e]].push_back(edst[e]);
+  // reach[u] = bitset of nodes reachable from u via paths of length >= 2
+  // through kept structure; computed bottom-up in reverse topo order over
+  // full successor sets (standard DAG transitive reduction).
+  int32_t words = (n + 63) / 64;
+  std::vector<uint64_t> reach((size_t)n * words, 0);
+  auto bit = [&](std::vector<uint64_t> &r, int32_t u, int32_t v) {
+    r[(size_t)u * words + v / 64] |= (1ull << (v % 64));
+  };
+  auto test = [&](const std::vector<uint64_t> &r, int32_t u, int32_t v) {
+    return (r[(size_t)u * words + v / 64] >> (v % 64)) & 1ull;
+  };
+  for (auto it = order.rbegin(); it != order.rend(); ++it) {
+    int32_t u = *it;
+    for (int32_t v : succ[u]) {
+      bit(reach, u, v);
+      for (int32_t w = 0; w < words; ++w)
+        reach[(size_t)u * words + w] |= reach[(size_t)v * words + w];
+    }
+  }
+  int32_t n_kept = 0;
+  for (int32_t e = 0; e < n_edges; ++e) {
+    int32_t u = esrc[e], v = edst[e];
+    // edge is redundant iff some other successor of u reaches v
+    bool redundant = false;
+    for (int32_t s : succ[u]) {
+      if (s != v && test(reach, s, v)) {
+        redundant = true;
+        break;
+      }
+    }
+    kept[e] = redundant ? 0 : 1;
+    n_kept += kept[e];
+  }
+  return n_kept;
+}

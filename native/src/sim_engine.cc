@@ -1,0 +1,79 @@
+// Event-driven task-graph execution simulation.
+//
+// Native core behind flexflow_tpu.sim.Simulator.simulate_runtime
+// (reference: Simulator::simulate_runtime, src/runtime/simulator.cc:822 —
+// builds SimTasks then replays them event-driven over per-device
+// timelines; TaskManager simulator.h:656-685). The search evaluates
+// thousands of candidate strategies, each one a replay, so this loop is
+// native.
+
+#include "flexflow_tpu_c.h"
+
+#include <algorithm>
+#include <queue>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Event {
+  double time;
+  int32_t task;
+  bool operator<(Event const &o) const {
+    // min-heap via std::priority_queue: invert; tie-break on task id for
+    // deterministic replay
+    if (time != o.time) return time > o.time;
+    return task > o.task;
+  }
+};
+
+}  // namespace
+
+extern "C" double fftpu_sim_taskgraph(int32_t n, const double *dur,
+                                      const int32_t *dev, int32_t n_edges,
+                                      const int32_t *esrc, const int32_t *edst,
+                                      double *start_times) {
+  if (n <= 0) return 0.0;
+  std::vector<std::vector<int32_t>> succ(n);
+  std::vector<int32_t> indeg(n, 0);
+  for (int32_t e = 0; e < n_edges; ++e) {
+    int32_t s = esrc[e], t = edst[e];
+    if (s < 0 || s >= n || t < 0 || t >= n) return -1.0;
+    succ[s].push_back(t);
+    indeg[t]++;
+  }
+
+  std::vector<double> ready(n, 0.0);   // when deps are satisfied
+  std::vector<double> finish(n, 0.0);
+  std::unordered_map<int32_t, double> lane_free;  // device lane -> free time
+  std::priority_queue<Event> pq;       // tasks whose deps are met, keyed by
+                                       // earliest possible start
+  for (int32_t i = 0; i < n; ++i)
+    if (indeg[i] == 0) pq.push({0.0, i});
+
+  int32_t done = 0;
+  double makespan = 0.0;
+  while (!pq.empty()) {
+    Event ev = pq.top();
+    pq.pop();
+    int32_t i = ev.task;
+    double lane = 0.0;
+    auto it = lane_free.find(dev[i]);
+    if (it != lane_free.end()) lane = it->second;
+    double start = std::max(ev.time, lane);
+    double end = start + dur[i];
+    lane_free[dev[i]] = end;
+    finish[i] = end;
+    if (start_times) start_times[i] = start;
+    makespan = std::max(makespan, end);
+    ++done;
+    for (int32_t s : succ[i]) {
+      ready[s] = std::max(ready[s], end);
+      if (--indeg[s] == 0) pq.push({ready[s], s});
+    }
+  }
+  if (done != n) return -1.0;  // cycle
+  return makespan;
+}
+
+extern "C" int fftpu_version(void) { return 1; }

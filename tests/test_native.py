@@ -1,0 +1,200 @@
+"""Native runtime library (native/ → ctypes bridge) tests.
+
+The reference ships host-side unit tests for exactly this layer
+(tests/unit/: dominators, machine_view, random_utils — SURVEY.md §4);
+these cover the TPU-native equivalents plus parity between the native and
+pure-Python fallback paths.
+"""
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import native_bridge as nb
+
+pytestmark = pytest.mark.skipif(
+    not nb.available(), reason="native library not built"
+)
+
+
+def test_sim_taskgraph_lanes_and_critical_path():
+    # diamond on two lanes: 0 → {1(d0, 2s), 2(d1, 3s)} → 3(d0)
+    ms = nb.sim_taskgraph([1.0, 2.0, 3.0, 1.0], [0, 0, 1, 0],
+                          [(0, 1), (0, 2), (1, 3), (2, 3)])
+    assert abs(ms - 5.0) < 1e-12
+    # same-lane serialization: two independent 2s tasks on one lane
+    ms2 = nb.sim_taskgraph([2.0, 2.0], [0, 0], [])
+    assert abs(ms2 - 4.0) < 1e-12
+    ms3 = nb.sim_taskgraph([2.0, 2.0], [0, 1], [])
+    assert abs(ms3 - 2.0) < 1e-12
+
+
+def test_sim_taskgraph_cycle_detected():
+    with pytest.raises(ValueError):
+        nb.sim_taskgraph([1.0, 1.0], [0, 0], [(0, 1), (1, 0)])
+
+
+def test_toposort_and_transitive_reduction():
+    order = nb.toposort(4, [(2, 1), (1, 0), (3, 2)])
+    pos = {v: i for i, v in enumerate(order)}
+    assert pos[3] < pos[2] < pos[1] < pos[0]
+    kept = nb.transitive_reduction(4, [(0, 1), (1, 2), (0, 2), (0, 3)])
+    assert (0, 2) not in kept and set(kept) == {(0, 1), (1, 2), (0, 3)}
+
+
+def test_dominators_diamond_and_chain():
+    # diamond: idom of the join is the fork
+    idom = nb.dominators(4, [(0, 1), (0, 2), (1, 3), (2, 3)], 0)
+    assert idom == [0, 0, 0, 0]
+    # chain with a bypass edge: 0→1→2→3 plus 1→3 ⇒ idom[3] = 1
+    idom = nb.dominators(4, [(0, 1), (1, 2), (2, 3), (1, 3)], 0)
+    assert idom[3] == 1 and idom[2] == 1 and idom[1] == 0
+    # unreachable node
+    idom = nb.dominators(3, [(0, 1)], 0)
+    assert idom[2] == -1
+
+
+def test_native_loader_row_alignment_and_epochs():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(17, 5)).astype(np.float32)
+    y = np.arange(17, dtype=np.int64).reshape(17, 1)
+    ld = nb.NativeLoader([x, y], batch_size=4, shuffle=True, seed=7)
+    assert ld.num_batches == 4
+    rows = []
+    for _ in range(ld.num_batches):
+        xb, yb = ld.next_batch()
+        for r in range(4):
+            np.testing.assert_array_equal(xb[r], x[int(yb[r, 0])])
+        rows.extend(yb[:, 0].tolist())
+    assert ld.next_batch() is None  # epoch end
+    assert len(set(rows)) == 16  # distinct rows, one dropped (ragged tail)
+    ld.reset(reshuffle=True)
+    rows2 = []
+    for _ in range(ld.num_batches):
+        _, yb = ld.next_batch()
+        rows2.extend(yb[:, 0].tolist())
+    assert len(set(rows2)) == 16
+    assert rows != rows2  # reshuffled order
+    ld.close()
+
+
+def test_dataloader_group_uses_native_and_matches_samples():
+    from flexflow_tpu.runtime.dataloader import DataLoaderGroup, SingleDataLoader
+
+    x = np.arange(48, dtype=np.float32).reshape(12, 4)
+    y = (np.arange(12, dtype=np.int32) % 3).reshape(12, 1)
+    g = DataLoaderGroup(
+        [SingleDataLoader(x, 4), SingleDataLoader(y, 4)], seed=3, shuffle=True
+    )
+    assert g._native is not None
+    g.reset()
+    seen = []
+    for _ in range(g.num_batches):
+        xb, yb = g.next_batch()
+        xb, yb = np.asarray(xb), np.asarray(yb)
+        for r in range(4):
+            row = int(xb[r, 0] // 4)
+            assert yb[r, 0] == row % 3  # alignment preserved
+            seen.append(row)
+    assert len(set(seen)) == 12
+
+
+def test_simulator_native_replay_matches_python():
+    """simulate_runtime through the native engine equals the Python
+    fallback on the same task graph (chain-structured graphs)."""
+    from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+    from flexflow_tpu.models.mlp import build_mlp
+    from flexflow_tpu.runtime.compiler import build_ops
+    from flexflow_tpu.search.unity import data_parallel_input_pshapes
+    from flexflow_tpu.sim import OpCostModel, Simulator, detect_machine_model
+
+    ff = FFModel(FFConfig(batch_size=32))
+    build_mlp(ff, 32, in_dim=64, hidden_dims=(64,), num_classes=10)
+    axis_sizes = {"data": 4}
+    inputs = ff._used_inputs()
+    pshapes = data_parallel_input_pshapes(inputs, axis_sizes)
+    ops, _ = build_ops(ff.layers, pshapes, axis_sizes, {})
+    machine = detect_machine_model(4)
+    sim = Simulator(machine, OpCostModel(machine))
+    t_native = sim.simulate_runtime(ops)
+
+    import flexflow_tpu.native_bridge as bridge
+
+    orig = bridge._lib
+    try:
+        bridge._lib = None
+        bridge._tried = True  # force the Python fallback
+        t_py = sim.simulate_runtime(ops)
+    finally:
+        bridge._lib = orig
+        bridge._tried = True
+    assert t_native > 0
+    np.testing.assert_allclose(t_native, t_py, rtol=1e-9)
+
+
+def test_simulator_native_replay_matches_python_branchy():
+    """Parity must hold on branchy graphs too (MoE expert branches), where
+    lane contention and event order actually matter."""
+    from flexflow_tpu import FFConfig, FFModel
+    from flexflow_tpu.models.moe import MoeConfig, build_moe_mnist
+    from flexflow_tpu.runtime.compiler import build_ops
+    from flexflow_tpu.search.unity import data_parallel_input_pshapes
+    from flexflow_tpu.sim import OpCostModel, Simulator, detect_machine_model
+
+    ff = FFModel(FFConfig(batch_size=32))
+    build_moe_mnist(ff, 32, MoeConfig(input_dim=16, num_exp=4, num_select=2,
+                                      expert_hidden_size=32))
+    axis_sizes = {"data": 2}
+    pshapes = data_parallel_input_pshapes(ff._used_inputs(), axis_sizes)
+    ops, _ = build_ops(ff.layers, pshapes, axis_sizes, {})
+    machine = detect_machine_model(2)
+    sim = Simulator(machine, OpCostModel(machine))
+    t_native = sim.simulate_runtime(ops)
+
+    import flexflow_tpu.native_bridge as bridge
+
+    orig = bridge._lib
+    try:
+        bridge._lib = None
+        bridge._tried = True
+        t_py = sim.simulate_runtime(ops)
+    finally:
+        bridge._lib = orig
+    np.testing.assert_allclose(t_native, t_py, rtol=1e-12)
+
+
+def test_loader_reproducible_native_vs_python():
+    """Same seed ⇒ identical batch order whether or not the native loader
+    engages (shuffle permutations come from numpy on both paths)."""
+    from flexflow_tpu.runtime.dataloader import DataLoaderGroup, SingleDataLoader
+
+    def run(force_python):
+        import flexflow_tpu.native_bridge as bridge
+
+        x = np.arange(36, dtype=np.float32).reshape(12, 3)
+        y = np.arange(12, dtype=np.int32).reshape(12, 1)
+        orig, orig_tried = bridge._lib, bridge._tried
+        try:
+            if force_python:
+                bridge._lib = None
+                bridge._tried = True
+            g = DataLoaderGroup(
+                [SingleDataLoader(x, 4), SingleDataLoader(y, 4)],
+                seed=11, shuffle=True,
+            )
+            if force_python:
+                assert g._native is None
+            else:
+                assert g._native is not None
+            out = []
+            for _ in range(3):  # 3 epochs
+                g.reset()
+                for _ in range(g.num_batches):
+                    _, yb = g.next_batch()
+                    out.extend(np.asarray(yb)[:, 0].tolist())
+            return out
+        finally:
+            bridge._lib, bridge._tried = orig, orig_tried
+
+    a = run(force_python=False)
+    b = run(force_python=True)
+    assert a == b
