@@ -1,0 +1,41 @@
+"""Keras-style frontend.
+
+TPU-native equivalent of ``flexflow.keras`` (reference:
+python/flexflow/keras/ — Sequential/functional ``Model`` whose
+``BaseModel.compile`` creates the FFModel + tensors + optimizer,
+models/base_model.py:128, and ``fit`` builds SingleDataLoaders and drives
+the train loop, base_model.py:198; layer classes mirror Keras).
+
+Layers here are declarative configs; ``__call__`` records a symbolic graph
+that is lowered onto an :class:`flexflow_tpu.FFModel` when the batch size
+is known (at ``fit``/``evaluate``), exactly like the reference defers
+building to ``compile``.
+"""
+
+from .layers import (
+    Activation,
+    Add,
+    AveragePooling2D,
+    BatchNormalization,
+    Concatenate,
+    Conv2D,
+    Dense,
+    Dropout,
+    Embedding,
+    Flatten,
+    Input,
+    LayerNormalization,
+    MaxPooling2D,
+    Multiply,
+    Reshape,
+    Subtract,
+)
+from .models import Model, Sequential
+from .optimizers import SGD, Adam
+
+__all__ = [
+    "Activation", "Add", "AveragePooling2D", "BatchNormalization",
+    "Concatenate", "Conv2D", "Dense", "Dropout", "Embedding", "Flatten",
+    "Input", "LayerNormalization", "MaxPooling2D", "Multiply", "Reshape",
+    "Subtract", "Model", "Sequential", "SGD", "Adam",
+]

@@ -1,0 +1,10 @@
+"""ONNX frontend (reference: python/flexflow/onnx/model.py — ``ONNXModel``
+walking the onnx graph with one ``handleX`` per op type).
+
+The ``onnx`` package is not bundled in every environment; import is lazy
+and `ONNXModel` raises a clear error when it is missing.
+"""
+
+from .model import ONNXModel
+
+__all__ = ["ONNXModel"]

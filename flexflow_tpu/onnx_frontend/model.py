@@ -1,0 +1,198 @@
+"""ONNX → flexflow_tpu importer.
+
+Mirrors the reference's walker design (reference:
+python/flexflow/onnx/model.py:56-375 — ``ONNXModel`` dispatches each onnx
+node to a ``handleX`` method that calls the FFModel builder). Covered ops
+are the set the reference handles (Conv/MaxPool/AveragePool/Gemm/MatMul/
+Relu/Softmax/Flatten/Concat/Split/Add/Sub/Mul/Dropout/Reshape/Transpose/
+BatchNormalization) plus Gelu/Sigmoid/Tanh.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..ffconst import ActiMode, PoolType
+
+
+def _require_onnx():
+    try:
+        import onnx  # noqa: F401
+
+        return onnx
+    except ImportError as e:  # pragma: no cover - environment-dependent
+        raise ImportError(
+            "the ONNX frontend requires the `onnx` package; it is not "
+            "bundled in this environment"
+        ) from e
+
+
+def _attrs(node) -> Dict:
+    import onnx
+
+    out = {}
+    for a in node.attribute:
+        out[a.name] = onnx.helper.get_attribute_value(a)
+    return out
+
+
+class ONNXModel:
+    """reference: ONNXModel (python/flexflow/onnx/model.py:56)."""
+
+    def __init__(self, filename_or_model):
+        onnx = _require_onnx()
+        if isinstance(filename_or_model, (str, bytes)):
+            self.model = onnx.load(filename_or_model)
+        else:
+            self.model = filename_or_model
+        self.inits: Dict[str, np.ndarray] = {}
+        from onnx import numpy_helper
+
+        for init in self.model.graph.initializer:
+            self.inits[init.name] = numpy_helper.to_array(init)
+
+    # ------------------------------------------------------------------ #
+    def apply(self, ffmodel, input_tensors: Sequence) -> List:
+        """Replay the onnx graph onto ``ffmodel``; ``input_tensors`` bind
+        the graph inputs (initializers excluded) in declaration order."""
+        env: Dict[str, object] = {}
+        graph_inputs = [
+            i for i in self.model.graph.input if i.name not in self.inits
+        ]
+        assert len(graph_inputs) == len(input_tensors), (
+            f"graph has {len(graph_inputs)} inputs, got {len(input_tensors)}"
+        )
+        for gi, t in zip(graph_inputs, input_tensors):
+            env[gi.name] = t
+        for node in self.model.graph.node:
+            handler = getattr(self, f"handle{node.op_type}", None)
+            if handler is None:
+                raise ValueError(f"unsupported ONNX op {node.op_type}")
+            outs = handler(ffmodel, node, env)
+            if not isinstance(outs, (list, tuple)):
+                outs = [outs]
+            for name, val in zip(node.output, outs):
+                env[name] = val
+        return [env[o.name] for o in self.model.graph.output]
+
+    # ---- handlers (reference: model.py handleX methods) ---------------- #
+    def handleConv(self, ff, node, env):
+        a = _attrs(node)
+        w = self.inits[node.input[1]]
+        out_c, _, kh, kw = w.shape
+        strides = a.get("strides", [1, 1])
+        pads = a.get("pads", [0, 0, 0, 0])
+        group = a.get("group", 1)
+        return ff.conv2d(env[node.input[0]], out_c, kh, kw, strides[0],
+                         strides[1], pads[0], pads[1], groups=group,
+                         use_bias=len(node.input) > 2, name=node.name or None)
+
+    def _pool(self, ff, node, env, pt):
+        a = _attrs(node)
+        k = a.get("kernel_shape", [2, 2])
+        s = a.get("strides", k)
+        p = a.get("pads", [0, 0, 0, 0])
+        return ff.pool2d(env[node.input[0]], k[0], k[1], s[0], s[1], p[0],
+                         p[1], pool_type=pt, name=node.name or None)
+
+    def handleMaxPool(self, ff, node, env):
+        return self._pool(ff, node, env, PoolType.MAX)
+
+    def handleAveragePool(self, ff, node, env):
+        return self._pool(ff, node, env, PoolType.AVG)
+
+    def handleGemm(self, ff, node, env):
+        w = self.inits[node.input[1]]
+        a = _attrs(node)
+        out_dim = w.shape[0] if a.get("transB", 0) else w.shape[1]
+        return ff.dense(env[node.input[0]], int(out_dim),
+                        use_bias=len(node.input) > 2, name=node.name or None)
+
+    def handleMatMul(self, ff, node, env):
+        if node.input[1] in self.inits:
+            w = self.inits[node.input[1]]
+            return ff.dense(env[node.input[0]], int(w.shape[-1]),
+                            use_bias=False, name=node.name or None)
+        return ff.batch_matmul(env[node.input[0]], env[node.input[1]],
+                               name=node.name or None)
+
+    def handleRelu(self, ff, node, env):
+        return ff.relu(env[node.input[0]], name=node.name or None)
+
+    def handleGelu(self, ff, node, env):
+        return ff.gelu(env[node.input[0]], name=node.name or None)
+
+    def handleSigmoid(self, ff, node, env):
+        return ff.sigmoid(env[node.input[0]], name=node.name or None)
+
+    def handleTanh(self, ff, node, env):
+        return ff.tanh(env[node.input[0]], name=node.name or None)
+
+    def handleSoftmax(self, ff, node, env):
+        a = _attrs(node)
+        return ff.softmax(env[node.input[0]], axis=a.get("axis", -1),
+                          name=node.name or None)
+
+    def handleFlatten(self, ff, node, env):
+        return ff.flat(env[node.input[0]], name=node.name or None)
+
+    def handleAdd(self, ff, node, env):
+        return ff.add(env[node.input[0]], env[node.input[1]],
+                      name=node.name or None)
+
+    def handleSub(self, ff, node, env):
+        return ff.subtract(env[node.input[0]], env[node.input[1]],
+                           name=node.name or None)
+
+    def handleMul(self, ff, node, env):
+        return ff.multiply(env[node.input[0]], env[node.input[1]],
+                           name=node.name or None)
+
+    def handleConcat(self, ff, node, env):
+        a = _attrs(node)
+        return ff.concat([env[i] for i in node.input], axis=a.get("axis", 0),
+                         name=node.name or None)
+
+    def handleSplit(self, ff, node, env):
+        a = _attrs(node)
+        if "split" in a:
+            sizes = list(a["split"])
+        elif len(node.input) > 1 and node.input[1] in self.inits:
+            sizes = self.inits[node.input[1]].tolist()
+        else:
+            sizes = len(node.output)
+        return ff.split(env[node.input[0]], sizes, axis=a.get("axis", 0),
+                        name=node.name or None)
+
+    def handleDropout(self, ff, node, env):
+        a = _attrs(node)
+        rate = a.get("ratio", 0.5)
+        if len(node.input) > 1 and node.input[1] in self.inits:
+            rate = float(self.inits[node.input[1]])
+        return ff.dropout(env[node.input[0]], rate=rate,
+                          name=node.name or None)
+
+    def handleReshape(self, ff, node, env):
+        shape = self.inits[node.input[1]].tolist()
+        x = env[node.input[0]]
+        if any(s == -1 for s in shape):
+            known = int(np.prod([s for s in shape if s > 0]))
+            total = int(np.prod(x.dims))
+            shape = [total // known if s == -1 else s for s in shape]
+        shape = [x.dims[i] if s == 0 else s for i, s in enumerate(shape)]
+        return ff.reshape(x, shape, name=node.name or None)
+
+    def handleTranspose(self, ff, node, env):
+        a = _attrs(node)
+        x = env[node.input[0]]
+        perm = a.get("perm", list(reversed(range(len(x.dims)))))
+        return ff.transpose(x, list(perm), name=node.name or None)
+
+    def handleBatchNormalization(self, ff, node, env):
+        return ff.batch_norm(env[node.input[0]], relu=False,
+                             name=node.name or None)
+
+    def handleIdentity(self, ff, node, env):
+        return ff.identity(env[node.input[0]], name=node.name or None)

@@ -32,7 +32,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-from ..ffconst import CompMode, DataType, LossType, MetricsType
+from ..ffconst import CompMode, DataType, LossType, MetricsType, OpType
 from ..config import FFConfig
 from ..core.layer import Layer
 from ..core.machine import DATA_AXIS, make_mesh, mesh_axis_sizes
@@ -255,6 +255,22 @@ def compile_model(
     n_inputs = len(input_tensors)
     input_ids = [t.tensor_id for t in input_tensors]
     logits_id = logits_tensor.tensor_id
+    # CE losses: raw-logit graphs (no trailing Softmax) get a fused
+    # log-softmax inside the loss; softmax-terminated graphs are treated as
+    # probabilities, matching the reference's Loss::backward convention.
+    # Value-preserving tail ops (identity/reshape/transpose/dropout) are
+    # walked through so softmax→identity still counts as probabilities.
+    _producer = {
+        t.tensor_id: op for op in ops for t in op.layer.outputs
+    }
+    _passthrough = {OpType.IDENTITY, OpType.RESHAPE, OpType.TRANSPOSE,
+                    OpType.DROPOUT}
+    _tid = logits_id
+    _logits_op = _producer.get(_tid)
+    while _logits_op is not None and _logits_op.op_type in _passthrough:
+        _tid = _logits_op.layer.inputs[0].tensor_id
+        _logits_op = _producer.get(_tid)
+    from_logits = _logits_op is None or _logits_op.op_type is not OpType.SOFTMAX
 
     # ---- train step --------------------------------------------------------
     def train_step(params, opt_state, rng, *batch):
@@ -266,13 +282,13 @@ def compile_model(
                 ops, mesh, params, dict(zip(input_ids, xs)), True, rng
             )
             logits = acts[logits_id]
-            loss = compute_loss(loss_type, logits, y)
+            loss = compute_loss(loss_type, logits, y, from_logits)
             for a in aux:
                 loss = loss + a
             return loss, logits
 
         (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
-        batch_metrics = compute_batch_metrics(metrics, loss_type, logits, y)
+        batch_metrics = compute_batch_metrics(metrics, loss_type, logits, y, from_logits)
         new_params, new_opt_state = optimizer.update(params, grads, opt_state, wd_mask)
         return new_params, new_opt_state, loss, batch_metrics
 
@@ -285,7 +301,7 @@ def compile_model(
             acts, aux = _forward_graph(
                 ops, mesh, params, dict(zip(input_ids, xs)), True, rng
             )
-            loss = compute_loss(loss_type, acts[logits_id], y)
+            loss = compute_loss(loss_type, acts[logits_id], y, from_logits)
             for a in aux:
                 loss = loss + a
             return loss
@@ -298,8 +314,8 @@ def compile_model(
         y = batch[n_inputs]
         acts, _ = _forward_graph(ops, mesh, params, dict(zip(input_ids, xs)), False, None)
         logits = acts[logits_id]
-        loss = compute_loss(loss_type, logits, y) if loss_type else jnp.zeros(())
-        return loss, logits, compute_batch_metrics(metrics, loss_type, logits, y)
+        loss = compute_loss(loss_type, logits, y, from_logits) if loss_type else jnp.zeros(())
+        return loss, logits, compute_batch_metrics(metrics, loss_type, logits, y, from_logits)
 
     def forward_fn(params, *xs):
         acts, _ = _forward_graph(ops, mesh, params, dict(zip(input_ids, xs)), False, None)

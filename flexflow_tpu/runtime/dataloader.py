@@ -91,7 +91,10 @@ class DataLoaderGroup:
         try:
             from .. import native_bridge
 
-            if native_bridge.available():
+            # native path needs at least one whole batch; smaller datasets
+            # use the Python wrap-around semantics below
+            if (native_bridge.available()
+                    and loaders[0].num_samples >= loaders[0].batch_size):
                 self._native = native_bridge.NativeLoader(
                     [l.data for l in loaders],
                     loaders[0].batch_size,

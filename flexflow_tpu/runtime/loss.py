@@ -20,24 +20,29 @@ from ..ffconst import LossType
 
 
 def compute_loss(
-    loss_type: LossType, logits: jnp.ndarray, labels: jnp.ndarray
+    loss_type: LossType, logits: jnp.ndarray, labels: jnp.ndarray,
+    from_logits: bool = False,
 ) -> jnp.ndarray:
     """Return scalar loss (mean over batch).
 
     ``logits`` is the final op's output. For the cross-entropy losses the
     final op is conventionally a Softmax (as in the reference, where
-    Loss::backward peels the softmax — loss_functions.cc); we therefore
-    treat the input as *probabilities* when the final op is softmax and
-    use a numerically-safe log.
+    Loss::backward peels the softmax — loss_functions.cc); the compiler
+    passes ``from_logits=True`` when the graph does NOT end in a softmax,
+    in which case a fused log-softmax is applied here instead — raw logits
+    through the probability path would be clipped into [1e-10, 1] and the
+    gradient destroyed.
     """
     if loss_type is LossType.SPARSE_CATEGORICAL_CROSSENTROPY:
         labels = labels.reshape(labels.shape[0], -1)[:, 0].astype(jnp.int32)
-        probs = jnp.clip(logits, 1e-10, 1.0)
-        ll = jnp.take_along_axis(jnp.log(probs), labels[:, None], axis=-1)
+        logp = (jax.nn.log_softmax(logits, axis=-1) if from_logits
+                else jnp.log(jnp.clip(logits, 1e-10, 1.0)))
+        ll = jnp.take_along_axis(logp, labels[:, None], axis=-1)
         return -jnp.mean(ll)
     if loss_type is LossType.CATEGORICAL_CROSSENTROPY:
-        probs = jnp.clip(logits, 1e-10, 1.0)
-        return -jnp.mean(jnp.sum(labels * jnp.log(probs), axis=-1))
+        logp = (jax.nn.log_softmax(logits, axis=-1) if from_logits
+                else jnp.log(jnp.clip(logits, 1e-10, 1.0)))
+        return -jnp.mean(jnp.sum(labels * logp, axis=-1))
     if loss_type is LossType.MEAN_SQUARED_ERROR_AVG_REDUCE:
         # mean over batch*features (reference: loss_functions.cc AVG_REDUCE
         # scale_factor = 2/volume)

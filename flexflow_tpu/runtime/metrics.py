@@ -14,6 +14,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Dict, List
 
+import jax
 import jax.numpy as jnp
 
 from ..ffconst import LossType, MetricsType
@@ -67,11 +68,20 @@ def compute_batch_metrics(
     loss_type: LossType,
     logits: jnp.ndarray,
     labels: jnp.ndarray,
+    from_logits: bool = False,
 ) -> Dict[str, jnp.ndarray]:
     """Per-batch metric computation (reference: Metrics::compute kernels,
-    src/metrics_functions/metrics_functions.cu). Runs inside jit."""
+    src/metrics_functions/metrics_functions.cu). Runs inside jit.
+    ``from_logits`` mirrors compute_loss: True when the graph does not end
+    in a softmax."""
     out: Dict[str, jnp.ndarray] = {"count": jnp.asarray(logits.shape[0])}
     sparse = loss_type is LossType.SPARSE_CATEGORICAL_CROSSENTROPY
+
+    def _logp():
+        if from_logits:
+            return jax.nn.log_softmax(logits, axis=-1)
+        return jnp.log(jnp.clip(logits, 1e-10, 1.0))
+
     if MetricsType.ACCURACY in metrics:
         pred = jnp.argmax(logits, axis=-1)
         if sparse:
@@ -81,13 +91,11 @@ def compute_batch_metrics(
         out["correct"] = jnp.sum(pred == true)
     if MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY in metrics and sparse:
         lab = labels.reshape(labels.shape[0], -1)[:, 0].astype(jnp.int32)
-        probs = jnp.clip(logits, 1e-10, 1.0)
         out["sparse_cce_loss"] = -jnp.sum(
-            jnp.take_along_axis(jnp.log(probs), lab[:, None], axis=-1)
+            jnp.take_along_axis(_logp(), lab[:, None], axis=-1)
         )
     if MetricsType.CATEGORICAL_CROSSENTROPY in metrics and not sparse:
-        probs = jnp.clip(logits, 1e-10, 1.0)
-        out["cce_loss"] = -jnp.sum(labels * jnp.log(probs))
+        out["cce_loss"] = -jnp.sum(labels * _logp())
     if MetricsType.MEAN_SQUARED_ERROR in metrics:
         out["mse_loss"] = jnp.sum((logits - labels) ** 2)
     if MetricsType.ROOT_MEAN_SQUARED_ERROR in metrics:

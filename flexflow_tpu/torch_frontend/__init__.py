@@ -1,0 +1,13 @@
+"""PyTorch frontend: torch.fx trace → IR file → FFModel replay.
+
+TPU-native equivalent of the reference's ``flexflow.torch``
+(reference: python/flexflow/torch/model.py — ``symbolic_trace`` at
+model.py:2444, 60+ per-node IR classes serialized to a ``.ff`` IR file via
+``torch_to_file`` model.py:2597, replayed onto FFModel by
+``PyTorchModel.apply``). Same serialize→replay design; the IR here is
+JSON-lines instead of the reference's positional strings.
+"""
+
+from .model import PyTorchModel, torch_to_flexflow
+
+__all__ = ["PyTorchModel", "torch_to_flexflow"]

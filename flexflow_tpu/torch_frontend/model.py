@@ -1,0 +1,433 @@
+"""torch.fx → flexflow_tpu importer.
+
+Mirrors the reference's design (reference: python/flexflow/torch/model.py):
+``torch.fx.symbolic_trace`` walks the module into a node list; each node is
+lowered to a serializable IR record; the IR replays onto an ``FFModel``
+through its builder API (``PyTorchModel.apply``). Weights can be copied
+post-compile with :func:`copy_weights` (layout transposes handled here).
+
+IR format: JSON lines, one record per fx node:
+    {"name": ..., "kind": "module|function|input|output",
+     "op": <builder op>, "inputs": [...], "attrs": {...}}
+"""
+
+from __future__ import annotations
+
+import json
+import operator
+from typing import Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..ffconst import ActiMode, AggrMode, DataType, PoolType
+
+
+def _pair(v):
+    return tuple(v) if isinstance(v, (tuple, list)) else (v, v)
+
+
+# --------------------------------------------------------------------- trace
+def _module_record(name, mod, inputs):
+    import torch.nn as nn
+
+    a: Dict = {}
+    if isinstance(mod, nn.Linear):
+        op = "dense"
+        a = dict(out_dim=mod.out_features, use_bias=mod.bias is not None)
+    elif isinstance(mod, nn.Conv2d):
+        op = "conv2d"
+        kh, kw = _pair(mod.kernel_size)
+        sh, sw = _pair(mod.stride)
+        ph, pw = _pair(mod.padding)
+        a = dict(out_channels=mod.out_channels, kernel=(kh, kw),
+                 stride=(sh, sw), padding=(ph, pw), groups=mod.groups,
+                 use_bias=mod.bias is not None)
+    elif isinstance(mod, (nn.MaxPool2d, nn.AvgPool2d)):
+        op = "pool2d"
+        kh, kw = _pair(mod.kernel_size)
+        sh, sw = _pair(mod.stride if mod.stride is not None else mod.kernel_size)
+        ph, pw = _pair(mod.padding)
+        a = dict(kernel=(kh, kw), stride=(sh, sw), padding=(ph, pw),
+                 pool_type="MAX" if isinstance(mod, nn.MaxPool2d) else "AVG")
+    elif isinstance(mod, nn.BatchNorm2d):
+        op = "batch_norm"
+        a = dict(relu=False)
+    elif isinstance(mod, nn.LayerNorm):
+        op = "layer_norm"
+        a = dict(axes=list(range(-len(mod.normalized_shape), 0)),
+                 elementwise_affine=mod.elementwise_affine,
+                 eps=mod.eps)
+    elif isinstance(mod, nn.Dropout):
+        op = "dropout"
+        a = dict(rate=mod.p)
+    elif isinstance(mod, nn.Embedding):
+        op = "embedding"
+        a = dict(num_entries=mod.num_embeddings, out_dim=mod.embedding_dim)
+    elif isinstance(mod, nn.ReLU):
+        op = "relu"
+    elif isinstance(mod, nn.GELU):
+        op = "gelu"
+    elif isinstance(mod, nn.Sigmoid):
+        op = "sigmoid"
+    elif isinstance(mod, nn.Tanh):
+        op = "tanh"
+    elif isinstance(mod, nn.ELU):
+        op = "elu"
+    elif isinstance(mod, nn.Softmax):
+        op = "softmax"
+        a = dict(axis=mod.dim if mod.dim is not None else -1)
+    elif isinstance(mod, nn.Flatten):
+        op = "flat"
+        if mod.start_dim != 1 or mod.end_dim != -1:
+            raise ValueError(f"unsupported Flatten({mod.start_dim},{mod.end_dim})")
+    elif isinstance(mod, nn.Identity):
+        op = "identity"
+    elif isinstance(mod, nn.MultiheadAttention):
+        raise ValueError(
+            "nn.MultiheadAttention cannot be fx-traced generically; build it "
+            "with FFModel.multihead_attention (the reference's torch "
+            "frontend has the same restriction)"
+        )
+    else:
+        raise ValueError(f"unsupported module at {name}: {type(mod).__name__}")
+    return {"name": name, "kind": "module", "op": op, "inputs": inputs,
+            "attrs": a, "module": True}
+
+
+_UNARY_FN = {
+    "relu": "relu", "gelu": "gelu", "sigmoid": "sigmoid", "tanh": "tanh",
+    "exp": "exp", "sin": "sin", "cos": "cos", "rsqrt": "rsqrt",
+}
+
+_BINARY_FN = {
+    operator.add: "add", operator.sub: "subtract", operator.mul: "multiply",
+    operator.truediv: "divide",
+}
+_BINARY_SCALAR = {
+    operator.add: "scalar_add", operator.sub: "scalar_sub",
+    operator.mul: "scalar_multiply", operator.truediv: "scalar_true_divide",
+}
+
+
+def _node_arg(a):
+    import torch.fx as fx
+
+    if isinstance(a, fx.Node):
+        return {"ref": a.name}
+    if isinstance(a, (tuple, list)):
+        return [_node_arg(x) for x in a]
+    return a
+
+
+def _trace(module) -> List[Dict]:
+    import torch
+    import torch.fx as fx
+    import torch.nn.functional as F
+
+    gm = fx.symbolic_trace(module)
+    records: List[Dict] = []
+    outputs: List[str] = []
+    for node in gm.graph.nodes:
+        if node.op == "placeholder":
+            records.append({"name": node.name, "kind": "input", "op": "input",
+                            "inputs": [], "attrs": {}})
+        elif node.op == "call_module":
+            mod = gm.get_submodule(node.target)
+            ins = [a.name for a in node.args if isinstance(a, fx.Node)]
+            rec = _module_record(node.name, mod, ins)
+            rec["module_path"] = node.target
+            records.append(rec)
+        elif node.op == "call_function" or node.op == "call_method":
+            records.append(_function_record(node, torch, F))
+        elif node.op == "get_attr":
+            raise ValueError(
+                f"get_attr node {node.target}: free tensors are not "
+                f"importable; wrap them in a module"
+            )
+        elif node.op == "output":
+            def _flat(a):
+                if isinstance(a, fx.Node):
+                    outputs.append(a.name)
+                elif isinstance(a, (tuple, list)):
+                    for x in a:
+                        _flat(x)
+            _flat(node.args)
+    records.append({"name": "__outputs__", "kind": "output", "op": "output",
+                    "inputs": outputs, "attrs": {}})
+    return records
+
+
+def _function_record(node, torch, F) -> Dict:
+    import torch.fx as fx
+
+    tgt = node.target
+    name = node.name
+    args = node.args
+
+    def rec(op, inputs, attrs=None):
+        return {"name": name, "kind": "function", "op": op,
+                "inputs": inputs, "attrs": attrs or {}}
+
+    def is_node(a):
+        return isinstance(a, fx.Node)
+
+    # method calls arrive as strings
+    if node.op == "call_method":
+        m = tgt
+        self_arg = args[0].name
+        if m in _UNARY_FN:
+            return rec(_UNARY_FN[m], [self_arg])
+        if m in ("view", "reshape"):
+            shape = [a for a in args[1:]]
+            if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+                shape = list(shape[0])
+            if any(is_node(s) for s in shape[1:]) or (
+                len(shape) == 2 and shape[1] == -1
+            ):
+                # x.view(x.size(0), -1) and friends → flatten
+                return rec("flat", [self_arg])
+            return rec("reshape", [self_arg], {"shape": [int(s) for s in shape]})
+        if m == "flatten":
+            return rec("flat", [self_arg])
+        if m in ("transpose",):
+            return rec("transpose2", [self_arg],
+                       {"dims": [int(args[1]), int(args[2])]})
+        if m == "permute":
+            perm = args[1:] if not isinstance(args[1], (tuple, list)) else args[1]
+            return rec("transpose", [self_arg], {"perm": [int(p) for p in perm]})
+        if m == "size" or m == "dim":
+            return rec("size", [self_arg], {"args": [a for a in args[1:]
+                                                    if not is_node(a)]})
+        if m == "contiguous" or m == "clone" or m == "detach":
+            return rec("identity", [self_arg])
+        if m == "softmax":
+            return rec("softmax", [self_arg], {"axis": int(args[1])})
+        if m == "mean":
+            dims = args[1] if len(args) > 1 else None
+            dims = [dims] if isinstance(dims, int) else list(dims or [])
+            return rec("mean", [self_arg],
+                       {"dims": dims, "keepdims": bool(node.kwargs.get("keepdim", False))})
+        raise ValueError(f"unsupported method: {m}")
+
+    # binary arithmetic (tensor-tensor or tensor-scalar)
+    if tgt in _BINARY_FN or tgt in (torch.add, torch.sub, torch.mul, torch.div):
+        fn_map = {torch.add: operator.add, torch.sub: operator.sub,
+                  torch.mul: operator.mul, torch.div: operator.truediv}
+        base = fn_map.get(tgt, tgt)
+        a, b = args[0], args[1]
+        if is_node(a) and is_node(b):
+            return rec(_BINARY_FN[base], [a.name, b.name])
+        if is_node(a):
+            return rec(_BINARY_SCALAR[base], [a.name], {"scalar": float(b)})
+        # scalar op tensor: only add/mul commute
+        if base in (operator.add, operator.mul):
+            return rec(_BINARY_SCALAR[base], [b.name], {"scalar": float(a)})
+        raise ValueError(f"unsupported scalar-tensor {base}")
+    if tgt in (F.relu, torch.relu):
+        return rec("relu", [args[0].name])
+    if tgt is F.gelu:
+        return rec("gelu", [args[0].name])
+    if tgt in (torch.sigmoid, F.sigmoid):
+        return rec("sigmoid", [args[0].name])
+    if tgt in (torch.tanh, F.tanh):
+        return rec("tanh", [args[0].name])
+    if tgt in (torch.exp,):
+        return rec("exp", [args[0].name])
+    if tgt is F.softmax or tgt is torch.softmax:
+        axis = node.kwargs.get("dim", args[1] if len(args) > 1 else -1)
+        return rec("softmax", [args[0].name], {"axis": int(axis)})
+    if tgt is F.dropout:
+        return rec("dropout", [args[0].name],
+                   {"rate": float(node.kwargs.get("p", args[1] if len(args) > 1 else 0.5))})
+    if tgt in (torch.flatten,):
+        return rec("flat", [args[0].name])
+    if tgt in (torch.cat,):
+        tensors = args[0]
+        axis = node.kwargs.get("dim", args[1] if len(args) > 1 else 0)
+        return rec("concat", [t.name for t in tensors], {"axis": int(axis)})
+    if tgt in (torch.split,):
+        sizes = args[1]
+        axis = int(node.kwargs.get("dim", args[2] if len(args) > 2 else 0))
+        sizes = list(sizes) if isinstance(sizes, (tuple, list)) else int(sizes)
+        return rec("split", [args[0].name], {"sizes": sizes, "axis": axis})
+    if tgt in (torch.matmul, torch.bmm):
+        return rec("batch_matmul", [args[0].name, args[1].name])
+    if tgt in (torch.reshape,):
+        return rec("reshape", [args[0].name], {"shape": [int(s) for s in args[1]]})
+    if tgt in (torch.transpose,):
+        return rec("transpose2", [args[0].name],
+                   {"dims": [int(args[1]), int(args[2])]})
+    if tgt in (torch.mean,):
+        dims = args[1] if len(args) > 1 else node.kwargs.get("dim")
+        dims = [dims] if isinstance(dims, int) else list(dims or [])
+        return rec("mean", [args[0].name],
+                   {"dims": dims, "keepdims": bool(node.kwargs.get("keepdim", False))})
+    if tgt is operator.getitem:
+        return rec("getitem", [args[0].name], {"index": int(args[1])})
+    raise ValueError(f"unsupported function: {tgt}")
+
+
+# -------------------------------------------------------------------- replay
+class PyTorchModel:
+    """reference: PyTorchModel (python/flexflow/torch/model.py:2408).
+
+    Construct from a live ``torch.nn.Module`` or a serialized IR file path;
+    ``apply(ffmodel, input_tensors)`` replays the graph through FFModel's
+    builder and returns the output Tensors.
+    """
+
+    def __init__(self, model_or_path: Union[str, "object"]):
+        if isinstance(model_or_path, str):
+            with open(model_or_path) as f:
+                self.ir = [json.loads(line) for line in f if line.strip()]
+            self.module = None
+        else:
+            self.module = model_or_path
+            self.ir = _trace(model_or_path)
+
+    def torch_to_file(self, path: str) -> None:
+        """reference: torch_to_file (model.py:2597)."""
+        with open(path, "w") as f:
+            for r in self.ir:
+                f.write(json.dumps(r) + "\n")
+
+    # -- replay ---------------------------------------------------------- #
+    def apply(self, ffmodel, input_tensors: Sequence) -> List:
+        env: Dict[str, object] = {}
+        outputs: List = []
+        it = iter(input_tensors)
+        self.layer_names: Dict[str, str] = {}  # fx node -> FF layer name
+        for r in self.ir:
+            op, name, ins = r["op"], r["name"], r["inputs"]
+            a = dict(r["attrs"])
+            if r["kind"] == "input":
+                env[name] = next(it)
+                continue
+            if r["kind"] == "output":
+                outputs = [env[i] for i in ins]
+                continue
+            x = [env[i] for i in ins]
+            out = self._emit(ffmodel, op, name, x, a, env)
+            env[name] = out
+        return outputs
+
+    def _emit(self, ff, op, name, x, a, env):
+        self.layer_names[name] = name
+        if op == "dense":
+            act = ActiMode.NONE
+            return ff.dense(x[0], a["out_dim"], activation=act,
+                            use_bias=a.get("use_bias", True), name=name)
+        if op == "conv2d":
+            k, s, p = a["kernel"], a["stride"], a["padding"]
+            return ff.conv2d(x[0], a["out_channels"], k[0], k[1], s[0], s[1],
+                             p[0], p[1], groups=a.get("groups", 1),
+                             use_bias=a.get("use_bias", True), name=name)
+        if op == "pool2d":
+            k, s, p = a["kernel"], a["stride"], a["padding"]
+            pt = PoolType.MAX if a["pool_type"] == "MAX" else PoolType.AVG
+            return ff.pool2d(x[0], k[0], k[1], s[0], s[1], p[0], p[1],
+                             pool_type=pt, name=name)
+        if op == "batch_norm":
+            return ff.batch_norm(x[0], relu=a.get("relu", False), name=name)
+        if op == "layer_norm":
+            return ff.layer_norm(x[0], axes=a.get("axes", [-1]),
+                                 elementwise_affine=a.get("elementwise_affine", True),
+                                 eps=a.get("eps", 1e-5), name=name)
+        if op == "dropout":
+            return ff.dropout(x[0], rate=a.get("rate", 0.5), name=name)
+        if op == "embedding":
+            return ff.embedding(x[0], a["num_entries"], a["out_dim"],
+                                aggr=AggrMode.NONE, name=name)
+        if op in ("relu", "gelu", "sigmoid", "tanh", "elu", "exp", "sin",
+                  "cos", "rsqrt", "identity"):
+            return getattr(ff, op)(x[0], name=name)
+        if op == "softmax":
+            return ff.softmax(x[0], axis=a.get("axis", -1), name=name)
+        if op == "flat":
+            return ff.flat(x[0], name=name)
+        if op == "reshape":
+            shape = a["shape"]
+            if any(s == -1 for s in shape):
+                known = int(np.prod([s for s in shape if s != -1]))
+                total = int(np.prod(x[0].dims))
+                shape = [total // known if s == -1 else s for s in shape]
+            return ff.reshape(x[0], shape, name=name)
+        if op == "transpose":
+            return ff.transpose(x[0], a["perm"], name=name)
+        if op == "transpose2":
+            nd = len(x[0].dims)
+            d0, d1 = [d % nd for d in a["dims"]]
+            perm = list(range(nd))
+            perm[d0], perm[d1] = perm[d1], perm[d0]
+            return ff.transpose(x[0], perm, name=name)
+        if op == "mean":
+            return ff.mean(x[0], a["dims"], keepdims=a.get("keepdims", False),
+                           name=name)
+        if op in ("add", "subtract", "multiply", "divide"):
+            return getattr(ff, op)(x[0], x[1], name=name)
+        if op in ("scalar_add", "scalar_sub", "scalar_multiply",
+                  "scalar_true_divide"):
+            return getattr(ff, op)(x[0], a["scalar"], name=name)
+        if op == "concat":
+            return ff.concat(x, axis=a["axis"], name=name)
+        if op == "split":
+            return ff.split(x[0], a["sizes"], axis=a["axis"], name=name)
+        if op == "batch_matmul":
+            return ff.batch_matmul(x[0], x[1], name=name)
+        if op == "getitem":
+            return x[0][a["index"]]
+        if op == "size":
+            raise ValueError(
+                "tensor.size() feeding anything but view/reshape is not "
+                "importable (shapes are static under XLA)"
+            )
+        raise ValueError(f"unknown IR op {op}")
+
+
+def torch_to_flexflow(module, path: str) -> PyTorchModel:
+    """reference: fx.torch_to_flexflow (python/flexflow/torch/fx.py) —
+    trace and serialize in one step."""
+    m = PyTorchModel(module)
+    m.torch_to_file(path)
+    return m
+
+
+def copy_weights(ffmodel, torch_module, layer_names: Optional[Dict[str, str]] = None):
+    """Copy a traced module's parameters into the compiled FFModel
+    (post-``compile``). Layout mapping: torch Linear stores (out, in) →
+    FF kernel (in, out); Conv2d OIHW matches; Embedding matches.
+    """
+    import torch
+
+    name_of = {}  # FF layer name -> torch submodule
+    gm_modules = dict(torch_module.named_modules())
+    for layer in ffmodel.layers:
+        if layer.name in gm_modules:
+            name_of[layer.name] = gm_modules[layer.name]
+        else:
+            # fx node names flatten '.' to '_'
+            for path, mod in gm_modules.items():
+                if path.replace(".", "_") == layer.name:
+                    name_of[layer.name] = mod
+                    break
+    for layer in ffmodel.layers:
+        mod = name_of.get(layer.name)
+        if mod is None or not layer.weights:
+            continue
+        wmap = {p.name.split("/")[-1]: p for p in layer.weights}
+        with torch.no_grad():
+            if isinstance(mod, torch.nn.Linear):
+                wmap["kernel"].set_weights(ffmodel, mod.weight.numpy().T)
+                if "bias" in wmap and mod.bias is not None:
+                    wmap["bias"].set_weights(ffmodel, mod.bias.numpy())
+            elif isinstance(mod, torch.nn.Conv2d):
+                wmap["kernel"].set_weights(ffmodel, mod.weight.numpy())
+                if "bias" in wmap and mod.bias is not None:
+                    wmap["bias"].set_weights(ffmodel, mod.bias.numpy())
+            elif isinstance(mod, torch.nn.Embedding):
+                wmap["weight"].set_weights(ffmodel, mod.weight.numpy())
+            elif isinstance(mod, (torch.nn.LayerNorm, torch.nn.BatchNorm2d)):
+                if "scale" in wmap and getattr(mod, "weight", None) is not None:
+                    wmap["scale"].set_weights(ffmodel, mod.weight.numpy())
+                if "bias" in wmap and getattr(mod, "bias", None) is not None:
+                    wmap["bias"].set_weights(ffmodel, mod.bias.numpy())

@@ -166,6 +166,7 @@ void reset_impl(fftpu_loader *L, F &&apply_perm) {
 }  // namespace
 
 extern "C" void fftpu_loader_reset(fftpu_loader *L, int32_t reshuffle) {
+  if (!L) return;
   reset_impl(L, [&] {
     if (L->shuffle && reshuffle)
       std::shuffle(L->perm.begin(), L->perm.end(), L->rng);
@@ -174,6 +175,7 @@ extern "C" void fftpu_loader_reset(fftpu_loader *L, int32_t reshuffle) {
 
 extern "C" void fftpu_loader_reset_with_perm(fftpu_loader *L,
                                              const int64_t *perm) {
+  if (!L) return;
   reset_impl(L, [&] {
     if (perm)
       std::copy(perm, perm + L->num_samples, L->perm.begin());
@@ -181,6 +183,7 @@ extern "C" void fftpu_loader_reset_with_perm(fftpu_loader *L,
 }
 
 extern "C" int64_t fftpu_loader_next(fftpu_loader *L, void *const *outs) {
+  if (!L || !outs) return -1;
   std::unique_lock<std::mutex> lk(L->mu);
   if (L->next_consume >= L->num_batches) return -1;
   int64_t b = L->next_consume;

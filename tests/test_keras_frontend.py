@@ -1,0 +1,94 @@
+"""Keras frontend tests (reference analog: tests/multi_gpu_tests.sh keras
+sequential/functional scripts + examples/python/keras/accuracy.py
+convergence gates — SURVEY.md §4)."""
+
+import numpy as np
+
+from flexflow_tpu.keras import (
+    Adam,
+    Add,
+    Concatenate,
+    Conv2D,
+    Dense,
+    Flatten,
+    Input,
+    MaxPooling2D,
+    Model,
+    Sequential,
+    SGD,
+)
+
+
+def _toy_classification(n=256, d=16, classes=5, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=(d, classes)).astype(np.float32)
+    y = np.argmax(x @ w, axis=1).astype(np.int32).reshape(-1, 1)
+    return x, y
+
+
+def test_sequential_mlp_trains():
+    x, y = _toy_classification()
+    model = Sequential([
+        Dense(64, activation="relu", input_shape=(16,)),
+        Dense(5),
+    ])
+    model.compile(optimizer=Adam(learning_rate=0.01),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    hist = model.fit(x, y, epochs=12, batch_size=32)
+    assert hist[-1].accuracy > 0.7, hist[-1].accuracy
+
+
+def test_sequential_cnn_builds_and_trains():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 1, 8, 8)).astype(np.float32)
+    y = rng.integers(0, 3, size=(64, 1)).astype(np.int32)
+    model = Sequential()
+    model.add(Conv2D(4, 3, padding="same", activation="relu",
+                     input_shape=(1, 8, 8)))
+    model.add(MaxPooling2D(2))
+    model.add(Flatten())
+    model.add(Dense(3))
+    model.compile(optimizer=SGD(learning_rate=0.01),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    hist = model.fit(x, y, epochs=2, batch_size=16)
+    assert len(hist) == 2
+    assert model.ffmodel is not None
+
+
+def test_functional_two_branch_model():
+    rng = np.random.default_rng(1)
+    xa = rng.normal(size=(96, 8)).astype(np.float32)
+    xb = rng.normal(size=(96, 8)).astype(np.float32)
+    y = (np.sum(xa - xb, axis=1) > 0).astype(np.int32).reshape(-1, 1)
+
+    ia, ib = Input((8,)), Input((8,))
+    ha = Dense(16, activation="relu")(ia)
+    hb = Dense(16, activation="relu")(ib)
+    merged = Concatenate(axis=-1)([ha, hb])
+    out = Dense(2)(merged)
+    model = Model(inputs=[ia, ib], outputs=out)
+    model.compile(optimizer=Adam(learning_rate=0.02),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    hist = model.fit([xa, xb], y, epochs=15, batch_size=32)
+    assert hist[-1].accuracy > 0.7, hist[-1].accuracy
+
+
+def test_residual_add_and_predict():
+    x, y = _toy_classification(n=64, d=12, classes=3, seed=2)
+    i = Input((12,))
+    h = Dense(12, activation="relu")(i)
+    h = Add()([h, i])
+    out = Dense(3)(h)
+    model = Model(inputs=i, outputs=out)
+    model.compile(optimizer="sgd", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    model.fit(x, y, epochs=1, batch_size=16)
+    preds = model.predict(x, batch_size=16)
+    assert preds.shape == (64, 3)
+    assert np.isfinite(preds).all()
+    ev = model.evaluate(x, y)
+    assert 0.0 <= ev.accuracy <= 1.0

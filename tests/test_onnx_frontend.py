@@ -1,0 +1,41 @@
+"""ONNX frontend tests (reference analog: examples/python/onnx). The onnx
+package is not bundled here, so the full walker only runs where onnx is
+installed; the import gate is always tested."""
+
+import numpy as np
+import pytest
+
+
+def test_onnx_import_gate():
+    try:
+        import onnx  # noqa: F401
+
+        have_onnx = True
+    except ImportError:
+        have_onnx = False
+    if have_onnx:
+        pytest.skip("onnx present; gate path not reachable")
+    from flexflow_tpu.onnx_frontend import ONNXModel
+
+    with pytest.raises(ImportError, match="onnx"):
+        ONNXModel("nonexistent.onnx")
+
+
+@pytest.mark.skipif(
+    not pytest.importorskip("importlib").util.find_spec("onnx"),
+    reason="onnx not installed",
+)
+def test_onnx_mlp_roundtrip(tmp_path):
+    import torch
+    import torch.nn as nn
+
+    from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+    from flexflow_tpu.onnx_frontend import ONNXModel
+
+    mod = nn.Sequential(nn.Linear(10, 16), nn.ReLU(), nn.Linear(16, 4))
+    p = str(tmp_path / "m.onnx")
+    torch.onnx.export(mod, torch.zeros(4, 10), p)
+    ff = FFModel(FFConfig(batch_size=4))
+    x = ff.create_tensor((4, 10), name="input")
+    (out,) = ONNXModel(p).apply(ff, [x])
+    assert out.dims == (4, 4)

@@ -1,0 +1,100 @@
+"""torch.fx importer tests (reference analog: tests/align mt5/operator
+alignment vs torch, SURVEY.md §4 — here the imported FF graph's forward is
+compared against the torch module itself)."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn as nn  # noqa: E402
+
+from flexflow_tpu import FFConfig, FFModel, LossType, MetricsType, SGDOptimizer  # noqa: E402
+from flexflow_tpu.torch_frontend import PyTorchModel, torch_to_flexflow  # noqa: E402
+from flexflow_tpu.torch_frontend.model import copy_weights  # noqa: E402
+
+
+class SmallMLP(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(20, 32)
+        self.act = nn.ReLU()
+        self.fc2 = nn.Linear(32, 5)
+
+    def forward(self, x):
+        h = self.act(self.fc1(x))
+        h = h + 0.5
+        return self.fc2(h)
+
+
+class SmallCNN(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.conv1 = nn.Conv2d(1, 4, 3, padding=1)
+        self.pool = nn.MaxPool2d(2, 2)
+        self.flatten = nn.Flatten()
+        self.fc = nn.Linear(4 * 4 * 4, 3)
+
+    def forward(self, x):
+        h = torch.relu(self.conv1(x))
+        h = self.pool(h)
+        h = self.flatten(h)
+        return self.fc(h)
+
+
+def _import_and_forward(module, x_np, bs):
+    ff = FFModel(FFConfig(batch_size=bs, seed=0))
+    xin = ff.create_tensor(x_np.shape, name="input")
+    m = PyTorchModel(module)
+    (out,) = m.apply(ff, [xin])
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type=LossType.MEAN_SQUARED_ERROR_AVG_REDUCE, metrics=[])
+    copy_weights(ff, module)
+    cm = ff.compiled
+    y = cm.raw_forward(cm.params, x_np)
+    return ff, np.asarray(y)
+
+
+def test_mlp_import_matches_torch():
+    torch.manual_seed(0)
+    mod = SmallMLP().eval()
+    x = np.random.default_rng(0).normal(size=(8, 20)).astype(np.float32)
+    ff, got = _import_and_forward(mod, x, 8)
+    want = mod(torch.from_numpy(x)).detach().numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_cnn_import_matches_torch():
+    torch.manual_seed(1)
+    mod = SmallCNN().eval()
+    x = np.random.default_rng(1).normal(size=(4, 1, 8, 8)).astype(np.float32)
+    ff, got = _import_and_forward(mod, x, 4)
+    want = mod(torch.from_numpy(x)).detach().numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_ir_file_roundtrip(tmp_path):
+    mod = SmallMLP()
+    p = str(tmp_path / "model.ff")
+    torch_to_flexflow(mod, p)
+    m2 = PyTorchModel(p)  # replay from file, no torch module needed
+    ff = FFModel(FFConfig(batch_size=8, seed=0))
+    xin = ff.create_tensor((8, 20), name="input")
+    (out,) = m2.apply(ff, [xin])
+    assert out.dims == (8, 5)
+    assert any(l.name == "fc1" for l in ff.layers)
+
+
+def test_imported_model_trains():
+    mod = SmallMLP()
+    ff = FFModel(FFConfig(batch_size=16, epochs=10, seed=0))
+    xin = ff.create_tensor((16, 20), name="input")
+    (out,) = PyTorchModel(mod).apply(ff, [xin])
+    ff.compile(optimizer=SGDOptimizer(lr=0.05),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=[MetricsType.ACCURACY])
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(128, 20)).astype(np.float32)
+    w = rng.normal(size=(20, 5)).astype(np.float32)
+    y = np.argmax(x @ w, axis=1).astype(np.int32).reshape(-1, 1)
+    hist = ff.fit(x, y, verbose=False)
+    assert hist[-1].accuracy > hist[0].accuracy
