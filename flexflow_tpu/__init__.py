@@ -42,6 +42,7 @@ from .ops import (  # noqa: F401
     element_binary,
     element_unary,
     embedding,
+    fused,
     linear,
     moe_ops,
     norm,

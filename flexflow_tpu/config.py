@@ -60,6 +60,7 @@ class FFConfig:
     substitution_json_path: Optional[str] = None
     machine_model_file: Optional[str] = None
     export_strategy_file: Optional[str] = None
+    export_strategy_task_graph_file: Optional[str] = None
     import_strategy_file: Optional[str] = None
     export_strategy_computation_graph_file: Optional[str] = None
     include_costs_dot_graph: bool = False
@@ -134,7 +135,7 @@ class FFConfig:
             elif a == "--import-strategy":
                 cfg.import_strategy_file = _next()
             elif a == "--taskgraph":
-                cfg.export_strategy_file = _next()
+                cfg.export_strategy_task_graph_file = _next()
             elif a == "--compgraph":
                 cfg.export_strategy_computation_graph_file = _next()
             elif a == "--include-costs-dot-graph":

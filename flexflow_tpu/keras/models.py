@@ -58,10 +58,16 @@ class _BaseModel:
 
     def fit(self, x, y, epochs: int = 1, batch_size: int = 32,
             shuffle: bool = True, verbose: bool = False):
-        """reference: BaseModel.fit (base_model.py:198)."""
+        """reference: BaseModel.fit (base_model.py:198). A changed
+        batch_size forces a rebuild (the graph is compiled batch-first);
+        epochs is honored on every call."""
         xs = x if isinstance(x, (list, tuple)) else [x]
+        if (self.ffmodel is not None
+                and self.ffmodel.config.batch_size != batch_size):
+            self.ffmodel = None
         self._build(xs, batch_size, epochs)
-        return self.ffmodel.fit(list(xs), y, shuffle=shuffle, verbose=verbose)
+        return self.ffmodel.fit(list(xs), y, epochs=epochs, shuffle=shuffle,
+                                verbose=verbose)
 
     def evaluate(self, x, y, batch_size: int = 32, verbose: bool = False):
         xs = x if isinstance(x, (list, tuple)) else [x]
@@ -89,7 +95,7 @@ class _BaseModel:
                     )
                     for b in batch
                 ]
-            out = np.asarray(cm.raw_forward(cm.params, *batch))
+            out = np.asarray(cm.forward_fn(cm.params, *batch))
             outs.append(out[:valid])
         return np.concatenate(outs, axis=0)
 

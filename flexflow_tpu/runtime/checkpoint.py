@@ -1,0 +1,142 @@
+"""Checkpoint / resume.
+
+The reference has NO checkpointing subsystem (SURVEY.md §5: weights are
+pulled/pushed through numpy inline mappings —
+``Parameter.get_weights/set_weights``, flexflow_cffi.py:664-875 — and the
+examples roll their own save/load). This module makes it first-class the
+way SURVEY.md §7 prescribes (Orbax-style): sharded params/optimizer state
+are saved from device without gathering to one host, and restored directly
+into the compiled model's shardings, plus step/rng bookkeeping for exact
+training resume.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+
+class CheckpointManager:
+    """Step-numbered checkpoints with retention (Orbax-backed).
+
+    Usage::
+
+        ckpt = CheckpointManager(dir, max_to_keep=3)
+        ckpt.save(ff, step)
+        step = ckpt.restore(ff)          # latest; or restore(ff, step=N)
+    """
+
+    def __init__(self, directory: str, max_to_keep: Optional[int] = 3):
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self.directory = os.path.abspath(directory)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True
+            ),
+        )
+
+    # ------------------------------------------------------------------ #
+    def save(self, ffmodel, step: int, extra: Optional[Dict[str, Any]] = None,
+             wait: bool = True) -> None:
+        """Save params + optimizer state + iteration counter. ``extra`` is
+        a JSON-serializable dict stored in a sidecar file and handed back
+        by :meth:`restore_extra`."""
+        cm = ffmodel.compiled
+        assert cm is not None, "compile() before saving"
+        ocp = self._ocp
+        state = {
+            "params": cm.params,
+            "opt_state": cm.opt_state,
+            "iteration": np.asarray(cm._iteration, np.int64),
+        }
+        self._mgr.save(step, args=ocp.args.StandardSave(state))
+        if wait:
+            self._mgr.wait_until_finished()
+        if extra is not None:
+            import json
+
+            with open(self._extra_path(step), "w") as f:
+                json.dump(extra, f)
+
+    def _extra_path(self, step: int) -> str:
+        return os.path.join(self.directory, f"extra_{step}.json")
+
+    def restore_extra(self, step: Optional[int] = None) -> Optional[Dict[str, Any]]:
+        """The ``extra`` dict saved alongside a step, or None."""
+        import json
+
+        step = step if step is not None else self._mgr.latest_step()
+        if step is None or not os.path.exists(self._extra_path(step)):
+            return None
+        with open(self._extra_path(step)) as f:
+            return json.load(f)
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def all_steps(self):
+        return list(self._mgr.all_steps())
+
+    def restore(self, ffmodel, step: Optional[int] = None) -> int:
+        """Restore into the compiled model in place, with each leaf placed
+        on its compiled sharding. Returns the restored step."""
+        cm = ffmodel.compiled
+        assert cm is not None, "compile() before restoring"
+        ocp = self._ocp
+        step = step if step is not None else self._mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        mesh = cm.mesh
+
+        def _abstract(x):
+            """Restore target: every leaf lands on the compiled mesh —
+            its own NamedSharding when it already has one, replicated
+            otherwise (fresh opt_state leaves are single-device until the
+            first step; mixing device sets would break the jitted step)."""
+            if isinstance(x, jax.Array):
+                sh = x.sharding
+                if not (isinstance(sh, NamedSharding) and sh.mesh == mesh):
+                    sh = NamedSharding(mesh, PartitionSpec())
+                return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sh)
+            return np.asarray(x)
+
+        target = {
+            "params": jax.tree.map(_abstract, cm.params),
+            "opt_state": jax.tree.map(_abstract, cm.opt_state),
+            "iteration": np.asarray(cm._iteration, np.int64),
+        }
+        restored = self._mgr.restore(step, args=ocp.args.StandardRestore(target))
+        cm.params = restored["params"]
+        cm.opt_state = restored["opt_state"]
+        cm._iteration = int(restored["iteration"])
+        return step
+
+    def close(self) -> None:
+        self._mgr.close()
+
+
+def save_checkpoint(ffmodel, path: str, step: int = 0) -> None:
+    """One-shot convenience (FFModel.save_checkpoint)."""
+    m = CheckpointManager(path, max_to_keep=None)
+    try:
+        m.save(ffmodel, step)
+    finally:
+        m.close()
+
+
+def load_checkpoint(ffmodel, path: str, step: Optional[int] = None) -> int:
+    """One-shot convenience (FFModel.load_checkpoint). Returns the step."""
+    m = CheckpointManager(path, max_to_keep=None)
+    try:
+        return m.restore(ffmodel, step)
+    finally:
+        m.close()

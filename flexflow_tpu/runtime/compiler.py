@@ -132,19 +132,25 @@ def init_params(
     (src/runtime/initializer.cc); here a single jitted init per weight with
     ``out_shardings`` so large weights are born sharded (no host round-trip).
     """
+    import zlib
+
     root = jax.random.key(seed)
     params: Dict[str, Dict[str, jax.Array]] = {}
     shardings: Dict[str, Dict[str, NamedSharding]] = {}
     wd_mask: Dict[str, Dict[str, bool]] = {}
-    for oi, op in enumerate(ops):
+    for op in ops:
         specs = op.weight_specs()
         if not specs:
             continue
         params[op.name] = {}
         shardings[op.name] = {}
         wd_mask[op.name] = {}
+        # key on a stable hash of the op name (not its graph index) so
+        # inits are invariant to graph passes that renumber ops (fusion,
+        # recompile) — the same named layer always draws the same weights
+        op_key = jax.random.fold_in(root, zlib.crc32(op.name.encode()))
         for wi, ws in enumerate(specs):
-            key = jax.random.fold_in(jax.random.fold_in(root, oi), wi)
+            key = jax.random.fold_in(op_key, wi)
             sh = _named_sharding(mesh, op.weight_shapes[ws.name])
             jdtype = dtype_override or ws.dtype.to_jnp()
             init_fn = ws.initializer

@@ -470,6 +470,42 @@ class FFModel:
     def allreduce(self, input: Tensor, name=None) -> Tensor:
         return self._infer_and_add(OpType.ALLREDUCE, [input], {}, name)
 
+    # ---- profiling / graph exports (reference: --profiling, --taskgraph,
+    # --compgraph — SURVEY.md §5 tracing/profiling) ----------------------- #
+    def profile_ops(self, iters: int = 10):
+        from .profiling import profile_ops
+
+        return profile_ops(self, iters=iters)
+
+    def export_computation_graph(self, path: str, include_costs: bool = False) -> None:
+        from .profiling import export_computation_graph
+
+        export_computation_graph(self, path, include_costs)
+
+    def export_task_graph(self, path: str, fmt: str = "dot") -> None:
+        from .profiling import export_task_graph
+
+        export_task_graph(self, path, fmt)
+
+    def profiler_trace(self, logdir: str):
+        """Context manager: jax profiler trace (reference analog: Legion
+        Prof, -lg:prof)."""
+        from .profiling import trace
+
+        return trace(logdir)
+
+    # ---- checkpoint / resume (no reference equivalent — SURVEY.md §5
+    # lists checkpointing as absent upstream; first-class here) ----------- #
+    def save_checkpoint(self, path: str, step: int = 0) -> None:
+        from .checkpoint import save_checkpoint
+
+        save_checkpoint(self, path, step)
+
+    def load_checkpoint(self, path: str, step: Optional[int] = None) -> int:
+        from .checkpoint import load_checkpoint
+
+        return load_checkpoint(self, path, step)
+
     # ---- strategy import/export (reference: --import-strategy /
     # --export-strategy, model.cc:3609-3618, src/runtime/strategy.cc) ------ #
     def export_strategy(self, path: str) -> None:
@@ -551,9 +587,17 @@ class FFModel:
         # record the strategies actually in effect (search-found, imported,
         # or compile(strategies=...)-supplied) so export_strategy sees them
         self._search_strategies = dict(strat)
+        compile_layers = self.layers
+        if self.config.perform_fusion:
+            # reference: the --fusion pass packing adjacent ops
+            # (model.cc:2964-3061); here it shrinks the graph the search
+            # and simulator see — XLA fuses the HLO either way
+            from ..ops.fused import apply_fusion
+
+            compile_layers = apply_fusion(self.layers, {logits.tensor_id})
         self.compiled = compile_model(
             self.config,
-            self.layers,
+            compile_layers,
             self._used_inputs(),
             logits,
             self.optimizer,
@@ -563,6 +607,15 @@ class FFModel:
             mesh=mesh,
             comp_mode=comp_mode,
         )
+        # graph exports requested via flags (reference: --compgraph /
+        # --taskgraph dumps written right after compile, model.cc:3666-3674)
+        if self.config.export_strategy_computation_graph_file:
+            self.export_computation_graph(
+                self.config.export_strategy_computation_graph_file,
+                include_costs=self.config.include_costs_dot_graph,
+            )
+        if self.config.export_strategy_task_graph_file:
+            self.export_task_graph(self.config.export_strategy_task_graph_file)
         # parameter index for get/set weights (recompile-safe: drop stale
         # Parameter handles from a previous compile)
         self._param_index.clear()
@@ -665,6 +718,7 @@ class FFModel:
         epochs: Optional[int] = None,
         shuffle: bool = True,
         verbose: bool = True,
+        recompile_state=None,
     ) -> List[PerfMetrics]:
         assert self.compiled is not None, "call compile() first"
         cm = self.compiled
@@ -693,6 +747,14 @@ class FFModel:
                 pm.update({k: float(v) for k, v in bm.items()})
                 last_loss = loss
                 cm._iteration += 1
+                if recompile_state is not None:
+                    # reference: recompile_on_condition evaluated per
+                    # iteration inside the train loop (model.cc:2422)
+                    from .recompile import recompile_on_condition
+
+                    recompile_state.last_metric = float(loss)
+                    if recompile_on_condition(self, recompile_state):
+                        cm = self.compiled
             if verbose:
                 lv = float(last_loss) if last_loss is not None else float("nan")
                 print(

@@ -1,0 +1,176 @@
+"""Profiling and graph exports.
+
+TPU-native equivalents of the reference's observability surface
+(SURVEY.md §5 "Tracing/profiling"):
+
+* per-op profiling (``--profiling`` → cudaEvent brackets,
+  linear_kernels.cu:95-111) → :func:`profile_ops`: each op's forward is
+  jitted and timed standalone with the compile cached, like the
+  reference's ``measure_operator_cost`` device timing.
+* Legion-level profiling (``-lg:prof``) → :func:`trace`: a context
+  manager around ``jax.profiler`` writing a TensorBoard-loadable trace.
+* ``--compgraph`` (``export_strategy_computation_graph``, graph.h:339) →
+  :func:`export_computation_graph`: dot of the op graph with shardings,
+  optionally cost-annotated (``--include-costs-dot-graph`` parity).
+* ``--taskgraph`` (``export_strategy_task_graph_file``, model.cc:3666) →
+  :func:`export_task_graph`: dot/JSON of the simulator's SimTask graph,
+  transitively reduced (via the native graph library when built).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..utils.dot import DotFile
+
+
+# --------------------------------------------------------------- jax tracing
+@contextlib.contextmanager
+def trace(logdir: str):
+    """Profile a region into a TensorBoard trace (reference analog:
+    Legion Prof via -lg:prof)."""
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+# ----------------------------------------------------------- per-op profiling
+def profile_ops(ffmodel, iters: int = 10, warmup: int = 2) -> List[Dict]:
+    """Time each compiled op's forward standalone (reference: per-op
+    cudaEvent profiling under --profiling, OpMeta::profiling op_meta.h:17).
+    Returns one record per op: name, type, ms, flops, arithmetic intensity.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.op import LowerCtx
+
+    cm = ffmodel.compiled
+    assert cm is not None, "compile() first"
+    rng = np.random.default_rng(0)
+    acts: Dict[int, np.ndarray] = {}
+    for t, sh in zip(cm.input_tensors, cm.input_shardings):
+        arr = rng.normal(size=t.dims).astype(np.float32) \
+            if t.dtype.to_jnp() == jnp.float32 else \
+            rng.integers(0, 2, size=t.dims).astype(np.int32)
+        acts[t.tensor_id] = jax.device_put(arr, sh)
+    records: List[Dict] = []
+    ctx = LowerCtx(mesh=cm.mesh, training=False, rng=None)
+    for op in cm.ops:
+        ins = [acts[t.tensor_id] for t in op.layer.inputs]
+        weights = cm.params.get(op.name, {})
+
+        fwd = jax.jit(lambda ins, weights, _op=op: _op.forward(ctx, ins, weights))
+        outs = fwd(ins, weights)  # compile + fill acts
+        jax.block_until_ready(outs)
+        for _ in range(warmup):
+            outs = fwd(ins, weights)
+        jax.block_until_ready(outs)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            outs = fwd(ins, weights)
+        jax.block_until_ready(outs)
+        ms = (time.perf_counter() - t0) / iters * 1e3
+        for t, o in zip(op.layer.outputs, outs):
+            acts[t.tensor_id] = o
+        fl = op.flops()
+        records.append({
+            "name": op.name,
+            "type": op.op_type.value,
+            "forward_ms": ms,
+            "flops": fl,
+            "gflops_per_s": (fl / (ms * 1e-3)) / 1e9 if ms > 0 else 0.0,
+        })
+    return records
+
+
+# ----------------------------------------------------------------- dot export
+def export_computation_graph(ffmodel, path: str,
+                             include_costs: bool = False) -> None:
+    """reference: --compgraph → Graph::export_strategy_computation_graph
+    (graph.h:339-344); --include-costs-dot-graph adds per-op cost rows."""
+    cm = ffmodel.compiled
+    assert cm is not None, "compile() first"
+    dot = DotFile("computation_graph")
+    cost_by_op = {}
+    if include_costs:
+        from ..sim import OpCostModel, Simulator, detect_machine_model
+
+        machine = detect_machine_model(cm.mesh.devices.size)
+        cost_model = OpCostModel(machine)
+        for op in cm.ops:
+            c = cost_model.measure(op)
+            cost_by_op[op.name] = c
+    for op in cm.ops:
+        shard = ", ".join(
+            str(ps.partition_spec()) for ps in op.output_shapes
+        )
+        label = f"{{{op.name}|{op.op_type.value}|{shard}"
+        if op.name in cost_by_op:
+            c = cost_by_op[op.name]
+            label += f"|fwd {c.forward_time*1e3:.3f} ms, bwd {c.backward_time*1e3:.3f} ms"
+        label += "}"
+        dot.add_node(op.name, label)
+    producer = {
+        t.tensor_id: op for op in cm.ops for t in op.layer.outputs
+    }
+    for op in cm.ops:
+        for t in op.layer.inputs:
+            src = producer.get(t.tensor_id)
+            if src is not None:
+                dot.add_edge(src.name, op.name, label="x".join(map(str, t.dims)))
+    dot.write(path)
+
+
+def export_task_graph(ffmodel, path: str, fmt: str = "dot") -> None:
+    """reference: --taskgraph → export_strategy_task_graph_file
+    (model.cc:3666). Exports the simulator's SimTask graph with simulated
+    start times; edges transitively reduced through the native graph
+    library when available."""
+    from ..sim import OpCostModel, Simulator, detect_machine_model
+
+    cm = ffmodel.compiled
+    assert cm is not None, "compile() first"
+    machine = detect_machine_model(cm.mesh.devices.size)
+    sim = Simulator(machine, OpCostModel(machine))
+    total = sim.simulate_runtime(cm.ops)
+    tasks = sim._last_tasks  # start times filled by the replay
+    edges = [(d, i) for i, t in enumerate(tasks) for d in t.deps]
+    try:
+        from ..native_bridge import available, transitive_reduction
+
+        if available():
+            edges = transitive_reduction(len(tasks), edges)
+    except Exception:
+        pass
+    if fmt == "json":
+        payload = {
+            "total_time_s": total,
+            "tasks": [
+                {"id": i, "name": t.name, "kind": t.kind,
+                 "run_time_s": t.run_time, "start_time_s": t.start_time}
+                for i, t in enumerate(tasks)
+            ],
+            "edges": [list(e) for e in edges],
+        }
+        with open(path, "w") as f:
+            json.dump(payload, f, indent=2)
+        return
+    dot = DotFile("task_graph")
+    for i, t in enumerate(tasks):
+        dot.add_node(
+            str(i),
+            f"{{{t.name}|{t.kind}|{t.run_time*1e6:.1f} us @ {t.start_time*1e6:.1f} us}}",
+        )
+    for s, d in edges:
+        dot.add_edge(str(s), str(d))
+    dot.write(path)

@@ -1,0 +1,85 @@
+"""Dynamic recompilation hook.
+
+TPU-native equivalent of the reference's RecompileState
+(reference: include/flexflow/recompile.h:26-41,
+src/recompile/recompile_state.cc; driven per-iteration by
+``FFModel::recompile_on_condition`` model.cc:2422 — built for the MoE
+cache switch in examples/cpp/mixture_of_experts/moe.cc:180-204).
+
+``trigger_func(state)`` is evaluated between iterations; when it returns
+True, ``alter_func(state)`` may mutate the layer graph / config, and the
+model recompiles. Weights whose (layer, name, shape) survive the
+alteration are carried over — under jit, "recompile" means building a new
+jitted step, so iteration cost is one compile, exactly like the
+reference's Legion re-mapping.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+
+class RecompileState:
+    """reference: recompile.h:26-41 (trigger_func/alter_func + ffmodel)."""
+
+    def __init__(
+        self,
+        trigger_func: Callable[["RecompileState"], bool],
+        alter_func: Callable[["RecompileState"], None],
+        ffmodel,
+    ):
+        self.trigger_func = trigger_func
+        self.alter_func = alter_func
+        self.ffmodel = ffmodel
+        self.recompilations = 0
+        # scratch for user trigger logic (the reference's moe.cc uses the
+        # last iteration's score/metric)
+        self.last_metric: Optional[float] = None
+        self.iteration = 0
+
+    def trigger(self) -> bool:
+        return bool(self.trigger_func(self))
+
+    def alter(self) -> None:
+        self.alter_func(self)
+        self.recompilations += 1
+
+
+def recompile_on_condition(ffmodel, state: RecompileState) -> bool:
+    """Evaluate the trigger; on fire, alter + recompile preserving weights
+    (reference: FFModel::recompile_on_condition, model.cc:2422). Returns
+    True if a recompilation happened."""
+    state.iteration += 1
+    if not state.trigger():
+        return False
+    cm = ffmodel.compiled
+    old_params = {}
+    old_iteration = 0
+    if cm is not None:
+        old_params = {
+            op_name: {w: np.asarray(v) for w, v in ws.items()}
+            for op_name, ws in cm.params.items()
+        }
+        old_iteration = cm._iteration
+    state.alter()
+    ffmodel.compile(
+        optimizer=ffmodel.optimizer,
+        loss_type=cm.loss_type if cm is not None else None,
+        metrics=list(cm.metrics) if cm is not None else [],
+        mesh=cm.mesh if cm is not None else None,
+    )
+    new_cm = ffmodel.compiled
+    # carry over surviving weights (same layer name + weight name + shape)
+    import jax
+
+    for op_name, ws in new_cm.params.items():
+        for wname, val in ws.items():
+            old = old_params.get(op_name, {}).get(wname)
+            if old is not None and old.shape == val.shape:
+                new_cm.params[op_name][wname] = jax.device_put(
+                    old.astype(np.asarray(val).dtype), val.sharding
+                )
+    new_cm._iteration = old_iteration
+    return True

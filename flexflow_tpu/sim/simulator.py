@@ -222,6 +222,7 @@ class Simulator:
         event-driven TaskManager loop) when built, with compute and
         network on separate lanes; pure-Python fallback otherwise."""
         tasks = self.build_task_graph(ops)
+        self._last_tasks = tasks  # exposed for --taskgraph export
         bwd_total = sum(t.run_time for t in tasks if t.kind == "bwd")
         durations = [self._effective_runtime(t, bwd_total) for t in tasks]
         # one compute lane (every device runs the same SPMD program, so the

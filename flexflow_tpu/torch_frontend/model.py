@@ -181,12 +181,19 @@ def _function_record(node, torch, F) -> Dict:
             shape = [a for a in args[1:]]
             if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
                 shape = list(shape[0])
-            if any(is_node(s) for s in shape[1:]) or (
-                len(shape) == 2 and shape[1] == -1
-            ):
-                # x.view(x.size(0), -1) and friends → flatten
+            if any(is_node(s) for s in shape[1:]):
+                # non-leading dynamic dims are not importable statically
+                raise ValueError(
+                    f"{name}: view/reshape with a dynamic non-batch dim is "
+                    f"not importable (shapes are static under XLA)"
+                )
+            if len(shape) == 2 and shape[1] == -1:
+                # x.view(x.size(0), -1) / x.view(B, -1) → flatten
                 return rec("flat", [self_arg])
-            return rec("reshape", [self_arg], {"shape": [int(s) for s in shape]})
+            # a leading x.size(0) (or any Node) means "keep the batch dim":
+            # serialize as 0, resolved against the input dims at apply time
+            out = [0 if is_node(s) else int(s) for s in shape]
+            return rec("reshape", [self_arg], {"shape": out})
         if m == "flatten":
             return rec("flat", [self_arg])
         if m in ("transpose",):
@@ -346,7 +353,12 @@ class PyTorchModel:
         if op == "flat":
             return ff.flat(x[0], name=name)
         if op == "reshape":
-            shape = a["shape"]
+            # 0 = copy the input dim at that position (dynamic batch);
+            # -1 = infer from the remaining volume
+            shape = [
+                x[0].dims[i] if s == 0 else s
+                for i, s in enumerate(a["shape"])
+            ]
             if any(s == -1 for s in shape):
                 known = int(np.prod([s for s in shape if s != -1]))
                 total = int(np.prod(x[0].dims))
@@ -377,10 +389,10 @@ class PyTorchModel:
         if op == "getitem":
             return x[0][a["index"]]
         if op == "size":
-            raise ValueError(
-                "tensor.size() feeding anything but view/reshape is not "
-                "importable (shapes are static under XLA)"
-            )
+            # live only because view/reshape consumed it; those consumers
+            # were already rewritten to flat/reshape records, so the value
+            # itself is never read — emit an inert marker
+            return ("__size__", x[0], a.get("args"))
         raise ValueError(f"unknown IR op {op}")
 
 
@@ -417,17 +429,17 @@ def copy_weights(ffmodel, torch_module, layer_names: Optional[Dict[str, str]] = 
         wmap = {p.name.split("/")[-1]: p for p in layer.weights}
         with torch.no_grad():
             if isinstance(mod, torch.nn.Linear):
-                wmap["kernel"].set_weights(ffmodel, mod.weight.numpy().T)
+                wmap["kernel"].set_weights(ffmodel, mod.weight.detach().numpy().T)
                 if "bias" in wmap and mod.bias is not None:
-                    wmap["bias"].set_weights(ffmodel, mod.bias.numpy())
+                    wmap["bias"].set_weights(ffmodel, mod.bias.detach().numpy())
             elif isinstance(mod, torch.nn.Conv2d):
-                wmap["kernel"].set_weights(ffmodel, mod.weight.numpy())
+                wmap["kernel"].set_weights(ffmodel, mod.weight.detach().numpy())
                 if "bias" in wmap and mod.bias is not None:
-                    wmap["bias"].set_weights(ffmodel, mod.bias.numpy())
+                    wmap["bias"].set_weights(ffmodel, mod.bias.detach().numpy())
             elif isinstance(mod, torch.nn.Embedding):
-                wmap["weight"].set_weights(ffmodel, mod.weight.numpy())
+                wmap["weight"].set_weights(ffmodel, mod.weight.detach().numpy())
             elif isinstance(mod, (torch.nn.LayerNorm, torch.nn.BatchNorm2d)):
                 if "scale" in wmap and getattr(mod, "weight", None) is not None:
-                    wmap["scale"].set_weights(ffmodel, mod.weight.numpy())
+                    wmap["scale"].set_weights(ffmodel, mod.weight.detach().numpy())
                 if "bias" in wmap and getattr(mod, "bias", None) is not None:
-                    wmap["bias"].set_weights(ffmodel, mod.bias.numpy())
+                    wmap["bias"].set_weights(ffmodel, mod.bias.detach().numpy())

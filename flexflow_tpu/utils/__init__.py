@@ -1,0 +1,2 @@
+"""Utility subsystems (reference: include/flexflow/utils/ —
+RecursiveLogger, dot-file writers, hash utils)."""

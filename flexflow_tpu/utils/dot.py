@@ -1,0 +1,40 @@
+"""Dot-file writers (reference: include/flexflow/utils/dot/,
+src/utils/dot/record_formatter.cc — used by ``--compgraph`` /
+``--taskgraph`` exports, model.cc:3666-3674)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+def _esc(s: str) -> str:
+    return s.replace('"', '\\"')
+
+
+class DotFile:
+    """Minimal digraph writer matching the reference's export format: one
+    record-shaped node per op, edges per tensor."""
+
+    def __init__(self, name: str = "graph"):
+        self.name = name
+        self.nodes: List[str] = []
+        self.edges: List[str] = []
+
+    def add_node(self, node_id: str, label: str,
+                 extra: Optional[Dict[str, str]] = None) -> None:
+        attrs = {"label": label, "shape": "record"}
+        attrs.update(extra or {})
+        a = ", ".join(f'{k}="{_esc(v)}"' for k, v in attrs.items())
+        self.nodes.append(f'  "{_esc(node_id)}" [{a}];')
+
+    def add_edge(self, src: str, dst: str, label: str = "") -> None:
+        lab = f' [label="{_esc(label)}"]' if label else ""
+        self.edges.append(f'  "{_esc(src)}" -> "{_esc(dst)}"{lab};')
+
+    def render(self) -> str:
+        body = "\n".join(self.nodes + self.edges)
+        return f'digraph "{_esc(self.name)}" {{\n{body}\n}}\n'
+
+    def write(self, path: str) -> None:
+        with open(path, "w") as f:
+            f.write(self.render())

@@ -1,0 +1,45 @@
+"""Indented search tracing.
+
+TPU-native equivalent of the reference's RecursiveLogger
+(reference: include/flexflow/utils/recursive_logger.h,
+src/runtime/recursive_logger.cc — DEBUG-level log lines indented by the
+search recursion depth, used by the Unity DP via ``log_dp``/``log_measure``
+categories). Enable with ``FLEXFLOW_TPU_LOG_SEARCH=1`` or by attaching a
+handler to the ``flexflow_tpu.search`` logger.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+
+logger = logging.getLogger("flexflow_tpu.search")
+if os.environ.get("FLEXFLOW_TPU_LOG_SEARCH"):
+    logging.basicConfig(level=logging.DEBUG)
+    logger.setLevel(logging.DEBUG)
+
+
+class RecursiveLogger:
+    """reference: RecursiveLogger (recursive_logger.h) — ``enter()``
+    returns a context manager that indents everything logged inside."""
+
+    def __init__(self, category: str = "search"):
+        self.depth = 0
+        self.log = logging.getLogger(f"flexflow_tpu.{category}")
+
+    @contextlib.contextmanager
+    def enter(self, label: str = ""):
+        if label:
+            self.debug(label)
+        self.depth += 1
+        try:
+            yield self
+        finally:
+            self.depth -= 1
+
+    def debug(self, msg: str, *args) -> None:
+        self.log.debug("%s%s", "  " * self.depth, msg % args if args else msg)
+
+    def info(self, msg: str, *args) -> None:
+        self.log.info("%s%s", "  " * self.depth, msg % args if args else msg)

@@ -1,0 +1,89 @@
+"""FusedOp pass + recompile-on-condition hook tests (reference:
+FFModel::apply_fusion model.cc:2495; RecompileState recompile.h:26-41)."""
+
+import numpy as np
+
+from flexflow_tpu import FFConfig, FFModel, LossType, MetricsType, OpType
+from flexflow_tpu.runtime.optimizer import AdamOptimizer
+from flexflow_tpu.runtime.recompile import RecompileState
+
+
+def _data(n=128, d=16, c=4, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=(d, c)).astype(np.float32)
+    y = np.argmax(x @ w, axis=1).astype(np.int32).reshape(-1, 1)
+    return x, y
+
+
+def _chain_model(fusion: bool):
+    ff = FFModel(FFConfig(batch_size=32, epochs=4, seed=0))
+    ff.config.perform_fusion = fusion
+    x = ff.create_tensor((32, 16), name="input")
+    h = ff.dense(x, 32, name="body")
+    h = ff.relu(h)
+    h = ff.scalar_multiply(h, 1.5)
+    h = ff.exp(h)
+    h = ff.tanh(h)
+    ff.dense(h, 4, name="head")
+    ff.compile(optimizer=AdamOptimizer(alpha=0.01),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=[MetricsType.ACCURACY])
+    return ff
+
+
+def test_fusion_shrinks_graph_and_matches():
+    x, y = _data()
+    ff_f = _chain_model(fusion=True)
+    ff_n = _chain_model(fusion=False)
+    ops_f = [op.op_type for op in ff_f.compiled.ops]
+    ops_n = [op.op_type for op in ff_n.compiled.ops]
+    assert OpType.FUSED in ops_f
+    assert len(ops_f) < len(ops_n)
+    # same math: identical params (same seed) => identical training
+    hf = ff_f.fit(x, y, verbose=False)
+    hn = ff_n.fit(x, y, verbose=False)
+    assert abs(hf[-1].accuracy - hn[-1].accuracy) < 1e-9
+
+
+def test_fusion_respects_multi_consumer():
+    ff = FFModel(FFConfig(batch_size=8, seed=0))
+    ff.config.perform_fusion = True
+    x = ff.create_tensor((8, 8), name="input")
+    h = ff.relu(x)
+    a = ff.exp(h)
+    b = ff.tanh(h)   # h has two consumers -> relu/exp must not fuse over it
+    out = ff.add(a, b)
+    ff.dense(out, 2)
+    ff.compile(optimizer=AdamOptimizer(alpha=0.01),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY, metrics=[])
+    kinds = [op.op_type for op in ff.compiled.ops]
+    assert OpType.FUSED not in kinds  # no fusible chain of length >= 2
+
+
+def test_recompile_on_condition_carries_weights():
+    x, y = _data()
+    ff = FFModel(FFConfig(batch_size=32, epochs=3, seed=0))
+    xin = ff.create_tensor((32, 16), name="input")
+    h = ff.dense(xin, 32, name="body")
+    h = ff.relu(h)
+    ff.dense(h, 4, name="head")
+    ff.compile(optimizer=AdamOptimizer(alpha=0.01),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=[MetricsType.ACCURACY])
+
+    fired = []
+
+    def trigger(rs):
+        return rs.iteration == 5
+
+    def alter(rs):
+        fired.append(rs.iteration)
+
+    rs = RecompileState(trigger, alter, ff)
+    hist = ff.fit(x, y, verbose=False, recompile_state=rs)
+    assert fired == [5]
+    assert rs.recompilations == 1
+    # training continued after the recompile with carried-over weights
+    assert np.isfinite(hist[-1].accuracy)
+    assert hist[-1].accuracy >= hist[0].accuracy - 0.1

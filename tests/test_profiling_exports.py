@@ -1,0 +1,79 @@
+"""Profiling + graph-export tests (reference: --profiling/--compgraph/
+--taskgraph observability surface, SURVEY.md §5)."""
+
+import json
+import os
+
+import numpy as np
+
+from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+from flexflow_tpu.models.mlp import build_mlp
+
+
+def _model(**cfg):
+    ff = FFModel(FFConfig(batch_size=16, seed=0, **cfg))
+    build_mlp(ff, 16, in_dim=8, hidden_dims=(16,), num_classes=4)
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY, metrics=[])
+    return ff
+
+
+def test_compgraph_export(tmp_path):
+    ff = _model()
+    p = str(tmp_path / "graph.dot")
+    ff.export_computation_graph(p, include_costs=True)
+    s = open(p).read()
+    assert s.startswith("digraph")
+    assert "mlp_dense0" in s and "->" in s and "ms" in s
+
+
+def test_taskgraph_export_dot_and_json(tmp_path):
+    ff = _model()
+    pd, pj = str(tmp_path / "tg.dot"), str(tmp_path / "tg.json")
+    ff.export_task_graph(pd, fmt="dot")
+    ff.export_task_graph(pj, fmt="json")
+    assert open(pd).read().startswith("digraph")
+    payload = json.load(open(pj))
+    assert payload["total_time_s"] > 0
+    names = [t["name"] for t in payload["tasks"]]
+    assert any(n.endswith(":fwd") for n in names)
+    assert any(n.endswith(":bwd") for n in names)
+    assert "grad_sync" in names
+
+
+def test_exports_via_config_flags(tmp_path):
+    cg = str(tmp_path / "cg.dot")
+    tg = str(tmp_path / "tg.dot")
+    ff = FFModel(FFConfig(batch_size=16, seed=0))
+    ff.config.export_strategy_computation_graph_file = cg
+    ff.config.export_strategy_task_graph_file = tg
+    build_mlp(ff, 16, in_dim=8, hidden_dims=(16,), num_classes=4)
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY, metrics=[])
+    assert os.path.exists(cg) and os.path.exists(tg)
+
+
+def test_profile_ops_records():
+    ff = _model()
+    recs = ff.profile_ops(iters=2)
+    assert len(recs) == len(ff.compiled.ops)
+    for r in recs:
+        assert r["forward_ms"] >= 0.0
+    dense = [r for r in recs if r["type"] == "linear"]
+    assert dense and all(r["flops"] > 0 for r in dense)
+
+
+def test_recursive_logger_indents(caplog):
+    import logging
+
+    from flexflow_tpu.utils.recursive_logger import RecursiveLogger
+
+    rl = RecursiveLogger("testcat")
+    with caplog.at_level(logging.DEBUG, logger="flexflow_tpu.testcat"):
+        rl.debug("outer")
+        with rl.enter("level1"):
+            rl.debug("inner")
+            with rl.enter():
+                rl.debug("inner2")
+    msgs = [r.message for r in caplog.records]
+    assert msgs == ["outer", "level1", "  inner", "    inner2"]

@@ -98,3 +98,25 @@ def test_imported_model_trains():
     y = np.argmax(x @ w, axis=1).astype(np.int32).reshape(-1, 1)
     hist = ff.fit(x, y, verbose=False)
     assert hist[-1].accuracy > hist[0].accuracy
+
+
+class ViewNet(nn.Module):
+    """Exercises x.size(0)-driven view/reshape idioms."""
+
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(24, 24)
+
+    def forward(self, x):
+        h = self.fc(x.view(x.size(0), -1))       # flatten via size()
+        h = h.view(x.size(0), 2, 12)             # dynamic-batch reshape
+        return h.reshape(x.size(0), 24)
+
+
+def test_size_driven_views_import():
+    torch.manual_seed(0)
+    mod = ViewNet().eval()
+    x = np.random.default_rng(3).normal(size=(4, 4, 6)).astype(np.float32)
+    ff, got = _import_and_forward(mod, x, 4)
+    want = mod(torch.from_numpy(x)).detach().numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
