@@ -64,7 +64,23 @@ class _BaseModel:
         xs = x if isinstance(x, (list, tuple)) else [x]
         if (self.ffmodel is not None
                 and self.ffmodel.config.batch_size != batch_size):
+            # rebuild for the new batch size but keep trained weights
+            # (Keras semantics: fit() never resets weights)
+            carried = {
+                name: {w: np.asarray(v) for w, v in ws.items()}
+                for name, ws in self.ffmodel.compiled.params.items()
+            }
             self.ffmodel = None
+            self._build(xs, batch_size, epochs)
+            import jax
+
+            cm = self.ffmodel.compiled
+            for name, ws in cm.params.items():
+                for w, v in ws.items():
+                    old = carried.get(name, {}).get(w)
+                    if old is not None and old.shape == v.shape:
+                        cm.params[name][w] = jax.device_put(
+                            old, cm.param_shardings[name][w])
         self._build(xs, batch_size, epochs)
         return self.ffmodel.fit(list(xs), y, epochs=epochs, shuffle=shuffle,
                                 verbose=verbose)

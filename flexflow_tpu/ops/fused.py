@@ -55,9 +55,17 @@ class FusedOp(Op):
         return [(last.sizes, last.dtype)]
 
     def forward(self, ctx, inputs, weights):
+        import jax
+
         x = inputs[0]
-        for op in self.sub_ops:
+        base_rng = ctx.rng
+        for i, op in enumerate(self.sub_ops):
+            # distinct rng per sub-op: two fused dropouts must not share a
+            # mask (matches the per-op fold_in in the unfused graph)
+            ctx.rng = (jax.random.fold_in(base_rng, i)
+                       if base_rng is not None else None)
             (x,) = op.forward(ctx, [x], {})
+        ctx.rng = base_rng
         return [x]
 
     def flops(self) -> float:

@@ -1,0 +1,298 @@
+"""Pipeline parallelism (GPipe schedule).
+
+The reference RESERVED pipeline parallelism but never implemented it
+(reference: PIPELINE_{INIT,FWD,BWD}_TASK_ID task ids exist, model.h:190-192,
+but no Pipeline op exists anywhere in src/ — SURVEY.md §2.3). Here it is a
+first-class strategy, per SURVEY.md §7 step 10.
+
+Design (TPU single-controller):
+
+* the op chain is split into ``num_stages`` contiguous stages balanced by
+  FLOPs; stage *s*'s parameters live only on the mesh slice ``pipe = s``
+  (a submesh keeping every other axis, so dp/tp still apply *inside* a
+  stage);
+* each stage's forward is one jitted program on its submesh; the global
+  batch splits into ``num_microbatches`` microbatches, and the GPipe
+  schedule emerges from JAX's async dispatch — microbatch *m+1*'s stage-*s*
+  program is enqueued while microbatch *m* runs on stage *s+1*'s devices,
+  so different stages execute concurrently on disjoint device groups;
+* backward replays per stage via ``jax.vjp`` (activation residuals held
+  per microbatch — the GPipe memory profile), gradients accumulate over
+  microbatches, and each stage's optimizer update runs on its own submesh;
+* inter-stage activation (and cotangent) transfers are device_put edges
+  between submeshes — the ICI hop where the reference would have issued a
+  Legion region copy.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..core.machine import PIPE_AXIS, mesh_axis_sizes
+from ..core.op import LowerCtx
+
+
+@dataclasses.dataclass
+class PipelineConfig:
+    """compile(..., pipeline=PipelineConfig(...))."""
+
+    num_stages: int
+    num_microbatches: int = 4
+    axis: str = PIPE_AXIS
+
+
+def split_stages(ops: List, num_stages: int) -> List[List]:
+    """Balanced contiguous split by FLOPs (fallback: op count)."""
+    costs = [max(op.flops(), 1.0) for op in ops]
+    total = sum(costs)
+    target = total / num_stages
+    stages: List[List] = [[] for _ in range(num_stages)]
+    acc, si = 0.0, 0
+    for op, c in zip(ops, costs):
+        if si < num_stages - 1 and acc >= target * (si + 1) and stages[si]:
+            si += 1
+        stages[si].append(op)
+        acc += c
+    for i in range(num_stages):  # no empty stages
+        if not stages[i]:
+            for j in range(num_stages):
+                if len(stages[j]) > 1:
+                    stages[i].append(stages[j].pop())
+                    break
+    return stages
+
+
+class PipelinedModel:
+    """Pipeline execution engine behind FFModel.compile(pipeline=...).
+
+    ``train_step(rng, xs, y) -> (loss, batch_metrics)`` mutates the
+    per-stage params/opt_state in place (host-driven schedule).
+    """
+
+    def __init__(self, ops, mesh: Mesh, cfg: PipelineConfig, optimizer,
+                 loss_fn, metrics_fn, input_ids: List[int], logits_id: int,
+                 params: Dict, wd_mask: Dict):
+        axis_sizes = mesh_axis_sizes(mesh)
+        if cfg.axis not in axis_sizes:
+            raise ValueError(f"mesh has no '{cfg.axis}' axis for pipelining")
+        S = axis_sizes[cfg.axis]
+        if cfg.num_stages != S:
+            raise ValueError(
+                f"num_stages={cfg.num_stages} must equal mesh {cfg.axis} "
+                f"size {S}"
+            )
+        self.cfg = cfg
+        self.mesh = mesh
+        self.optimizer = optimizer
+        self.loss_fn = loss_fn
+        self.metrics_fn = metrics_fn
+        self.input_ids = input_ids
+        self.logits_id = logits_id
+        self.stages = split_stages(ops, S)
+
+        # per-stage submeshes: slice the pipe axis, keep the other axes
+        pipe_index = list(mesh.axis_names).index(cfg.axis)
+        other_axes = [a for a in mesh.axis_names if a != cfg.axis]
+        self.submeshes: List[Mesh] = []
+        for s in range(S):
+            devs = np.take(mesh.devices, s, axis=pipe_index)
+            if not other_axes:  # keep a mesh, even if trivial
+                devs = devs.reshape(1)
+                self.submeshes.append(Mesh(devs, ("_stage",)))
+            else:
+                self.submeshes.append(Mesh(devs, tuple(other_axes)))
+
+        # move each stage's params onto its submesh (pipe axis dropped from
+        # specs — params are partitioned BY stage, not across it)
+        self.stage_params: List[Dict] = []
+        self.stage_wd: List[Dict] = []
+        for s, stage_ops in enumerate(self.stages):
+            sp, sw = {}, {}
+            for op in stage_ops:
+                if op.name in params:
+                    sp[op.name] = {
+                        w: jax.device_put(v, self._weight_sharding(s, op, w))
+                        for w, v in params[op.name].items()
+                    }
+                    sw[op.name] = wd_mask[op.name]
+            self.stage_params.append(sp)
+            self.stage_wd.append(sw)
+        self.stage_opt_state = [
+            optimizer.init_state(sp) for sp in self.stage_params
+        ]
+        self._stage_fwd = [self._make_stage_fwd(s) for s in range(S)]
+        self._stage_update = [self._make_stage_update(s) for s in range(S)]
+
+    # ------------------------------------------------------------------ #
+    def _weight_sharding(self, s: int, op, wname: str) -> NamedSharding:
+        ps = op.weight_shapes[wname]
+        sub = self.submeshes[s]
+        spec = tuple(
+            e if e in sub.axis_names else None
+            for e in ps.partition_spec()
+        )
+        return NamedSharding(sub, PartitionSpec(*spec))
+
+    def _replicated(self, s: int, v) -> NamedSharding:
+        return NamedSharding(self.submeshes[s],
+                             PartitionSpec(*([None] * v.ndim)))
+
+    def _ship(self, s: int, tree):
+        """Move an activation/cotangent dict onto stage s's submesh."""
+        return {
+            k: jax.device_put(v, self._replicated(s, v))
+            for k, v in tree.items()
+        }
+
+    def _live_after(self, s: int) -> set:
+        needed = {self.logits_id}
+        for later in self.stages[s + 1:]:
+            for op in later:
+                for t in op.layer.inputs:
+                    needed.add(t.tensor_id)
+        return needed
+
+    def _make_stage_fwd(self, s: int):
+        stage_ops = self.stages[s]
+        mesh = self.submeshes[s]
+        needed = self._live_after(s)
+
+        def fwd(stage_params, acts: Dict[int, jax.Array], rng):
+            ctx = LowerCtx(mesh=mesh, training=True, aux_losses=[])
+            acts = dict(acts)
+            for oi, op in enumerate(stage_ops):
+                ctx.rng = (jax.random.fold_in(rng, oi)
+                           if rng is not None else None)
+                ins = [acts[t.tensor_id] for t in op.layer.inputs]
+                outs = op.forward(ctx, ins, stage_params.get(op.name, {}))
+                for out, t in zip(outs, op.layer.outputs):
+                    acts[t.tensor_id] = out
+            out_acts = {k: v for k, v in acts.items() if k in needed}
+            aux = ctx.aux_losses or []
+            # aux as a summed scalar so the vjp cotangent is one scalar
+            aux_sum = sum(aux) if aux else jnp.zeros(())
+            return out_acts, aux_sum
+
+        return fwd  # jitting happens implicitly through jax.vjp + jit below
+
+    def _make_stage_update(self, s: int):
+        opt = self.optimizer
+        wd = self.stage_wd[s]
+
+        @jax.jit
+        def upd(stage_params, grads, opt_state):
+            return opt.update(stage_params, grads, opt_state, wd)
+
+        return upd
+
+    # ------------------------------------------------------------------ #
+    def train_step(self, rng, xs: Sequence[jax.Array], y: jax.Array):
+        M = self.cfg.num_microbatches
+        S = len(self.stages)
+        assert xs[0].shape[0] % M == 0, (
+            f"batch {xs[0].shape[0]} not divisible by microbatches {M}"
+        )
+        xs_mb = [jnp.split(jnp.asarray(x), M, axis=0) for x in xs]
+        y_mb = jnp.split(jnp.asarray(y), M, axis=0)
+
+        # ---- forward (async dispatch pipelines stages across submeshes)
+        vjps = [[None] * S for _ in range(M)]
+        out_structs = [None] * M       # last-stage output act dicts
+        loss_vjps, losses = [None] * M, [None] * M
+        logits_mb = [None] * M
+        for m in range(M):
+            acts = self._ship(
+                0, {tid: mb[m] for tid, mb in zip(self.input_ids, xs_mb)}
+            )
+            aux_terms = []
+            for s in range(S):
+                mrng = (jax.random.fold_in(rng, m * 131 + s)
+                        if rng is not None else None)
+                fwd = self._stage_fwd[s]
+                (acts, aux), vjp = jax.vjp(
+                    lambda p, a: fwd(p, a, mrng), self.stage_params[s], acts
+                )
+                vjps[m][s] = vjp
+                aux_terms.append(aux)
+                if s < S - 1:
+                    acts = self._ship(s + 1, acts)
+            out_structs[m] = acts
+            logits = acts[self.logits_id]
+            ym = jax.device_put(y_mb[m],
+                                self._replicated(S - 1, y_mb[m]))
+            loss, lvjp = jax.vjp(
+                lambda lg, _y=ym: self.loss_fn(lg, _y), logits
+            )
+            losses[m] = loss + sum(aux_terms)
+            loss_vjps[m] = lvjp
+            logits_mb[m] = logits
+
+        # ---- backward (reverse stage order per microbatch)
+        inv_m = 1.0 / M
+        grad_acc: List[Any] = [None] * S
+        for m in range(M):
+            (dlogits,) = loss_vjps[m](
+                jnp.asarray(inv_m, losses[m].dtype)
+            )
+            dacts = {
+                k: (dlogits if k == self.logits_id else jnp.zeros_like(v))
+                for k, v in out_structs[m].items()
+            }
+            for s in reversed(range(S)):
+                daux = jnp.asarray(inv_m)  # aux terms share the 1/M scale
+                dparams, dacts = vjps[m][s]((dacts, daux))
+                if s > 0:
+                    dacts = self._ship(s - 1, dacts)
+                grad_acc[s] = (dparams if grad_acc[s] is None
+                               else jax.tree.map(jnp.add, grad_acc[s], dparams))
+
+        # ---- per-stage optimizer update on each submesh
+        for s in range(S):
+            self.stage_params[s], self.stage_opt_state[s] = \
+                self._stage_update[s](self.stage_params[s], grad_acc[s],
+                                      self.stage_opt_state[s])
+
+        loss = float(sum(jax.device_get(l) for l in losses)) * inv_m
+        bm = {}
+        if self.metrics_fn is not None:
+            logits = jnp.concatenate(
+                [jax.device_get(l) for l in logits_mb], axis=0
+            )
+            bm = self.metrics_fn(logits, jax.device_get(jnp.asarray(y)))
+        return loss, bm
+
+    def forward_only(self, xs: Sequence[jax.Array]):
+        acts = self._ship(
+            0, {tid: jnp.asarray(x) for tid, x in zip(self.input_ids, xs)}
+        )
+        for s in range(len(self.stages)):
+            acts, _ = self._stage_fwd[s](self.stage_params[s], acts, None)
+            if s < len(self.stages) - 1:
+                acts = self._ship(s + 1, acts)
+        return acts[self.logits_id]
+
+    # convenience: gather all params back to host (checkpointing, tests)
+    def all_params(self) -> Dict:
+        merged: Dict = {}
+        for sp in self.stage_params:
+            merged.update(sp)
+        return merged
+
+    def sync_to(self, cm) -> None:
+        """Write trained stage params back into the CompiledModel (full-mesh
+        shardings), so checkpointing/eval/get_weights after a pipelined fit
+        see the trained weights."""
+        for sp in self.stage_params:
+            for op_name, ws in sp.items():
+                if op_name not in cm.params:
+                    continue
+                for w, v in ws.items():
+                    cm.params[op_name][w] = jax.device_put(
+                        np.asarray(v), cm.param_shardings[op_name][w]
+                    )

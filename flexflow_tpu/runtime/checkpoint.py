@@ -63,6 +63,21 @@ class CheckpointManager:
 
             with open(self._extra_path(step), "w") as f:
                 json.dump(extra, f)
+        self._prune_extras()
+
+    def _prune_extras(self) -> None:
+        """Drop sidecars whose checkpoint step has been retention-deleted."""
+        import glob
+        import re
+
+        live = set(self._mgr.all_steps())
+        for p in glob.glob(os.path.join(self.directory, "extra_*.json")):
+            m = re.match(r"extra_(\d+)\.json$", os.path.basename(p))
+            if m and int(m.group(1)) not in live:
+                try:
+                    os.remove(p)
+                except OSError:
+                    pass
 
     def _extra_path(self, step: int) -> str:
         return os.path.join(self.directory, f"extra_{step}.json")

@@ -70,6 +70,7 @@ class CompiledModel:
     raw_forward: Any  # un-jitted forward (params, *xs) -> logits, for
     #                   callers that want to jit/transform it themselves
     tensor_pshapes: Dict[int, ParallelTensorShape]
+    from_logits: bool = False  # CE loss path: graph does not end in softmax
     _iteration: int = 0
 
 
@@ -356,5 +357,6 @@ def compile_model(
         forward_fn=jit_forward,
         grad_step=jit_grad,
         raw_forward=forward_fn,
+        from_logits=from_logits,
         tensor_pshapes=pshapes,
     )

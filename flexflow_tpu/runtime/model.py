@@ -58,6 +58,7 @@ class FFModel:
         self.input_tensors: List[Tensor] = []
         self.optimizer: Optional[Optimizer] = None
         self.compiled: Optional[CompiledModel] = None
+        self.pipelined = None  # PipelinedModel when compile(pipeline=...)
         self.search_result = None  # GraphSearchResult from the last search
         self._search_strategies: Dict[str, Dict[str, str]] = {}
         self.iter_config = FFIterationConfig()
@@ -545,9 +546,13 @@ class FFModel:
         comp_mode: CompMode = CompMode.TRAINING,
         strategies: Optional[Dict[str, Dict[str, str]]] = None,
         mesh=None,
+        pipeline=None,
     ) -> None:
         """reference: FFModel::compile (model.cc:2803); Python surface
-        flexflow_cffi.py:2022."""
+        flexflow_cffi.py:2022. ``pipeline`` takes a
+        ``parallel.pipeline.PipelineConfig`` to train with a GPipe schedule
+        over the mesh's pipe axis (no reference equivalent — PP is reserved
+        but unimplemented upstream, model.h:190-192)."""
         if optimizer is not None:
             self.optimizer = optimizer
         elif self.optimizer is None:
@@ -607,6 +612,24 @@ class FFModel:
             mesh=mesh,
             comp_mode=comp_mode,
         )
+        self.pipelined = None
+        if pipeline is not None:
+            from ..parallel.pipeline import PipelinedModel
+            from .loss import compute_loss
+            from .metrics import compute_batch_metrics
+
+            cm = self.compiled
+            lt, fl = cm.loss_type, cm.from_logits
+            self.pipelined = PipelinedModel(
+                cm.ops, cm.mesh, pipeline, self.optimizer,
+                loss_fn=lambda lg, y: compute_loss(lt, lg, y, fl),
+                metrics_fn=(lambda lg, y: compute_batch_metrics(
+                    cm.metrics, lt, lg, y, fl)) if mtypes else None,
+                input_ids=[t.tensor_id for t in self._used_inputs()],
+                logits_id=logits.tensor_id,
+                params=cm.params,
+                wd_mask=cm.wd_mask,
+            )
         # graph exports requested via flags (reference: --compgraph /
         # --taskgraph dumps written right after compile, model.cc:3666-3674)
         if self.config.export_strategy_computation_graph_file:
@@ -741,9 +764,14 @@ class FFModel:
             last_loss = None
             for it in range(group.num_batches):
                 batch = group.next_batch()
-                cm.params, cm.opt_state, loss, bm = cm.train_step(
-                    cm.params, cm.opt_state, self._next_rng(), *batch
-                )
+                if self.pipelined is not None:
+                    loss, bm = self.pipelined.train_step(
+                        self._next_rng(), batch[:-1], batch[-1]
+                    )
+                else:
+                    cm.params, cm.opt_state, loss, bm = cm.train_step(
+                        cm.params, cm.opt_state, self._next_rng(), *batch
+                    )
                 pm.update({k: float(v) for k, v in bm.items()})
                 last_loss = loss
                 cm._iteration += 1
@@ -762,6 +790,10 @@ class FFModel:
                     flush=True,
                 )
             history.append(pm)
+        if self.pipelined is not None:
+            # keep the CompiledModel view current so checkpoint/eval/
+            # get_weights after a pipelined fit see trained weights
+            self.pipelined.sync_to(cm)
         return history
 
     def eval(self, x, y, batch_size: Optional[int] = None, verbose: bool = True) -> PerfMetrics:

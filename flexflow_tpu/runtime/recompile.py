@@ -63,12 +63,24 @@ def recompile_on_condition(ffmodel, state: RecompileState) -> bool:
             for op_name, ws in cm.params.items()
         }
         old_iteration = cm._iteration
+    if ffmodel.pipelined is not None:
+        # trained weights live in the stage params; fold them into the
+        # carried-over snapshot and keep the pipeline schedule on recompile
+        for sp in ffmodel.pipelined.stage_params:
+            for op_name, ws in sp.items():
+                old_params[op_name] = {
+                    w: np.asarray(v) for w, v in ws.items()
+                }
+        pipeline_cfg = ffmodel.pipelined.cfg
+    else:
+        pipeline_cfg = None
     state.alter()
     ffmodel.compile(
         optimizer=ffmodel.optimizer,
         loss_type=cm.loss_type if cm is not None else None,
         metrics=list(cm.metrics) if cm is not None else [],
         mesh=cm.mesh if cm is not None else None,
+        pipeline=pipeline_cfg,
     )
     new_cm = ffmodel.compiled
     # carry over surviving weights (same layer name + weight name + shape)
@@ -81,5 +93,17 @@ def recompile_on_condition(ffmodel, state: RecompileState) -> bool:
                 new_cm.params[op_name][wname] = jax.device_put(
                     old.astype(np.asarray(val).dtype), val.sharding
                 )
+    if ffmodel.pipelined is not None:
+        # the new PipelinedModel re-sliced initial params; refresh its
+        # stage params from the carried-over set
+        pm = ffmodel.pipelined
+        for s, sp in enumerate(pm.stage_params):
+            for op_name, ws in sp.items():
+                for wname, val in ws.items():
+                    old = old_params.get(op_name, {}).get(wname)
+                    if old is not None and old.shape == val.shape:
+                        sp[op_name][wname] = jax.device_put(
+                            old.astype(np.asarray(val).dtype), val.sharding
+                        )
     new_cm._iteration = old_iteration
     return True

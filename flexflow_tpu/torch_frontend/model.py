@@ -274,6 +274,25 @@ def _function_record(node, torch, F) -> Dict:
     raise ValueError(f"unsupported function: {tgt}")
 
 
+class _SizeMarker:
+    """Placeholder for a traced ``tensor.size()`` value. view/reshape
+    consumers are rewritten at trace time and never read it; anything else
+    touching it gets the actionable error the importer used to raise."""
+
+    def __init__(self, node_name: str):
+        self._node = node_name
+
+    def _fail(self, *_a, **_k):
+        raise ValueError(
+            f"tensor.size() at node '{self._node}' feeds an operation "
+            f"other than view/reshape — not importable (shapes are static "
+            f"under XLA)"
+        )
+
+    __getitem__ = __iter__ = __int__ = __index__ = __add__ = __radd__ = _fail
+    __mul__ = __rmul__ = __sub__ = __truediv__ = __call__ = _fail
+
+
 # -------------------------------------------------------------------- replay
 class PyTorchModel:
     """reference: PyTorchModel (python/flexflow/torch/model.py:2408).
@@ -391,8 +410,9 @@ class PyTorchModel:
         if op == "size":
             # live only because view/reshape consumed it; those consumers
             # were already rewritten to flat/reshape records, so the value
-            # itself is never read — emit an inert marker
-            return ("__size__", x[0], a.get("args"))
+            # itself must never be read — emit a marker that raises with an
+            # actionable message on any actual use
+            return _SizeMarker(name)
         raise ValueError(f"unknown IR op {op}")
 
 

@@ -16,8 +16,14 @@ import os
 
 logger = logging.getLogger("flexflow_tpu.search")
 if os.environ.get("FLEXFLOW_TPU_LOG_SEARCH"):
-    logging.basicConfig(level=logging.DEBUG)
-    logger.setLevel(logging.DEBUG)
+    # scope the handler to the flexflow_tpu logger tree only — a global
+    # basicConfig would turn on DEBUG spam for every library in-process
+    _pkg = logging.getLogger("flexflow_tpu")
+    if not _pkg.handlers:
+        _h = logging.StreamHandler()
+        _h.setFormatter(logging.Formatter("%(name)s: %(message)s"))
+        _pkg.addHandler(_h)
+    _pkg.setLevel(logging.DEBUG)
 
 
 class RecursiveLogger:

@@ -1,0 +1,116 @@
+"""Pipeline-parallel tests (no reference analog — PP is reserved but
+unimplemented upstream, model.h:190-192; SURVEY.md §2.3/§7 step 10).
+
+Runs GPipe over a pipe×data mesh on the hermetic 8-device CPU platform and
+checks numerical equivalence against non-pipelined training.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from flexflow_tpu import FFConfig, FFModel, LossType, MetricsType
+from flexflow_tpu.parallel.pipeline import PipelineConfig, split_stages
+from flexflow_tpu.runtime.optimizer import SGDOptimizer
+
+
+def _build(ff, bs):
+    x = ff.create_tensor((bs, 16), name="input")
+    h = ff.dense(x, 32, name="fc1")
+    h = ff.relu(h, name="act1")
+    h = ff.dense(h, 32, name="fc2")
+    h = ff.relu(h, name="act2")
+    h = ff.dense(h, 4, name="head")
+    return ff.softmax(h, name="probs")
+
+
+def _data(n=64, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 16)).astype(np.float32)
+    w = rng.normal(size=(16, 4)).astype(np.float32)
+    y = np.argmax(x @ w, axis=1).astype(np.int32).reshape(-1, 1)
+    return x, y
+
+
+def test_split_stages_balanced_and_contiguous():
+    ff = FFModel(FFConfig(batch_size=8, seed=0))
+    _build(ff, 8)
+    ff.compile(optimizer=SGDOptimizer(lr=0.1),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY, metrics=[])
+    stages = split_stages(ff.compiled.ops, 2)
+    assert len(stages) == 2 and all(stages)
+    flat = [op.name for st in stages for op in st]
+    assert flat == [op.name for op in ff.compiled.ops]  # contiguous order
+
+
+def test_pipeline_matches_single_device_training():
+    bs = 16
+    x, y = _data(n=bs)  # one batch per epoch: deterministic comparison
+
+    def run(pipelined):
+        ff = FFModel(FFConfig(
+            batch_size=bs, epochs=3, seed=0,
+            mesh_shape={"pipe": 2, "data": 4} if pipelined else {"data": 8},
+        ))
+        _build(ff, bs)
+        kw = dict(pipeline=PipelineConfig(num_stages=2, num_microbatches=4)) \
+            if pipelined else {}
+        ff.compile(optimizer=SGDOptimizer(lr=0.1),
+                   loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                   metrics=[MetricsType.ACCURACY], **kw)
+        hist = ff.fit(x, y, verbose=False, shuffle=False)
+        if pipelined:
+            params = ff.pipelined.all_params()
+        else:
+            params = ff.compiled.params
+        return hist, {k: {w: np.asarray(v) for w, v in ws.items()}
+                      for k, ws in params.items()}
+
+    h_pp, p_pp = run(True)
+    h_sd, p_sd = run(False)
+    # identical data, seed, optimizer: GPipe with grad accumulation equals
+    # full-batch training up to float tolerance
+    for name in p_sd:
+        for w in p_sd[name]:
+            np.testing.assert_allclose(
+                p_pp[name][w], p_sd[name][w], rtol=2e-4, atol=2e-5,
+                err_msg=f"{name}/{w}",
+            )
+    assert abs(h_pp[-1].accuracy - h_sd[-1].accuracy) <= 0.15
+
+
+def test_pipeline_forward_only():
+    bs = 8
+    ff = FFModel(FFConfig(batch_size=bs, seed=0, mesh_shape={"pipe": 2, "data": 4}))
+    _build(ff, bs)
+    ff.compile(optimizer=SGDOptimizer(lr=0.1),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=[], pipeline=PipelineConfig(num_stages=2,
+                                                   num_microbatches=2))
+    x, _ = _data(n=bs)
+    out = np.asarray(ff.pipelined.forward_only([jnp.asarray(x)]))
+    assert out.shape == (bs, 4)
+    np.testing.assert_allclose(out.sum(axis=-1), 1.0, rtol=1e-5)
+
+
+def test_pipelined_fit_syncs_compiled_params(tmp_path):
+    """Checkpoint/eval after a pipelined fit must see trained weights."""
+    bs = 16
+    x, y = _data(n=64)
+    ff = FFModel(FFConfig(batch_size=bs, epochs=3, seed=0,
+                          mesh_shape={"pipe": 2, "data": 4}))
+    _build(ff, bs)
+    ff.compile(optimizer=SGDOptimizer(lr=0.2),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=[MetricsType.ACCURACY],
+               pipeline=PipelineConfig(num_stages=2, num_microbatches=4))
+    before = {k: {w: np.asarray(v) for w, v in ws.items()}
+              for k, ws in ff.compiled.params.items()}
+    ff.fit(x, y, verbose=False)
+    after = ff.compiled.params
+    changed = any(
+        not np.allclose(before[k][w], np.asarray(after[k][w]))
+        for k in before for w in before[k]
+    )
+    assert changed, "cm.params not synced after pipelined fit"
+    ff.save_checkpoint(str(tmp_path / "ck"), step=1)  # saves trained weights
