@@ -2,23 +2,60 @@
 (reference: examples/cpp/Transformer/transformer.cc:172-210 — ELAPSED
 TIME/THROUGHPUT printed around the epoch loop with execution fences).
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+Prints ONE JSON line on stdout (progress goes to stderr):
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "detail": {...}}
 
-``vs_baseline`` follows the OSDI'22 AE protocol (BASELINE.md): searched /
-hybrid strategy throughput relative to pure data-parallel on the same
-hardware; on a single chip both collapse to the same strategy, so the ratio
-is computed against the data-parallel run when >1 device is present and is
-1.0 otherwise.
+Resilience contract (round-1 postmortem: BENCH_r01.json rc=1, no artifact,
+because a transient `UNAVAILABLE: TPU backend setup/compile error` escaped;
+separately the backend can HANG during init, which no in-process retry can
+survive). The top-level invocation is therefore an *orchestrator*: it runs
+the measurement in a subprocess with a hard timeout, retries once, then
+falls back to a CPU measurement — and always emits a JSON line.
+
+``vs_baseline`` follows the OSDI'22 AE protocol (BASELINE.md): hybrid /
+searched strategy throughput relative to pure data-parallel on the same
+hardware; a single chip collapses both, so the ratio is 1.0 there.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
 
+# Peak dense bf16 FLOP/s per chip, by device-kind substring (MFU denom).
+_PEAK_FLOPS = [
+    ("v6", 918e12),
+    ("v5p", 459e12),
+    ("v5", 197e12),  # v5e / v5 lite
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+]
+
+
+def _progress(msg: str) -> None:
+    print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+
+def _peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "").lower()
+    for sub, peak in _PEAK_FLOPS:
+        if sub in kind:
+            return peak
+    if device.platform != "cpu":  # tpu or an experimental tpu-plugin name
+        return 275e12
+    return 1e12  # CPU fallback: nominal, MFU not meaningful there
+
+
+# --------------------------------------------------------------------------
+# measurement child (runs in a subprocess; may crash or hang — the
+# orchestrator owns the timeout)
+# --------------------------------------------------------------------------
 
 def _build(batch_size, num_layers, seq, hidden, heads, mesh=None, tp_axis=None):
     from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
@@ -65,17 +102,64 @@ def _time_steps(ff, cfg, batch_size, warmup=3, iters=30):
     return (t1 - t0) / iters
 
 
-def main():
+def _measure(force_cpu: bool) -> dict:
+    if force_cpu:
+        os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
 
-    n_dev = len(jax.devices())
+    if force_cpu:
+        jax.config.update("jax_platforms", "cpu")
+
+    devs = None
+    err = None
+    for attempt in range(1, 4):  # in-process retry for *erroring* init
+        try:
+            devs = jax.devices()
+            break
+        except RuntimeError as e:
+            err = str(e).splitlines()[-1][:300]
+            _progress(f"backend init attempt {attempt}/3 failed: {err}")
+            try:
+                jax.extend.backend.clear_backends()
+            except Exception:
+                pass
+            time.sleep(5 * attempt)
+    if devs is None:
+        raise RuntimeError(f"backend init failed: {err}")
+
+    n_dev = len(devs)
+    platform = devs[0].platform
+    # the real chip may register under an experimental plugin name (the
+    # round-1 tail showed platform 'axon'), so anything-but-cpu is a device
+    on_cpu = platform == "cpu"
+    _progress(f"backend up: {platform} x{n_dev} "
+              f"({getattr(devs[0], 'device_kind', '?')})")
+
     # the reference benchmark config (transformer.cc:78-86): seq 512,
-    # hidden 1024, 16 heads, 12 layers; batch 8 per the OSDI'22 bert.sh
-    batch = 8 * max(1, n_dev)
-    ff, cfg = _build(batch, num_layers=12, seq=512, hidden=1024, heads=16)
-    step_s = _time_steps(ff, cfg, batch)
+    # hidden 1024, 16 heads, 12 layers; batch 8 per the OSDI'22 bert.sh.
+    # The CPU fallback shrinks the model so the artifact still proves the
+    # harness end-to-end within the time budget.
+    if on_cpu:
+        layers, seq, hidden, heads, per_dev_batch, iters = 2, 128, 256, 4, 4, 5
+    else:
+        layers, seq, hidden, heads, per_dev_batch, iters = 12, 512, 1024, 16, 8, 30
+    batch = per_dev_batch * max(1, n_dev)
+
+    _progress(f"building model: layers={layers} seq={seq} hidden={hidden} "
+              f"heads={heads} batch={batch}")
+    t_build = time.perf_counter()
+    ff, cfg = _build(batch, num_layers=layers, seq=seq, hidden=hidden, heads=heads)
+    _progress(f"model built in {time.perf_counter() - t_build:.1f}s; "
+              f"timing ({iters} iters)...")
+    step_s = _time_steps(ff, cfg, batch, iters=iters)
     throughput = batch / step_s
-    print(json.dumps({
+    _progress(f"step={step_s * 1e3:.2f} ms  throughput={throughput:.2f} samples/s")
+
+    fwd_flops = float(sum(op.flops() for op in ff.compiled.ops))
+    peak = _peak_flops(devs[0]) * n_dev
+    mfu = 3.0 * fwd_flops / step_s / peak  # fwd+bwd ≈ 3x fwd FLOPs
+
+    result = {
         "metric": "transformer_bert_train_throughput",
         "value": round(throughput, 2),
         "unit": "samples/s",
@@ -84,9 +168,128 @@ def main():
             "step_time_ms": round(step_s * 1e3, 2),
             "batch_size": batch,
             "devices": n_dev,
-            "config": "seq512_hidden1024_heads16_layers12",
+            "platform": platform,
+            "device_kind": getattr(devs[0], "device_kind", "?"),
+            "config": f"seq{seq}_hidden{hidden}_heads{heads}_layers{layers}",
+            "fwd_flops_per_step": fwd_flops,
+            "mfu": round(mfu, 4),
+            "dtype": "float32",
         },
-    }))
+    }
+
+    # ---- Pallas kernels off: quantify the custom-kernel delta -------------
+    # Only meaningful where the kernels actually engage (use_pallas gates on
+    # the mesh; kernels/__init__.py) — otherwise both builds are identical.
+    from flexflow_tpu.kernels import pallas_mode
+
+    pallas_active = (not on_cpu) and pallas_mode() == "compiled" and \
+        ff.compiled.mesh.size == 1
+    result["detail"]["pallas_active"] = pallas_active
+    if pallas_active:
+        try:
+            _progress("re-building with Pallas kernels off...")
+            os.environ["FLEXFLOW_TPU_PALLAS"] = "off"
+            ff_off, _ = _build(batch, num_layers=layers, seq=seq,
+                               hidden=hidden, heads=heads)
+            step_off = _time_steps(ff_off, cfg, batch, iters=iters)
+            result["detail"]["step_time_ms_no_pallas"] = round(step_off * 1e3, 2)
+            result["detail"]["pallas_speedup"] = round(step_off / step_s, 3)
+            _progress(f"no-pallas step={step_off * 1e3:.2f} ms")
+        except Exception as e:  # kernel path must not kill the artifact
+            result["detail"]["pallas_off_error"] = str(e)[:300]
+        finally:
+            os.environ.pop("FLEXFLOW_TPU_PALLAS", None)
+
+    # ---- vs_baseline: hybrid vs pure DP (OSDI'22 AE protocol) -------------
+    if n_dev > 1:
+        try:
+            from flexflow_tpu import make_mesh
+
+            _progress("timing pure data-parallel baseline...")
+            mesh_dp = make_mesh({"data": n_dev})
+            ff_dp, _ = _build(batch, num_layers=layers, seq=seq, hidden=hidden,
+                              heads=heads, mesh=mesh_dp)
+            step_dp = _time_steps(ff_dp, cfg, batch, iters=iters)
+            result["vs_baseline"] = round(step_dp / step_s, 3)
+            result["detail"]["dp_step_time_ms"] = round(step_dp * 1e3, 2)
+        except Exception as e:
+            result["detail"]["dp_baseline_error"] = str(e)[:300]
+    return result
+
+
+# --------------------------------------------------------------------------
+# orchestrator (the default entry): subprocess + hard timeout + CPU fallback
+# --------------------------------------------------------------------------
+
+def _run_child(force_cpu: bool, timeout_s: float):
+    """Run the measurement child; returns (result_dict | None, error | None)."""
+    cmd = [sys.executable, os.path.abspath(__file__), "--child"]
+    if force_cpu:
+        cmd.append("--cpu")
+    label = "cpu" if force_cpu else "device"
+    _progress(f"launching {label} measurement child (timeout {timeout_s:.0f}s)")
+    try:
+        proc = subprocess.run(
+            cmd, stdout=subprocess.PIPE, stderr=sys.stderr, timeout=timeout_s,
+            text=True,
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"{label} child timed out after {timeout_s:.0f}s (hung backend?)"
+    except OSError as e:
+        return None, f"{label} child failed to launch: {e}"
+    if proc.returncode != 0:
+        return None, f"{label} child exited rc={proc.returncode}"
+    for line in reversed(proc.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line), None
+            except json.JSONDecodeError:
+                continue
+    return None, f"{label} child produced no JSON"
+
+
+def main():
+    if "--child" in sys.argv:
+        print(json.dumps(_measure(force_cpu="--cpu" in sys.argv)))
+        return
+
+    # the resilience contract: a JSON line comes out of here no matter what
+    try:
+        try:
+            device_timeout = float(os.environ.get("FLEXFLOW_BENCH_TIMEOUT", "1200"))
+        except ValueError:
+            device_timeout = 1200.0
+        errors = []
+        result = None
+        for attempt in (1, 2):
+            result, err = _run_child(force_cpu=False, timeout_s=device_timeout)
+            if result is not None:
+                break
+            errors.append(f"attempt {attempt}: {err}")
+            _progress(err)
+        if result is None:
+            result, err = _run_child(force_cpu=True, timeout_s=600)
+            if result is not None:
+                result["error"] = "; ".join(errors) + " — value is a CPU fallback"
+            else:
+                errors.append(err)
+                result = {
+                    "metric": "transformer_bert_train_throughput",
+                    "value": 0.0,
+                    "unit": "samples/s",
+                    "vs_baseline": 0.0,
+                    "error": "; ".join(errors),
+                }
+    except Exception as e:
+        result = {
+            "metric": "transformer_bert_train_throughput",
+            "value": 0.0,
+            "unit": "samples/s",
+            "vs_baseline": 0.0,
+            "error": f"orchestrator: {e}"[:500],
+        }
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":
