@@ -11,14 +11,17 @@ Design (TPU single-controller):
   FLOPs; stage *s*'s parameters live only on the mesh slice ``pipe = s``
   (a submesh keeping every other axis, so dp/tp still apply *inside* a
   stage);
-* each stage's forward is one jitted program on its submesh; the global
-  batch splits into ``num_microbatches`` microbatches, and the GPipe
-  schedule emerges from JAX's async dispatch — microbatch *m+1*'s stage-*s*
-  program is enqueued while microbatch *m* runs on stage *s+1*'s devices,
-  so different stages execute concurrently on disjoint device groups;
-* backward replays per stage via ``jax.vjp`` (activation residuals held
-  per microbatch — the GPipe memory profile), gradients accumulate over
-  microbatches, and each stage's optimizer update runs on its own submesh;
+* each stage compiles exactly TWO programs on its submesh — a jitted
+  forward and a jitted backward (the backward rematerializes the stage's
+  forward via ``jax.vjp`` inside the jit, so only the inter-stage boundary
+  activations are ever stored: GPipe with per-stage rematerialization);
+* the global batch splits into ``num_microbatches`` microbatches, each kept
+  **sharded over the stage submesh's data axis**; the GPipe schedule emerges
+  from JAX's async dispatch — microbatch *m+1*'s stage-*s* program is
+  enqueued while microbatch *m* runs on stage *s+1*'s devices, so different
+  stages execute concurrently on disjoint device groups;
+* gradients accumulate over microbatches and each stage's optimizer update
+  runs on its own submesh;
 * inter-stage activation (and cotangent) transfers are device_put edges
   between submeshes — the ICI hop where the reference would have issued a
   Legion region copy.
@@ -27,45 +30,61 @@ Design (TPU single-controller):
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-from ..core.machine import PIPE_AXIS, mesh_axis_sizes
+from ..core.machine import DATA_AXIS, PIPE_AXIS, mesh_axis_sizes
 from ..core.op import LowerCtx
 
 
 @dataclasses.dataclass
 class PipelineConfig:
-    """compile(..., pipeline=PipelineConfig(...))."""
+    """compile(..., pipeline=PipelineConfig(...)).
+
+    ``remat=False`` (default) stores each stage's vjp residuals per
+    microbatch — the plain GPipe memory profile, no recompute.
+    ``remat=True`` rematerializes each stage's forward inside its compiled
+    backward: ~1.33x the FLOPs, but only stage-boundary activations are
+    ever stored (for memory-constrained configs).
+    """
 
     num_stages: int
     num_microbatches: int = 4
     axis: str = PIPE_AXIS
+    remat: bool = False
 
 
 def split_stages(ops: List, num_stages: int) -> List[List]:
-    """Balanced contiguous split by FLOPs (fallback: op count)."""
+    """Balanced contiguous split by FLOPs.
+
+    Stage boundaries are chosen at FLOP prefix-sum quantiles, closing a
+    stage early when exactly one op per remaining stage is left — so every
+    stage is non-empty and the concatenation of stages is the original op
+    order (contiguous in topological order).
+    """
+    n = len(ops)
+    if n < num_stages:
+        raise ValueError(f"cannot split {n} ops into {num_stages} stages")
     costs = [max(op.flops(), 1.0) for op in ops]
     total = sum(costs)
-    target = total / num_stages
-    stages: List[List] = [[] for _ in range(num_stages)]
-    acc, si = 0.0, 0
-    for op, c in zip(ops, costs):
-        if si < num_stages - 1 and acc >= target * (si + 1) and stages[si]:
-            si += 1
-        stages[si].append(op)
+    bounds: List[int] = []
+    acc = 0.0
+    for i, c in enumerate(costs):
         acc += c
-    for i in range(num_stages):  # no empty stages
-        if not stages[i]:
-            for j in range(num_stages):
-                if len(stages[j]) > 1:
-                    stages[i].append(stages[j].pop())
-                    break
-    return stages
+        if len(bounds) == num_stages - 1:
+            break
+        rem_ops = n - (i + 1)
+        rem_stages = num_stages - len(bounds) - 1
+        if (
+            acc >= total * (len(bounds) + 1) / num_stages
+            or rem_ops == rem_stages
+        ):
+            bounds.append(i + 1)
+    return [ops[a:b] for a, b in zip([0] + bounds, bounds + [n])]
 
 
 class PipelinedModel:
@@ -77,7 +96,7 @@ class PipelinedModel:
 
     def __init__(self, ops, mesh: Mesh, cfg: PipelineConfig, optimizer,
                  loss_fn, metrics_fn, input_ids: List[int], logits_id: int,
-                 params: Dict, wd_mask: Dict):
+                 params: Dict, wd_mask: Dict, opt_state=None):
         axis_sizes = mesh_axis_sizes(mesh)
         if cfg.axis not in axis_sizes:
             raise ValueError(f"mesh has no '{cfg.axis}' axis for pipelining")
@@ -123,11 +142,20 @@ class PipelinedModel:
                     sw[op.name] = wd_mask[op.name]
             self.stage_params.append(sp)
             self.stage_wd.append(sw)
-        self.stage_opt_state = [
-            optimizer.init_state(sp) for sp in self.stage_params
-        ]
-        self._stage_fwd = [self._make_stage_fwd(s) for s in range(S)]
+        self.stage_opt_state = (
+            [optimizer.init_state(sp) for sp in self.stage_params]
+            if opt_state is None else self._slice_opt_state(opt_state)
+        )
+        self._stage_fwd = [self._make_stage_fwd(s, training=True)
+                           for s in range(S)]
+        self._stage_fwd_eval = [self._make_stage_fwd(s, training=False)
+                                for s in range(S)]
+        self._stage_bwd = [self._make_stage_bwd(s) for s in range(S)]
         self._stage_update = [self._make_stage_update(s) for s in range(S)]
+        self._bwd_last = self._make_last_stage_bwd()
+        # one jitted tree-add per stage param structure (grad accumulation
+        # as ONE dispatch, not one per leaf)
+        self._acc = jax.jit(lambda a, b: jax.tree.map(jnp.add, a, b))
 
     # ------------------------------------------------------------------ #
     def _weight_sharding(self, s: int, op, wname: str) -> NamedSharding:
@@ -139,16 +167,70 @@ class PipelinedModel:
         )
         return NamedSharding(sub, PartitionSpec(*spec))
 
-    def _replicated(self, s: int, v) -> NamedSharding:
-        return NamedSharding(self.submeshes[s],
-                             PartitionSpec(*([None] * v.ndim)))
+    def _act_sharding(self, s: int, v) -> NamedSharding:
+        """Batch-dim sharding over the submesh's data axis (replicated only
+        when the microbatch doesn't divide, or there is no data axis)."""
+        sub = self.submeshes[s]
+        sizes = mesh_axis_sizes(sub)
+        dp = sizes.get(DATA_AXIS, 1)
+        if v.ndim >= 1 and dp > 1 and v.shape[0] % dp == 0:
+            return NamedSharding(
+                sub, PartitionSpec(DATA_AXIS, *([None] * (v.ndim - 1)))
+            )
+        return NamedSharding(sub, PartitionSpec(*([None] * v.ndim)))
 
     def _ship(self, s: int, tree):
-        """Move an activation/cotangent dict onto stage s's submesh."""
+        """Move an activation/cotangent dict onto stage s's submesh,
+        keeping the batch dim sharded over the stage's data axis."""
         return {
-            k: jax.device_put(v, self._replicated(s, v))
+            k: jax.device_put(v, self._act_sharding(s, v))
             for k, v in tree.items()
         }
+
+    def _slice_opt_state(self, opt_state):
+        """Per-stage optimizer state seeded from a full-model state (so a
+        checkpoint restored into the CompiledModel flows into the pipeline).
+
+        State leaves that mirror a parameter (momentum / Adam m,v) get that
+        parameter's submesh sharding; everything else (scalars, Adam's t)
+        is replicated on the submesh.
+        """
+        states = []
+        for s, sp in enumerate(self.stage_params):
+            sub = self.optimizer.slice_state(opt_state, list(sp.keys()))
+
+            def place(node, like):
+                if isinstance(node, dict):
+                    # Adam's top-level m/v mirror the params tree; op-name
+                    # and weight-name levels align with `like` directly
+                    return {
+                        k: place(
+                            v,
+                            like.get(k) if isinstance(like, dict) and k in like
+                            else (sp if k in ("m", "v") else None),
+                        )
+                        for k, v in node.items()
+                    }
+                if (
+                    like is not None
+                    and getattr(node, "shape", None) == getattr(like, "shape", None)
+                ):
+                    return jax.device_put(node, like.sharding)
+                return jax.device_put(
+                    jnp.asarray(node),
+                    NamedSharding(self.submeshes[s], PartitionSpec()),
+                )
+
+            states.append(place(sub, sp))
+        return states
+
+    @staticmethod
+    def _mb_rng(rng, m: int, s: int):
+        """Per-(microbatch, stage) PRNG key. The remat backward MUST derive
+        the identical key as the forward sweep so recomputed dropout masks
+        match — this is the single derivation point."""
+        return (jax.random.fold_in(rng, m * 131 + s)
+                if rng is not None else None)
 
     def _live_after(self, s: int) -> set:
         needed = {self.logits_id}
@@ -158,13 +240,14 @@ class PipelinedModel:
                     needed.add(t.tensor_id)
         return needed
 
-    def _make_stage_fwd(self, s: int):
+    def _stage_apply(self, s: int, training: bool):
+        """The pure stage function: acts-in -> (acts-out, aux-loss sum)."""
         stage_ops = self.stages[s]
         mesh = self.submeshes[s]
         needed = self._live_after(s)
 
         def fwd(stage_params, acts: Dict[int, jax.Array], rng):
-            ctx = LowerCtx(mesh=mesh, training=True, aux_losses=[])
+            ctx = LowerCtx(mesh=mesh, training=training, aux_losses=[])
             acts = dict(acts)
             for oi, op in enumerate(stage_ops):
                 ctx.rng = (jax.random.fold_in(rng, oi)
@@ -179,7 +262,52 @@ class PipelinedModel:
             aux_sum = sum(aux) if aux else jnp.zeros(())
             return out_acts, aux_sum
 
-        return fwd  # jitting happens implicitly through jax.vjp + jit below
+        return fwd
+
+    def _make_stage_fwd(self, s: int, training: bool):
+        fwd = self._stage_apply(s, training)
+        if not training:
+            return jax.jit(lambda p, a: fwd(p, a, None))
+        return jax.jit(fwd)
+
+    def _make_stage_bwd(self, s: int):
+        """One compiled backward per stage: recomputes the stage forward
+        inside the jit (rematerialization) and pulls cotangents back
+        through it, so no per-op residuals ever leave the program."""
+        fwd = self._stage_apply(s, training=True)
+
+        @jax.jit
+        def bwd(stage_params, acts_in, rng, d_out, d_aux):
+            _, vjp = jax.vjp(lambda p, a: fwd(p, a, rng), stage_params, acts_in)
+            dparams, dacts = vjp((d_out, d_aux))
+            return dparams, dacts
+
+        return bwd
+
+    def _make_last_stage_bwd(self):
+        """The pipeline tail as ONE compiled program: recompute the last
+        stage's forward, compute the loss, and pull cotangents back — no
+        separate logits fetch, loss dispatch, or zero-cotangent fill."""
+        S = len(self.stages)
+        fwd = self._stage_apply(S - 1, training=True)
+        loss_fn = self.loss_fn
+        logits_id = self.logits_id
+
+        @jax.jit
+        def bwd_last(stage_params, acts_in, rng, y, cot):
+            def f(p, a):
+                out, aux = fwd(p, a, rng)
+                logits = out[logits_id]
+                loss = loss_fn(logits, y)
+                return loss + aux, (loss, aux, logits)
+
+            _, vjp, (loss, aux, logits) = jax.vjp(
+                f, stage_params, acts_in, has_aux=True
+            )
+            dparams, dacts = vjp(cot)
+            return loss, aux, logits, dparams, dacts
+
+        return bwd_last
 
     def _make_stage_update(self, s: int):
         opt = self.optimizer
@@ -192,7 +320,19 @@ class PipelinedModel:
         return upd
 
     # ------------------------------------------------------------------ #
-    def train_step(self, rng, xs: Sequence[jax.Array], y: jax.Array):
+    def train_step(self, rng, xs: Sequence[jax.Array], y: jax.Array,
+                   sync: bool = True):
+        """One pipelined training step.
+
+        ``sync=True`` (default) fetches the scalar loss to host — which
+        fences the step and exposes the GPipe bubble. ``sync=False``
+        returns the per-microbatch device scalars instead
+        (``(loss_parts, aux_parts)``, combine as
+        ``(sum(map(float, loss_parts)) + sum(map(float, aux_parts))) / M``)
+        so back-to-back steps overlap across the bubble: stage 0 starts
+        step N+1's microbatches as soon as its own backward of step N is
+        done, while later stages drain.
+        """
         M = self.cfg.num_microbatches
         S = len(self.stages)
         assert xs[0].shape[0] % M == 0, (
@@ -200,57 +340,82 @@ class PipelinedModel:
         )
         xs_mb = [jnp.split(jnp.asarray(x), M, axis=0) for x in xs]
         y_mb = jnp.split(jnp.asarray(y), M, axis=0)
+        inv_m = 1.0 / M
+        cot = jnp.asarray(inv_m)  # every microbatch's loss (and each
+        daux = cot                # stage's aux term) carries 1/M weight
+        grad_acc: List[Any] = [None] * S
 
-        # ---- forward (async dispatch pipelines stages across submeshes)
+        def acc(s, dparams):
+            grad_acc[s] = (dparams if grad_acc[s] is None
+                           else self._acc(grad_acc[s], dparams))
+
+        # ---- forward sweep; the pipeline TAIL (last stage's forward, the
+        # loss, and the last stage's backward) is one compiled program, so
+        # the turnaround needs no logits fetch / separate loss dispatch.
+        # Async dispatch pipelines stages across submeshes: microbatch m+1's
+        # stage-s program is enqueued while m runs on stage s+1's devices.
+        # Non-remat (default): jax.vjp over the jitted stage function — the
+        # forward runs as one compiled program whose residuals stay on the
+        # stage's devices, and the transpose is a second cached compiled
+        # program. Remat: only stage-boundary activations are kept and the
+        # compiled backward replays the forward.
+        remat = self.cfg.remat
+        stage_in = [[None] * S for _ in range(M)]
         vjps = [[None] * S for _ in range(M)]
-        out_structs = [None] * M       # last-stage output act dicts
-        loss_vjps, losses = [None] * M, [None] * M
-        logits_mb = [None] * M
+        losses, aux_mb, logits_mb = [None] * M, [None] * M, [None] * M
+        dacts_tail = [None] * M
         for m in range(M):
             acts = self._ship(
                 0, {tid: mb[m] for tid, mb in zip(self.input_ids, xs_mb)}
             )
             aux_terms = []
-            for s in range(S):
-                mrng = (jax.random.fold_in(rng, m * 131 + s)
-                        if rng is not None else None)
-                fwd = self._stage_fwd[s]
-                (acts, aux), vjp = jax.vjp(
-                    lambda p, a: fwd(p, a, mrng), self.stage_params[s], acts
-                )
-                vjps[m][s] = vjp
+            for s in range(S - 1):
+                mrng = self._mb_rng(rng, m, s)
+                if remat:
+                    stage_in[m][s] = acts
+                    acts, aux = self._stage_fwd[s](
+                        self.stage_params[s], acts, mrng)
+                else:
+                    (acts, aux), vjps[m][s] = jax.vjp(
+                        lambda p, a, _f=self._stage_fwd[s], _r=mrng:
+                            _f(p, a, _r),
+                        self.stage_params[s], acts,
+                    )
                 aux_terms.append(aux)
-                if s < S - 1:
-                    acts = self._ship(s + 1, acts)
-            out_structs[m] = acts
-            logits = acts[self.logits_id]
-            ym = jax.device_put(y_mb[m],
-                                self._replicated(S - 1, y_mb[m]))
-            loss, lvjp = jax.vjp(
-                lambda lg, _y=ym: self.loss_fn(lg, _y), logits
+                acts = self._ship(s + 1, acts)
+            mrng = self._mb_rng(rng, m, S - 1)
+            ym = jax.device_put(y_mb[m], self._act_sharding(S - 1, y_mb[m]))
+            loss, aux, logits, dparams, dacts = self._bwd_last(
+                self.stage_params[S - 1], acts, mrng, ym, cot
             )
-            losses[m] = loss + sum(aux_terms)
-            loss_vjps[m] = lvjp
+            acc(S - 1, dparams)
+            aux_terms.append(aux)
+            # per-stage aux scalars live on different submeshes; combined on
+            # host at the end (eager adds across device sets are not allowed)
+            losses[m] = loss
+            aux_mb[m] = aux_terms
             logits_mb[m] = logits
+            if S > 1:
+                dacts_tail[m] = self._ship(S - 2, dacts)
 
-        # ---- backward (reverse stage order per microbatch)
-        inv_m = 1.0 / M
-        grad_acc: List[Any] = [None] * S
+        # ---- backward sweep over the remaining stages (reverse order per
+        # microbatch; each compiled backward replays its stage's forward
+        # with the SAME per-stage rng)
         for m in range(M):
-            (dlogits,) = loss_vjps[m](
-                jnp.asarray(inv_m, losses[m].dtype)
-            )
-            dacts = {
-                k: (dlogits if k == self.logits_id else jnp.zeros_like(v))
-                for k, v in out_structs[m].items()
-            }
-            for s in reversed(range(S)):
-                daux = jnp.asarray(inv_m)  # aux terms share the 1/M scale
-                dparams, dacts = vjps[m][s]((dacts, daux))
+            dacts = dacts_tail[m]
+            for s in reversed(range(S - 1)):
+                if remat:
+                    mrng = self._mb_rng(rng, m, s)
+                    dparams, dacts = self._stage_bwd[s](
+                        self.stage_params[s], stage_in[m][s], mrng,
+                        dacts, daux,
+                    )
+                else:
+                    dparams, dacts = vjps[m][s]((dacts, daux))
+                    vjps[m][s] = None  # free residuals
                 if s > 0:
                     dacts = self._ship(s - 1, dacts)
-                grad_acc[s] = (dparams if grad_acc[s] is None
-                               else jax.tree.map(jnp.add, grad_acc[s], dparams))
+                acc(s, dparams)
 
         # ---- per-stage optimizer update on each submesh
         for s in range(S):
@@ -258,7 +423,12 @@ class PipelinedModel:
                 self._stage_update[s](self.stage_params[s], grad_acc[s],
                                       self.stage_opt_state[s])
 
-        loss = float(sum(jax.device_get(l) for l in losses)) * inv_m
+        if not sync:
+            return losses, [a for terms in aux_mb for a in terms]
+        loss = float(
+            sum(jax.device_get(l) for l in losses)
+            + sum(jax.device_get(a) for terms in aux_mb for a in terms)
+        ) * inv_m
         bm = {}
         if self.metrics_fn is not None:
             logits = jnp.concatenate(
@@ -272,7 +442,7 @@ class PipelinedModel:
             0, {tid: jnp.asarray(x) for tid, x in zip(self.input_ids, xs)}
         )
         for s in range(len(self.stages)):
-            acts, _ = self._stage_fwd[s](self.stage_params[s], acts, None)
+            acts, _ = self._stage_fwd_eval[s](self.stage_params[s], acts)
             if s < len(self.stages) - 1:
                 acts = self._ship(s + 1, acts)
         return acts[self.logits_id]
@@ -285,9 +455,9 @@ class PipelinedModel:
         return merged
 
     def sync_to(self, cm) -> None:
-        """Write trained stage params back into the CompiledModel (full-mesh
-        shardings), so checkpointing/eval/get_weights after a pipelined fit
-        see the trained weights."""
+        """Write trained stage params AND optimizer state back into the
+        CompiledModel (full-mesh shardings), so checkpointing/eval/
+        get_weights after a pipelined fit see the trained state."""
         for sp in self.stage_params:
             for op_name, ws in sp.items():
                 if op_name not in cm.params:
@@ -296,3 +466,33 @@ class PipelinedModel:
                     cm.params[op_name][w] = jax.device_put(
                         np.asarray(v), cm.param_shardings[op_name][w]
                     )
+
+        def onto(template, sub):
+            # recurse the (subset) state tree, placing each leaf with the
+            # full-model template leaf's sharding
+            if isinstance(sub, dict):
+                return {
+                    k: onto(template[k], v) if k in template else v
+                    for k, v in sub.items()
+                }
+            return jax.device_put(np.asarray(sub), template.sharding)
+
+        merged = cm.opt_state
+        for s, sub in enumerate(self.stage_opt_state):
+            placed = onto(merged, sub)
+            merged = self.optimizer.merge_state(merged, placed)
+        cm.opt_state = merged
+
+    def sync_from(self, cm) -> None:
+        """Re-seed stage params/opt_state from the CompiledModel (after a
+        checkpoint restore into cm)."""
+        for s, stage_ops in enumerate(self.stages):
+            for op in stage_ops:
+                if op.name in cm.params:
+                    self.stage_params[s][op.name] = {
+                        w: jax.device_put(
+                            np.asarray(v), self._weight_sharding(s, op, w)
+                        )
+                        for w, v in cm.params[op.name].items()
+                    }
+        self.stage_opt_state = self._slice_opt_state(cm.opt_state)

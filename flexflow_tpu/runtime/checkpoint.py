@@ -133,6 +133,10 @@ class CheckpointManager:
         cm.params = restored["params"]
         cm.opt_state = restored["opt_state"]
         cm._iteration = int(restored["iteration"])
+        if getattr(ffmodel, "pipelined", None) is not None:
+            # pipelined training holds per-stage copies; re-seed them so the
+            # restored weights AND optimizer moments flow into the pipeline
+            ffmodel.pipelined.sync_from(cm)
         return step
 
     def close(self) -> None:

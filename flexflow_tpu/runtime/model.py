@@ -629,6 +629,7 @@ class FFModel:
                 logits_id=logits.tensor_id,
                 params=cm.params,
                 wd_mask=cm.wd_mask,
+                opt_state=cm.opt_state,
             )
         # graph exports requested via flags (reference: --compgraph /
         # --taskgraph dumps written right after compile, model.cc:3666-3674)

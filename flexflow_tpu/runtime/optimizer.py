@@ -39,6 +39,19 @@ class Optimizer:
         marking which leaves get weight decay."""
         raise NotImplementedError
 
+    # ---- state partitioning (pipeline parallelism) ---------------------- #
+    # The pipeline engine holds each stage's params (and optimizer state) on
+    # that stage's submesh. The optimizer knows its own state layout, so it
+    # provides the subset/merge operations keyed by top-level op name.
+    def slice_state(self, state: Pytree, names) -> Pytree:
+        """Subset of ``state`` covering the ops in ``names``."""
+        raise NotImplementedError
+
+    def merge_state(self, state: Pytree, sub_state: Pytree) -> Pytree:
+        """New full state with ``sub_state``'s entries written over
+        ``state``'s."""
+        raise NotImplementedError
+
 
 class SGDOptimizer(Optimizer):
     """SGD with momentum/nesterov (reference: optimizer.h:36-72;
@@ -87,6 +100,12 @@ class SGDOptimizer(Optimizer):
             new_p.append(np_)
             new_v.append(nv_)
         return treedef.unflatten(new_p), treedef.unflatten(new_v)
+
+    def slice_state(self, state, names):
+        return {k: state[k] for k in names if k in state}
+
+    def merge_state(self, state, sub_state):
+        return {**state, **sub_state}
 
 
 class AdamOptimizer(Optimizer):
@@ -149,4 +168,18 @@ class AdamOptimizer(Optimizer):
             "m": treedef.unflatten(new_m),
             "v": treedef.unflatten(new_v),
             "t": t,
+        }
+
+    def slice_state(self, state, names):
+        return {
+            "m": {k: state["m"][k] for k in names if k in state["m"]},
+            "v": {k: state["v"][k] for k in names if k in state["v"]},
+            "t": state["t"],
+        }
+
+    def merge_state(self, state, sub_state):
+        return {
+            "m": {**state["m"], **sub_state["m"]},
+            "v": {**state["v"], **sub_state["v"]},
+            "t": sub_state["t"],
         }

@@ -32,6 +32,30 @@ def _data(n=64, seed=0):
     return x, y
 
 
+def test_split_stages_tail_heavy_stays_contiguous():
+    """Repair of thin stages must shift boundaries, never reorder ops
+    (round-1 advisor finding: FLOPs [1,1,5] over 3 stages yielded
+    [[a],[c],[b]], executing b after its consumer c)."""
+
+    class FakeOp:
+        def __init__(self, name, f):
+            self.name, self._f = name, f
+
+        def flops(self):
+            return self._f
+
+    ops = [FakeOp("a", 1.0), FakeOp("b", 1.0), FakeOp("c", 5.0)]
+    stages = split_stages(ops, 3)
+    assert [[o.name for o in st] for st in stages] == [["a"], ["b"], ["c"]]
+    # heavier tail, more shapes
+    ops = [FakeOp(f"o{i}", f) for i, f in enumerate([1, 1, 1, 1, 100, 100])]
+    for S in (2, 3, 4, 5, 6):
+        stages = split_stages(ops, S)
+        assert all(stages), f"empty stage with S={S}"
+        flat = [o.name for st in stages for o in st]
+        assert flat == [o.name for o in ops], f"reordered with S={S}"
+
+
 def test_split_stages_balanced_and_contiguous():
     ff = FFModel(FFConfig(batch_size=8, seed=0))
     _build(ff, 8)
@@ -79,6 +103,71 @@ def test_pipeline_matches_single_device_training():
     assert abs(h_pp[-1].accuracy - h_sd[-1].accuracy) <= 0.15
 
 
+def test_pipeline_step_overhead_bounded():
+    """Performance-real criterion: on a compute-dominated model the
+    steady-state pipelined step stays within 1.3x of the non-pipelined
+    step on the 8-device CPU mesh (the compiled-per-stage engine; the old
+    eager engine measured ~4x). Steady-state = closed loop without
+    per-step host sync, so adjacent steps overlap across the GPipe bubble
+    — fencing every step would measure the bubble, which back-to-back
+    training amortizes."""
+    import time
+
+    H, L, bs, M = 512, 8, 128, 2
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(bs, H)).astype(np.float32)
+    y = rng.integers(0, 8, size=(bs, 1)).astype(np.int32)
+
+    def build(ff):
+        t = ff.create_tensor((bs, H), name="input")
+        for i in range(L):
+            t = ff.dense(t, H, name=f"fc{i}")
+            t = ff.relu(t, name=f"a{i}")
+        t = ff.dense(t, 8, name="head")
+        return ff.softmax(t, name="probs")
+
+    def run(pipelined, iters=10):
+        ff = FFModel(FFConfig(
+            batch_size=bs, seed=0,
+            mesh_shape={"pipe": 2, "data": 4} if pipelined else {"data": 8}))
+        build(ff)
+        kw = dict(pipeline=PipelineConfig(num_stages=2, num_microbatches=M)) \
+            if pipelined else {}
+        ff.compile(optimizer=SGDOptimizer(lr=0.01),
+                   loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                   metrics=[], **kw)
+        key = jax.random.key(0)
+        if pipelined:
+            pm = ff.pipelined
+            for _ in range(2):
+                pm.train_step(key, [jnp.asarray(x)], jnp.asarray(y))
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                parts, aux = pm.train_step(key, [jnp.asarray(x)],
+                                           jnp.asarray(y), sync=False)
+            _ = sum(float(p) for p in parts)  # fence once at the end
+            return (time.perf_counter() - t0) / iters
+        cm = ff.compiled
+        xb = jax.device_put(x, cm.input_shardings[0])
+        yb = jax.device_put(y, cm.label_sharding)
+        p, o = cm.params, cm.opt_state
+        for _ in range(2):
+            p, o, loss, _ = cm.train_step(p, o, key, xb, yb)
+        float(loss)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            p, o, loss, _ = cm.train_step(p, o, key, xb, yb)
+        float(loss)  # fences the dependency chain
+        return (time.perf_counter() - t0) / iters
+
+    # best-of-2 per path: damps transient machine-load noise
+    tp = min(run(True), run(True))
+    tn = min(run(False), run(False))
+    assert tp <= 1.3 * tn, (
+        f"pipelined step {tp*1e3:.1f} ms > 1.3x non-pipelined {tn*1e3:.1f} ms"
+    )
+
+
 def test_pipeline_forward_only():
     bs = 8
     ff = FFModel(FFConfig(batch_size=bs, seed=0, mesh_shape={"pipe": 2, "data": 4}))
@@ -91,6 +180,73 @@ def test_pipeline_forward_only():
     out = np.asarray(ff.pipelined.forward_only([jnp.asarray(x)]))
     assert out.shape == (bs, 4)
     np.testing.assert_allclose(out.sum(axis=-1), 1.0, rtol=1e-5)
+
+
+def test_pipeline_momentum_matches_single_device():
+    """Optimizer state must accumulate correctly per stage: momentum-SGD
+    pipelined training equals non-pipelined training."""
+    bs = 16
+    x, y = _data(n=bs)
+
+    def run(pipelined):
+        ff = FFModel(FFConfig(
+            batch_size=bs, epochs=4, seed=0,
+            mesh_shape={"pipe": 2, "data": 4} if pipelined else {"data": 8},
+        ))
+        _build(ff, bs)
+        kw = dict(pipeline=PipelineConfig(num_stages=2, num_microbatches=4)) \
+            if pipelined else {}
+        ff.compile(optimizer=SGDOptimizer(lr=0.1, momentum=0.9),
+                   loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                   metrics=[], **kw)
+        ff.fit(x, y, verbose=False, shuffle=False)
+        params = ff.pipelined.all_params() if pipelined else ff.compiled.params
+        return {k: {w: np.asarray(v) for w, v in ws.items()}
+                for k, ws in params.items()}
+
+    p_pp, p_sd = run(True), run(False)
+    for name in p_sd:
+        for w in p_sd[name]:
+            np.testing.assert_allclose(
+                p_pp[name][w], p_sd[name][w], rtol=5e-4, atol=5e-5,
+                err_msg=f"{name}/{w}")
+
+
+def test_pipelined_checkpoint_roundtrips_opt_state(tmp_path):
+    """sync_to must carry optimizer state into cm (round-1 advisor: a
+    checkpoint after a pipelined fit recorded untouched initial state), and
+    restore must re-seed the pipeline's per-stage state."""
+    bs = 16
+    x, y = _data(n=64)
+
+    def make():
+        ff = FFModel(FFConfig(batch_size=bs, epochs=2, seed=0,
+                              mesh_shape={"pipe": 2, "data": 4}))
+        _build(ff, bs)
+        ff.compile(optimizer=SGDOptimizer(lr=0.1, momentum=0.9),
+                   loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                   metrics=[],
+                   pipeline=PipelineConfig(num_stages=2, num_microbatches=4))
+        return ff
+
+    ff = make()
+    ff.fit(x, y, verbose=False, shuffle=False)
+    # sync_to ran inside fit: cm.opt_state now holds real momenta
+    mom = {k: {w: np.asarray(v) for w, v in ws.items()}
+           for k, ws in ff.compiled.opt_state.items()}
+    assert any(np.abs(v).max() > 0 for ws in mom.values() for v in ws.values()), \
+        "cm.opt_state still zeros after pipelined fit"
+    ff.save_checkpoint(str(tmp_path / "ck"), step=1)
+
+    ff2 = make()
+    ff2.load_checkpoint(str(tmp_path / "ck"))
+    # per-stage state must match what was saved
+    for s, sp in enumerate(ff2.pipelined.stage_params):
+        for op_name in sp:
+            for w, v in ff2.pipelined.stage_opt_state[s][op_name].items():
+                np.testing.assert_allclose(
+                    np.asarray(v), mom[op_name][w], rtol=1e-6,
+                    err_msg=f"stage{s} {op_name}/{w}")
 
 
 def test_pipelined_fit_syncs_compiled_params(tmp_path):
