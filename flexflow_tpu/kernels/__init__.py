@@ -43,9 +43,10 @@ def interpret_flag() -> bool:
 
 
 def use_pallas(ctx) -> bool:
-    """Shared op-level gate: Pallas kernels engage on single-device
-    lowerings only; multi-device meshes keep the jnp paths, which GSPMD
-    partitions (a pallas_call there would need shard_map wrapping)."""
+    """Op-level gate for kernels WITHOUT a shard_map composition yet
+    (MoE dispatch/combine): single-device lowerings only. Flash attention
+    has its own mesh-aware gate (``flash_attention.sharded_supported``) and
+    engages on dp x tp meshes via shard_map."""
     return pallas_mode() is not None and (
         getattr(ctx, "mesh", None) is None or ctx.mesh.size == 1
     )

@@ -193,6 +193,47 @@ def supported(q_shape, k_shape) -> bool:
     return max(working, fwd) <= VMEM_BUDGET_BYTES
 
 
+def sharded_supported(q_shape, k_shape, mesh, batch_axis, heads_axis) -> bool:
+    """Whether the shard_map-wrapped kernel handles these GLOBAL (B,S,H,D)
+    shapes on this mesh: batch/heads must divide by their axis sizes and
+    the per-shard block must satisfy :func:`supported`."""
+    from ..core.machine import mesh_axis_sizes
+
+    sizes = mesh_axis_sizes(mesh)
+    ddeg = sizes.get(batch_axis, 1) if batch_axis else 1
+    hdeg = sizes.get(heads_axis, 1) if heads_axis else 1
+    b, sq, h, d = q_shape
+    if b % ddeg or h % hdeg:
+        return False
+    lq = (b // ddeg, sq, h // hdeg, d)
+    lk = (k_shape[0] // ddeg, k_shape[1], k_shape[2] // hdeg, d)
+    return supported(lq, lk)
+
+
+def sharded_flash_attention(q, k, v, mesh, batch_axis, heads_axis,
+                            causal: bool = False,
+                            scale: Optional[float] = None,
+                            block_q: int = 128) -> jax.Array:
+    """Flash attention composed with SPMD sharding via shard_map.
+
+    Attention is independent across batch and heads, so each device runs
+    the single-core kernel on its (B/dp, S, H/tp, D) block — this is what
+    lets the Pallas path engage on dp x tp meshes instead of falling back
+    to the jnp einsums (the reference's cuDNN path is likewise per-GPU
+    under its MachineView — src/ops/attention.cu). Sequence-sharded
+    attention goes through parallel/ring_attention.py instead.
+    """
+    from jax.sharding import PartitionSpec
+
+    spec = PartitionSpec(batch_axis, None, heads_axis, None)
+    fn = functools.partial(flash_attention, causal=causal, scale=scale,
+                           block_q=block_q)
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )(q, k, v)
+
+
 def flash_attention(q, k, v, causal: bool = False,
                     scale: Optional[float] = None,
                     block_q: int = 128) -> jax.Array:

@@ -137,15 +137,33 @@ class MultiHeadAttention(Op):
                 dropout_rate=drop, rng=ctx.rng,
             )
         else:
-            from ..kernels import flash_attention as fa, use_pallas
+            from ..kernels import flash_attention as fa, pallas_mode
 
-            if drop == 0.0 and use_pallas(ctx) and fa.supported(qh.shape, kh.shape):
-                # Pallas fused attention: (S,S) logits never touch HBM.
-                # Multi-device meshes keep the jnp path (GSPMD partitions
-                # the einsums; a pallas_call would need shard_map wrapping).
-                ctxv = fa.flash_attention(qh, kh, vh, causal=self.causal,
-                                          scale=scale)
-            else:
+            ctxv = None
+            if drop == 0.0 and pallas_mode() is not None:
+                mesh = ctx.mesh
+                if mesh is None or mesh.size == 1:
+                    if fa.supported(qh.shape, kh.shape):
+                        # Pallas fused attention: (S,S) logits never
+                        # touch HBM.
+                        ctxv = fa.flash_attention(
+                            qh, kh, vh, causal=self.causal, scale=scale)
+                else:
+                    # multi-device: shard_map the kernel over the batch /
+                    # heads mesh axes (attention is independent across
+                    # both), so dp x tp configs run the fused kernel too
+                    bdim = self.input_shapes[0].dims[0]
+                    batch_ax = bdim.axis if bdim.is_partitioned else None
+                    wq = self.weight_shapes.get("wq")
+                    hdim = wq.dims[1] if wq is not None else None
+                    heads_ax = (hdim.axis if hdim is not None and
+                                hdim.is_partitioned else None)
+                    if fa.sharded_supported(qh.shape, kh.shape, mesh,
+                                            batch_ax, heads_ax):
+                        ctxv = fa.sharded_flash_attention(
+                            qh, kh, vh, mesh, batch_ax, heads_ax,
+                            causal=self.causal, scale=scale)
+            if ctxv is None:
                 ctxv = single_device_attention(
                     qh, kh, vh, self.causal, scale, drop, ctx.rng
                 )
