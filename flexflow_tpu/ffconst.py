@@ -149,6 +149,10 @@ class OpType(enum.Enum):
     CACHE = "cache"
     AGGREGATE = "aggregate"
     AGGREGATE_SPEC = "aggregate_spec"
+    # stacked (single-tensor) MoE pipeline: the expert-parallel formulation
+    GROUP_BY_STACKED = "group_by_stacked"
+    EXPERT_LINEAR = "expert_linear"
+    AGGREGATE_STACKED = "aggregate_stacked"
     RESHAPE = "reshape"
     REVERSE = "reverse"
     TRANSPOSE = "transpose"

@@ -24,11 +24,16 @@ class MoeConfig:
     lambda_bal: float = 0.04
 
 
-def build_moe_mnist(ff: FFModel, batch_size: int, cfg: Optional[MoeConfig] = None):
+def build_moe_mnist(ff: FFModel, batch_size: int, cfg: Optional[MoeConfig] = None,
+                    stacked: bool = False, expert_axis: Optional[str] = None):
+    """``stacked=True`` builds the expert-parallel formulation;
+    ``expert_axis`` additionally pins the EP strategy on the group_by layer
+    (otherwise leave it to compile(strategies=...) or the search)."""
     cfg = cfg or MoeConfig()
     x = ff.create_tensor((batch_size, cfg.input_dim), DataType.FLOAT, name="input")
     t = ff.moe(x, cfg.num_exp, cfg.num_select, cfg.expert_hidden_size,
-               cfg.alpha, cfg.lambda_bal)
+               cfg.alpha, cfg.lambda_bal, stacked=stacked,
+               expert_axis=expert_axis, name="moe")
     t = ff.dense(t, cfg.num_classes, name="moe_head")
     t = ff.softmax(t)
     return x, t

@@ -32,7 +32,7 @@ from typing import List, Tuple
 import jax
 import jax.numpy as jnp
 
-from ..ffconst import DataType, OpType
+from ..ffconst import ActiMode, DataType, OpType
 from ..core.op import Op, register_op
 from ..core.parallel_tensor import ParallelDim, ParallelTensorShape
 
@@ -85,6 +85,24 @@ def moe_dispatch_mask(assign: jnp.ndarray, n: int, capacity: int) -> jnp.ndarray
     ] * poh[:, None, :]
 
 
+def _dispatch_rows(ctx, x, assign, n: int, capacity: int, k: int):
+    """Global-order dispatch: x (B, feat...) -> stacked (n, capacity,
+    feat...) expert rows (the shared scatter of GroupBy / GroupByStacked;
+    reference: group_by.cu)."""
+    feat = x.shape[1:]
+    xf = x.reshape(x.shape[0], -1)
+    if _use_pallas(ctx):
+        from ..kernels.moe_kernels import moe_dispatch
+
+        rows = moe_dispatch(xf, assign, n, capacity)
+    else:
+        # each sample is duplicated for each of its k expert picks
+        xk = jnp.repeat(xf, k, axis=0)  # (T, d)
+        dispatch = moe_dispatch_mask(assign, n, capacity)  # (T,n,c)
+        rows = jnp.einsum("tnc,tf->ncf", dispatch, xk)  # (n,c,d)
+    return rows.reshape((n, capacity) + feat)
+
+
 @register_op
 class GroupBy(Op):
     """reference: src/ops/group_by.cc — scatter input rows into n
@@ -106,19 +124,8 @@ class GroupBy(Op):
 
     def forward(self, ctx, inputs, weights):
         x, assign = inputs
-        if _use_pallas(ctx):
-            from ..kernels.moe_kernels import moe_dispatch
-
-            rows = moe_dispatch(x, assign, self.n, self.capacity)  # (n,c,…)
-            return [rows[e] for e in range(self.n)]
-        B = x.shape[0]
-        xf = x.reshape(B, -1)
-        # each sample is duplicated for each of its k expert picks
-        xk = jnp.repeat(xf, self.k, axis=0)  # (T, d)
-        dispatch = moe_dispatch_mask(assign, self.n, self.capacity)  # (T,n,c)
-        expert_rows = jnp.einsum("tnc,tf->ncf", dispatch, xk)  # (n,c,d)
-        out_shape = (self.capacity,) + x.shape[1:]
-        return [expert_rows[e].reshape(out_shape) for e in range(self.n)]
+        rows = _dispatch_rows(ctx, x, assign, self.n, self.capacity, self.k)
+        return [rows[e] for e in range(self.n)]
 
 
 class _AggregateBase(Op):
@@ -135,8 +142,9 @@ class _AggregateBase(Op):
         # (batch, out_dim) — reference: aggregate.cc:149-152
         return [((self.batch, self.out_dim), self.input_shapes[4].dtype)]
 
-    def _combine(self, gate_weights, assign, exp_preds, ctx=None):
-        stacked = jnp.stack([p.reshape(self.capacity, -1) for p in exp_preds])  # (n,c,d)
+    def _combine(self, gate_weights, assign, stacked, ctx=None):
+        """Gate-weighted combine of stacked (n, capacity, d) expert rows
+        (reference: aggregate.cu gather)."""
         if ctx is not None and _use_pallas(ctx):
             from ..kernels.moe_kernels import moe_combine
 
@@ -146,6 +154,9 @@ class _AggregateBase(Op):
         combine = dispatch * gate_weights.reshape(-1)[:, None, None]
         out_flat = jnp.einsum("tnc,ncf->tf", combine, stacked)  # (T,d)
         return out_flat.reshape(self.batch, self.k, -1).sum(axis=1)
+
+    def _stack(self, exp_preds):
+        return jnp.stack([p.reshape(self.capacity, -1) for p in exp_preds])
 
     def _balance_aux(self, full_gate, assign):
         """Straight-through auxiliary loss whose gradient wrt ``full_gate``
@@ -170,8 +181,7 @@ class Aggregate(_AggregateBase):
 
     def forward(self, ctx, inputs, weights):
         gate_preds, assign, _true_assign, full_gate = inputs[:4]
-        exp_preds = inputs[4:]
-        out = self._combine(gate_preds, assign, exp_preds, ctx)
+        out = self._combine(gate_preds, assign, self._stack(inputs[4:]), ctx)
         aux = self._balance_aux(full_gate, assign)
         if aux is not None and hasattr(ctx, "aux_losses") and ctx.aux_losses is not None:
             ctx.aux_losses.append(aux)
@@ -188,13 +198,281 @@ class AggregateSpec(_AggregateBase):
 
     def forward(self, ctx, inputs, weights):
         gate_preds, assign, _true_assign, full_gate = inputs[:4]
-        exp_preds = inputs[4:]
         uniform = jnp.full_like(gate_preds, 1.0 / self.k)
-        out = self._combine(uniform, assign, exp_preds, ctx)
+        out = self._combine(uniform, assign, self._stack(inputs[4:]), ctx)
         aux = self._balance_aux(full_gate, assign)
         if aux is not None and hasattr(ctx, "aux_losses") and ctx.aux_losses is not None:
             ctx.aux_losses.append(aux)
         return [out]
+
+
+# --------------------------------------------------------------------------
+# Stacked MoE pipeline — the EXPERT-PARALLEL formulation.
+#
+# The n-output GroupBy above mirrors the reference API (one tensor per
+# expert, each with its own dense ops), but n separate ops cannot shard
+# "across experts". The stacked pipeline keeps all experts in ONE
+# (n, capacity, d) tensor whose expert dim is a first-class ParallelDim:
+# shard it over a mesh axis and the experts are truly distributed
+# (SURVEY.md §2.3 EP; reference: group_by.cu/aggregate.cu data movement).
+#
+# ROUTING-LAYOUT INVARIANT: GroupByStacked and AggregateStacked each decide
+# between two routings from the SAME structural predicate —
+#   expert dim sharded over axis ax  AND  ax == the token (batch) axis
+#   AND capacity % N == 0:
+#     -> per-shard dispatch + all-to-all over ICI (rows grouped by source
+#        shard; reference analog: group_by.cu scatter + NCCL a2a)
+#   otherwise:
+#     -> global one-hot dispatch/combine einsums (rows in global token
+#        order; GSPMD inserts whatever collectives the shardings imply)
+# Both ops see the same shapes, so the predicate — and therefore the row
+# layout — always agrees between dispatch and combine.
+# --------------------------------------------------------------------------
+
+
+def _ep_axis(shape: ParallelTensorShape, token_dim) -> Tuple[str, int] | None:
+    """The (axis, degree) of the hand-scheduled EP path, or None.
+
+    ``shape``: the stacked (n, capacity, d) tensor; ``token_dim``: the
+    batch ParallelDim of the assign tensor. See ROUTING-LAYOUT INVARIANT.
+    """
+    ed = shape.dims[0]
+    if not ed.is_partitioned:
+        return None
+    if token_dim is None or not token_dim.is_partitioned:
+        return None
+    if ed.axis != token_dim.axis:
+        return None
+    if shape.dims[1].size % ed.degree != 0:
+        return None
+    return ed.axis, ed.degree
+
+
+@register_op
+class GroupByStacked(Op):
+    """GroupBy emitting one stacked (n, capacity, d) tensor (see the
+    module-level EP note; reference: src/ops/group_by.cc semantics)."""
+
+    op_type = OpType.GROUP_BY_STACKED
+
+    def __init__(self, layer, input_shapes):
+        super().__init__(layer, input_shapes)
+        self.n = self.attrs["n"]
+        self.alpha = float(self.attrs["alpha"])
+        self.k = input_shapes[1].sizes[-1]
+        self.batch = input_shapes[0].sizes[0]
+        self.capacity = expert_capacity(self.batch, self.k, self.n, self.alpha)
+
+    def infer_output_shapes(self):
+        d = self.input_shapes[0].sizes[1:]
+        return [((self.n, self.capacity) + d, self.input_shapes[0].dtype)]
+
+    def propagate(self, input_shapes, strategy):
+        out_shapes, weight_shapes = super().propagate(input_shapes, strategy)
+        axis_sizes = strategy.get("_axis_sizes", {})
+        ax = strategy.get("expert")
+        if ax:
+            deg = axis_sizes.get(ax, 1)
+            if deg > 1 and self.n % deg == 0:
+                # base propagate may have matched dim0 (size n) against the
+                # input batch dim; overwrite with the expert sharding
+                out_shapes[0] = ParallelTensorShape(
+                    (ParallelDim(self.n, deg, ax),)
+                    + tuple(ParallelDim(d.size) for d in out_shapes[0].dims[1:]),
+                    out_shapes[0].dtype,
+                )
+        else:
+            # dim0 is the EXPERT dim — it must not inherit the input's
+            # batch sharding even when n happens to equal the batch size
+            out_shapes[0] = ParallelTensorShape(
+                tuple(ParallelDim(d.size) for d in out_shapes[0].dims),
+                out_shapes[0].dtype,
+            )
+        return out_shapes, weight_shapes
+
+    def forward(self, ctx, inputs, weights):
+        x, assign = inputs
+        feat = x.shape[1:]
+        ep = _ep_axis(self.output_shapes[0], self.input_shapes[1].dims[0]) \
+            if self.output_shapes else None
+        if ep is not None and ctx.mesh is not None:
+            from jax.sharding import PartitionSpec as P
+
+            from ..kernels import pallas_mode
+            from ..parallel.collectives import expert_all_to_all
+
+            ax, deg = ep
+            c_loc = self.capacity // deg
+            n, k = self.n, self.k
+            use_kernel = pallas_mode() is not None
+
+            def body(x_loc, assign_loc):
+                # per-shard dispatch (reference: group_by.cu scatter)
+                xf = x_loc.reshape(x_loc.shape[0], -1)
+                if use_kernel:
+                    from ..kernels.moe_kernels import moe_dispatch
+
+                    return moe_dispatch(xf, assign_loc, n, c_loc)
+                xk = jnp.repeat(xf, k, axis=0)
+                disp = moe_dispatch_mask(assign_loc, n, c_loc)
+                return jnp.einsum("tnc,tf->ncf", disp, xk)
+
+            rows = jax.shard_map(
+                body, mesh=ctx.mesh,
+                in_specs=(P(ax, *([None] * (x.ndim - 1))), P(ax, None)),
+                out_specs=P(None, ax, None),
+                check_vma=False,  # pallas_call outputs carry no vma typing
+            )(x, assign)
+            # redistribute token-sharded rows onto the expert owners (ICI
+            # all-to-all; reference analog: NCCL a2a in group_by's shuffle)
+            rows = expert_all_to_all(rows, ctx.mesh, ax)
+            return [rows.reshape((self.n, self.capacity) + feat)]
+        return [_dispatch_rows(ctx, x, assign, self.n, self.capacity, self.k)]
+
+    def flops(self) -> float:
+        d = 1
+        for s in self.input_shapes[0].sizes[1:]:
+            d *= s
+        return 2.0 * self.batch * self.k * self.n * self.capacity * d
+
+
+@register_op
+class ExpertLinear(Op):
+    """Per-expert dense over the stacked (n, capacity, d) tensor: weight
+    (n, d, out) shards on the expert dim, so each device computes only its
+    experts (reference analog: the per-expert Linear ops of moe.cc:20-45,
+    here batched so EP is expressible)."""
+
+    op_type = OpType.EXPERT_LINEAR
+
+    def __init__(self, layer, input_shapes):
+        super().__init__(layer, input_shapes)
+        self.out_dim = layer.attrs["out_dim"]
+        self.activation = layer.attrs.get("activation", ActiMode.NONE)
+        self.use_bias = layer.attrs.get("use_bias", True)
+        self.n = input_shapes[0].sizes[0]
+        self.capacity = input_shapes[0].sizes[1]
+        self.in_dim = input_shapes[0].sizes[-1]
+
+    def infer_output_shapes(self):
+        return [((self.n, self.capacity, self.out_dim),
+                 self.input_shapes[0].dtype)]
+
+    def weight_specs(self):
+        from ..core.op import WeightSpec
+        from ..runtime.initializer import (DefaultBiasInitializer,
+                                           DefaultWeightInitializer)
+
+        dt = self.input_shapes[0].dtype
+        specs = [WeightSpec(
+            "kernel", (self.n, self.in_dim, self.out_dim), dt,
+            self.attrs.get("kernel_initializer") or DefaultWeightInitializer(),
+            weight_decay=True,
+        )]
+        if self.use_bias:
+            specs.append(WeightSpec(
+                "bias", (self.n, self.out_dim), dt,
+                self.attrs.get("bias_initializer") or DefaultBiasInitializer(),
+                weight_decay=False,
+            ))
+        return specs
+
+    def propagate(self, input_shapes, strategy):
+        out_shapes, weight_shapes = super().propagate(input_shapes, strategy)
+        axis_sizes = strategy.get("_axis_sizes", {})
+        in0 = input_shapes[0]
+        # expert sharding: explicit strategy, else inherit the input's
+        # expert-dim sharding so weights stay local to their experts
+        ax = strategy.get("expert") or (
+            in0.dims[0].axis if in0.dims[0].is_partitioned else None
+        )
+        if ax:
+            deg = axis_sizes.get(ax, in0.dims[0].degree or 1)
+            if deg > 1 and self.n % deg == 0:
+                out_shapes[0] = out_shapes[0].partitioned(0, deg, ax)
+                weight_shapes["kernel"] = weight_shapes["kernel"].partitioned(0, deg, ax)
+                if self.use_bias:
+                    weight_shapes["bias"] = weight_shapes["bias"].partitioned(0, deg, ax)
+        return out_shapes, weight_shapes
+
+    def forward(self, ctx, inputs, weights):
+        (x,) = inputs
+        y = jnp.einsum("ecd,edh->ech", x, weights["kernel"])
+        if self.use_bias:
+            y = y + weights["bias"][:, None, :]
+        from .linear import apply_activation
+
+        return [apply_activation(y, self.activation)]
+
+    def flops(self) -> float:
+        return 2.0 * self.n * self.capacity * self.in_dim * self.out_dim
+
+
+@register_op
+class AggregateStacked(_AggregateBase):
+    """Aggregate over the stacked expert tensor. Inputs:
+    [gate_preds (B,k), gate_assign (B,k), full_gate (B,n),
+    exp_stacked (n, capacity, f)] -> (B, f). Routing layout follows the
+    module-level invariant (must mirror GroupByStacked's choice)."""
+
+    op_type = OpType.AGGREGATE_STACKED
+
+    def __init__(self, layer, input_shapes):
+        Op.__init__(self, layer, input_shapes)
+        self.n = self.attrs["n"]
+        self.lambda_bal = float(self.attrs["lambda_bal"])
+        self.k = input_shapes[0].sizes[-1]
+        self.batch = input_shapes[0].sizes[0]
+        self.capacity = input_shapes[3].sizes[1]
+        self.out_dim = input_shapes[3].sizes[-1]
+
+    def infer_output_shapes(self):
+        return [((self.batch, self.out_dim), self.input_shapes[3].dtype)]
+
+    def forward(self, ctx, inputs, weights):
+        gate_preds, assign, full_gate, stacked = inputs
+        ep = _ep_axis(self.input_shapes[3], self.input_shapes[1].dims[0])
+        if ep is not None and ctx.mesh is not None:
+            from jax.sharding import PartitionSpec as P
+
+            from ..kernels import pallas_mode
+            from ..parallel.collectives import experts_to_tokens
+
+            ax, deg = ep
+            c_loc = self.capacity // deg
+            n, k = self.n, self.k
+            use_kernel = pallas_mode() is not None
+            # expert outputs back to the token-owning shards (inverse a2a)
+            rows = experts_to_tokens(
+                stacked.reshape(self.n, self.capacity, -1), ctx.mesh, ax)
+
+            def body(rows_loc, assign_loc, gate_loc):
+                if use_kernel:
+                    from ..kernels.moe_kernels import moe_combine
+
+                    return moe_combine(rows_loc, assign_loc, gate_loc)
+                disp = moe_dispatch_mask(assign_loc, n, c_loc)
+                comb = disp * gate_loc.reshape(-1)[:, None, None]
+                out = jnp.einsum("tnc,ncf->tf", comb, rows_loc)
+                return out.reshape(gate_loc.shape[0], k, -1).sum(axis=1)
+
+            out = jax.shard_map(
+                body, mesh=ctx.mesh,
+                in_specs=(P(None, ax, None), P(ax, None), P(ax, None)),
+                out_specs=P(ax, None),
+                check_vma=False,  # pallas_call outputs carry no vma typing
+            )(rows, assign, gate_preds)
+        else:
+            out = self._combine(
+                gate_preds, assign,
+                stacked.reshape(self.n, self.capacity, -1), ctx)
+        aux = self._balance_aux(full_gate, assign)
+        if aux is not None and ctx.aux_losses is not None:
+            ctx.aux_losses.append(aux)
+        return [out]
+
+    def flops(self) -> float:
+        return 2.0 * self.batch * self.k * self.n * self.capacity * self.out_dim
 
 
 @register_op

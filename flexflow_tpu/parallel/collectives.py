@@ -84,3 +84,16 @@ def expert_all_to_all(x: jax.Array, mesh: Mesh, axis: str) -> jax.Array:
 
     fn = jax.shard_map(body, mesh=mesh, in_specs=P(None, axis), out_specs=P(axis, None))
     return fn(x)
+
+
+def experts_to_tokens(x: jax.Array, mesh: Mesh, axis: str) -> jax.Array:
+    """Inverse of :func:`expert_all_to_all`: x sharded on dim 0 (experts),
+    returns x sharded on dim 1 (tokens) — the combine-side data movement of
+    expert parallelism (reference analog: aggregate.cu gathering expert
+    outputs back to the token-owning devices)."""
+
+    def body(xs):
+        return jax.lax.all_to_all(xs, axis, split_axis=1, concat_axis=0, tiled=True)
+
+    fn = jax.shard_map(body, mesh=mesh, in_specs=P(axis, None), out_specs=P(None, axis))
+    return fn(x)

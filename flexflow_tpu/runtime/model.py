@@ -422,6 +422,41 @@ class FFModel:
         """reference: FFModel::aggregate_spec (model.h:459)."""
         return self._infer_and_add(OpType.AGGREGATE_SPEC, list(inputs), dict(n=n, lambda_bal=lambda_bal), name)
 
+    def group_by_stacked(self, input: Tensor, assign: Tensor, n: int,
+                         alpha: float, name=None,
+                         strategy: Optional[Dict[str, str]] = None) -> Tensor:
+        """GroupBy emitting one stacked (n, capacity, d) tensor whose expert
+        dim is shardable over a mesh axis — the expert-parallel formulation
+        (reference semantics: src/ops/group_by.cc; EP per SURVEY.md §2.3).
+        ``strategy={"expert": axis}`` pins the EP axis."""
+        attrs = dict(n=n, alpha=alpha)
+        if strategy:
+            attrs["strategy"] = strategy
+        return self._infer_and_add(OpType.GROUP_BY_STACKED, [input, assign],
+                                   attrs, name)
+
+    def expert_linear(self, input: Tensor, out_dim: int,
+                      activation: ActiMode = ActiMode.NONE,
+                      use_bias: bool = True, kernel_initializer=None,
+                      name=None) -> Tensor:
+        """Per-expert dense over a stacked (n, capacity, d) tensor; the
+        (n, d, out) weight shards on the expert dim (batched equivalent of
+        the reference's per-expert Linear ops, moe.cc:20-45)."""
+        attrs = dict(out_dim=out_dim, activation=activation, use_bias=use_bias)
+        if kernel_initializer is not None:
+            attrs["kernel_initializer"] = kernel_initializer
+        return self._infer_and_add(OpType.EXPERT_LINEAR, [input], attrs, name)
+
+    def aggregate_stacked(self, gate_preds: Tensor, assign: Tensor,
+                          full_gate: Tensor, exp_stacked: Tensor, n: int,
+                          lambda_bal: float, name=None) -> Tensor:
+        """Aggregate over the stacked expert tensor (reference semantics:
+        src/ops/aggregate.cc, incl. the lambda_bal balance gradient)."""
+        return self._infer_and_add(
+            OpType.AGGREGATE_STACKED,
+            [gate_preds, assign, full_gate, exp_stacked],
+            dict(n=n, lambda_bal=lambda_bal), name)
+
     def moe(
         self,
         input: Tensor,
@@ -430,16 +465,38 @@ class FFModel:
         expert_hidden_size: int,
         alpha: float = 2.0,
         lambda_bal: float = 0.04,
+        stacked: bool = False,
+        expert_axis: Optional[str] = None,
         name=None,
     ) -> Tensor:
         """Composite MoE layer (reference: FFModel::moe src/ops/moe.cc:20-45:
         gate = dense(input, num_exp, RELU); topk_{vals,idx} = top_k(gate, k);
         exp_i = group_by(input, idx, n, alpha); agg = aggregate(
-        [softmax(vals), idx, idx, gate, softmax(dense(exp_i, hidden, RELU))…]))."""
+        [softmax(vals), idx, idx, gate, softmax(dense(exp_i, hidden, RELU))…])).
+
+        ``stacked=True`` builds the expert-parallel formulation instead:
+        one group_by_stacked -> expert_linear -> aggregate_stacked chain
+        whose expert dim shards over a mesh axis (``expert_axis``, or a
+        compile(strategies=...) entry, or found by the search).
+        Same math; the n-branch form mirrors the reference API.
+        """
+        if expert_axis is not None and not stacked:
+            raise ValueError("expert_axis requires stacked=True (the "
+                             "n-branch formulation cannot shard experts)")
         nm = name or "moe"
         gate = self.dense(input, num_exp, ActiMode.RELU, name=f"{nm}_gate")
         topk_out, topk_idx = self.top_k(gate, num_select, sorted=False)
         gate_sm = self.softmax(topk_out)
+        if stacked:
+            grouped = self.group_by_stacked(
+                input, topk_idx, num_exp, alpha, name=f"{nm}_group",
+                strategy={"expert": expert_axis} if expert_axis else None)
+            h = self.expert_linear(grouped, expert_hidden_size, ActiMode.RELU,
+                                   name=f"{nm}_experts")
+            h = self.softmax(h)
+            return self.aggregate_stacked(gate_sm, topk_idx, gate, h,
+                                          num_exp, lambda_bal,
+                                          name=f"{nm}_agg")
         agg_inputs = [gate_sm, topk_idx, topk_idx, gate]
         grouped = self.group_by(input, topk_idx, num_exp, alpha)
         for i, g in enumerate(grouped):

@@ -116,6 +116,15 @@ def candidate_strategies(
         for a in model_axes:
             if out_c % axis_sizes[a] == 0:
                 cands.append({"out_channels": a})
+    elif t is OpType.GROUP_BY_STACKED and param_ok:
+        # expert parallelism: shard the stacked expert dim. The data axis is
+        # a legitimate EP axis here (GShard-style: expert shards colocate
+        # with token shards, dispatch rides an all-to-all) — downstream
+        # expert_linear/aggregate_stacked follow the sharding structurally.
+        n_exp = layer.attrs.get("n", 0)
+        for a, sz in axis_sizes.items():
+            if sz > 1 and a != "pipe" and n_exp % sz == 0:
+                cands.append({"expert": a})
 
     for template in _JSON_RULES.get(t.name, []):
         c = _expand(template, axis_sizes)

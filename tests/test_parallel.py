@@ -113,6 +113,142 @@ def test_seq_parallel_attention_in_model():
     assert np.isfinite(float(loss))
 
 
+def test_experts_to_tokens_inverts_expert_all_to_all():
+    from flexflow_tpu.parallel.collectives import experts_to_tokens
+
+    mesh = make_mesh({"data": 8})
+    x = np.arange(8 * 16 * 4, dtype=np.float32).reshape(8, 16, 4)
+    xs = jax.device_put(x, jax.sharding.NamedSharding(mesh, P(None, "data")))
+    roundtrip = experts_to_tokens(expert_all_to_all(xs, mesh, "data"),
+                                  mesh, "data")
+    np.testing.assert_array_equal(np.asarray(roundtrip), x)
+
+
+def _moe_data(n=64, dim=16, classes=4, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, dim)).astype(np.float32)
+    w = rng.normal(size=(dim, classes)).astype(np.float32)
+    y = np.argmax(x @ w, axis=1).astype(np.int32).reshape(-1, 1)
+    return x, y
+
+
+def _run_moe(mesh_shape, stacked, expert_axis, bs=64, epochs=3, pallas=None,
+             monkeypatch=None):
+    from flexflow_tpu.models.moe import MoeConfig, build_moe_mnist
+
+    if pallas is not None and monkeypatch is not None:
+        monkeypatch.setenv("FLEXFLOW_TPU_PALLAS", pallas)
+    cfg = MoeConfig(input_dim=16, num_classes=4, num_exp=8, num_select=2,
+                    expert_hidden_size=32, alpha=4.0)  # alpha 4: no drops
+    ff = FFModel(FFConfig(batch_size=bs, epochs=epochs, seed=0))
+    build_moe_mnist(ff, bs, cfg, stacked=stacked, expert_axis=expert_axis)
+    n_dev = int(np.prod(list(mesh_shape.values())))
+    mesh = make_mesh(mesh_shape, devices=jax.devices()[:n_dev])
+    ff.compile(optimizer=SGDOptimizer(lr=0.1),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=[MetricsType.ACCURACY], mesh=mesh)
+    x, y = _moe_data(n=bs, dim=16, classes=4)
+    hist = ff.fit(x, y, verbose=False, shuffle=False)
+    params = {k: {w: np.asarray(v) for w, v in ws.items()}
+              for k, ws in ff.compiled.params.items()}
+    return ff, hist, params
+
+
+def test_stacked_moe_matches_branch_moe_single_device():
+    """The stacked (EP-capable) formulation computes the same math as the
+    reference-API n-branch formulation: same final logits after training
+    from the same seed is too strong (different weight trees), so compare
+    forward outputs with identical expert weights copied over."""
+    from flexflow_tpu.models.moe import MoeConfig, build_moe_mnist
+
+    bs = 32
+    cfg = MoeConfig(input_dim=16, num_classes=4, num_exp=4, num_select=2,
+                    expert_hidden_size=16, alpha=4.0)
+    x_np = np.random.default_rng(1).normal(size=(bs, 16)).astype(np.float32)
+
+    mesh1 = make_mesh({"data": 1}, devices=jax.devices()[:1])
+    ff_b = FFModel(FFConfig(batch_size=bs, seed=0))
+    build_moe_mnist(ff_b, bs, cfg, stacked=False)
+    ff_b.compile(optimizer=SGDOptimizer(lr=0.1),
+                 loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                 metrics=[], mesh=mesh1)
+
+    ff_s = FFModel(FFConfig(batch_size=bs, seed=0))
+    build_moe_mnist(ff_s, bs, cfg, stacked=True)
+    ff_s.compile(optimizer=SGDOptimizer(lr=0.1),
+                 loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                 metrics=[], mesh=mesh1)
+
+    # align weights: gate/head copied; stacked expert weights from branches
+    ps, pb = ff_s.compiled.params, ff_b.compiled.params
+    for name in ("moe_gate", "moe_head"):
+        ps[name] = pb[name]
+    ps["moe_experts"] = {
+        "kernel": jnp.stack([pb[f"moe_exp{i}"]["kernel"]
+                             for i in range(cfg.num_exp)]),
+        "bias": jnp.stack([pb[f"moe_exp{i}"]["bias"]
+                           for i in range(cfg.num_exp)]),
+    }
+    out_s = np.asarray(ff_s.compiled.forward_fn(ps, x_np))
+    out_b = np.asarray(ff_b.compiled.forward_fn(pb, x_np))
+    np.testing.assert_allclose(out_s, out_b, rtol=2e-5, atol=2e-5)
+
+
+def test_expert_parallel_matches_single_device(monkeypatch):
+    """dp x ep training parity: experts sharded over the data axis
+    (GShard-style) must train identically to the unsharded stacked model
+    (alpha high enough that capacity never drops tokens)."""
+    calls = []
+    import flexflow_tpu.parallel.collectives as coll
+
+    real = coll.expert_all_to_all
+    monkeypatch.setattr(coll, "expert_all_to_all",
+                        lambda *a, **kw: (calls.append(1), real(*a, **kw))[1])
+
+    ff_ep, h_ep, p_ep = _run_moe({"data": 8}, stacked=True,
+                                 expert_axis="data")
+    ff_sd, h_sd, p_sd = _run_moe({"data": 1}, stacked=True, expert_axis=None)
+
+    assert calls, "hand-scheduled EP all-to-all path did not engage"
+    # expert weights really sharded over the expert axis
+    spec = ff_ep.compiled.params["moe_experts"]["kernel"].sharding.spec
+    assert "data" in tuple(spec), f"expert weights not sharded: {spec}"
+    for name in p_sd:
+        for w in p_sd[name]:
+            np.testing.assert_allclose(
+                p_ep[name][w], p_sd[name][w], rtol=2e-3, atol=2e-4,
+                err_msg=f"{name}/{w}")
+    assert abs(h_ep[-1].accuracy - h_sd[-1].accuracy) < 0.05
+
+
+def test_expert_parallel_with_kernels(monkeypatch):
+    """The EP path composes with the Pallas MoE kernels (interpret mode):
+    per-shard dispatch/combine kernels + the same a2a."""
+    _, h_k, p_k = _run_moe({"data": 8}, stacked=True, expert_axis="data",
+                           pallas="interpret", monkeypatch=monkeypatch)
+    monkeypatch.setenv("FLEXFLOW_TPU_PALLAS", "off")
+    _, h_o, p_o = _run_moe({"data": 8}, stacked=True, expert_axis="data")
+    for name in p_o:
+        for w in p_o[name]:
+            np.testing.assert_allclose(
+                p_k[name][w], p_o[name][w], rtol=2e-3, atol=2e-4,
+                err_msg=f"{name}/{w}")
+
+
+def test_search_offers_expert_parallel_candidate():
+    from flexflow_tpu.search.substitution import candidate_strategies
+
+    ff = FFModel(FFConfig(batch_size=64, seed=0, mesh_shape={"data": 8}))
+    from flexflow_tpu.models.moe import MoeConfig, build_moe_mnist
+
+    build_moe_mnist(ff, 64, MoeConfig(input_dim=16, num_classes=4, num_exp=8,
+                                      num_select=2, expert_hidden_size=32),
+                    stacked=True)
+    group = next(l for l in ff.layers if l.name == "moe_group")
+    cands = candidate_strategies(group, {"data": 8})
+    assert {"expert": "data"} in cands, cands
+
+
 def test_seq_parallel_matches_unsharded():
     """Same model, seq-parallel vs single-axis mesh: identical logits."""
     bs, S, E = 4, 16, 8
