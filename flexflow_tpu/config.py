@@ -49,14 +49,24 @@ class FFConfig:
     search_budget: int = 0
     search_alpha: float = 1.2
     search_method: str = "unity"  # "unity" (DP, default) | "mcmc" (MLSys'19)
-    search_overlap_backward_update: bool = False
+    # whether the search's simulator credits backward/all-reduce overlap
+    # (reference: --overlap; default True here because XLA's latency-hiding
+    # scheduler does overlap grad sync with backward compute)
+    search_overlap_backward_update: bool = True
     only_data_parallel: bool = False
+    # sample (batch-dim) parallelism for model inputs; off = inputs
+    # replicated (reference: enable_sample_parallel, config.h:116-160).
+    # NOTE: the reference's enable_inplace_optimizations has no equivalent
+    # field — XLA's buffer assignment performs in-place reuse automatically.
     enable_sample_parallel: bool = True
     enable_parameter_parallel: bool = False
     enable_attribute_parallel: bool = False
-    enable_inplace_optimizations: bool = True
     perform_fusion: bool = False
+    # memory-aware search: lambda binary search between runtime- and
+    # memory-optimal strategies (reference: graph.cc:2056-2157); budget =
+    # memory_threshold_mb when set, else the machine model's HBM capacity
     perform_memory_search: bool = False
+    memory_threshold_mb: Optional[int] = None
     substitution_json_path: Optional[str] = None
     machine_model_file: Optional[str] = None
     export_strategy_file: Optional[str] = None
@@ -122,6 +132,12 @@ class FFConfig:
                 cfg.perform_fusion = True
             elif a == "--memory-search":
                 cfg.perform_memory_search = True
+            elif a == "--memory-threshold":
+                cfg.memory_threshold_mb = int(_next())
+            elif a == "--disable-sample-parallel":
+                cfg.enable_sample_parallel = False
+            elif a == "--disable-overlap":
+                cfg.search_overlap_backward_update = False
             elif a == "--profiling":
                 cfg.profiling = True
             elif a == "--print-freq":

@@ -227,6 +227,14 @@ class AggregateSpec(_AggregateBase):
 #        order; GSPMD inserts whatever collectives the shardings imply)
 # Both ops see the same shapes, so the predicate — and therefore the row
 # layout — always agrees between dispatch and combine.
+#
+# CAPACITY SEMANTICS under the hand-scheduled path: capacity is enforced
+# PER SHARD (c_loc = capacity / degree), the standard per-device capacity
+# of SPMD MoE systems — a hot expert can drop a token on one shard that the
+# global formulation (whole-batch ranking) would have kept. Exact numerical
+# parity with the unsharded model therefore requires alpha headroom such
+# that no tokens drop; with drops, both formulations are valid MoE
+# semantics but differ on which overflow tokens are cut.
 # --------------------------------------------------------------------------
 
 
@@ -273,7 +281,17 @@ class GroupByStacked(Op):
         ax = strategy.get("expert")
         if ax:
             deg = axis_sizes.get(ax, 1)
-            if deg > 1 and self.n % deg == 0:
+            if deg > 1 and self.n % deg != 0:
+                # never silently ignore a pinned strategy: the search
+                # pre-filters candidates, so this only fires on user error
+                raise ValueError(
+                    f"{self.name}: expert axis {ax!r} (degree {deg}) does "
+                    f"not divide num experts {self.n}")
+            if deg <= 1 and ax not in axis_sizes:
+                raise ValueError(
+                    f"{self.name}: expert axis {ax!r} is not a mesh axis "
+                    f"(have {sorted(axis_sizes)})")
+            if deg > 1:
                 # base propagate may have matched dim0 (size n) against the
                 # input batch dim; overwrite with the expert sharding
                 out_shapes[0] = ParallelTensorShape(

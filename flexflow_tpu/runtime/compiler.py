@@ -217,7 +217,9 @@ def compile_model(
     # --- input sharding: batch dim over the data axis (the reference's
     # default Repartition-on-batch when only_data_parallel, model.cc:2638;
     # with search enabled inputs still default to sample-parallel).
-    data_degree = axis_sizes.get(DATA_AXIS, 1)
+    # --disable-sample-parallel keeps inputs replicated.
+    data_degree = (axis_sizes.get(DATA_AXIS, 1)
+                   if config.enable_sample_parallel else 1)
     input_pshapes: Dict[int, ParallelTensorShape] = {}
     for t in input_tensors:
         dims = []

@@ -718,36 +718,62 @@ class FFModel:
         selects the MLSys'19 annealing path bounded by
         ``search_budget``/``search_alpha``). Returns (strategies, mesh)."""
         from ..search.mcmc import mcmc_optimize
-        from ..search.unity import (data_parallel_input_pshapes, full_search,
-                                    graph_optimize)
-        from ..sim import OpCostModel, Simulator, detect_machine_model
+        from ..search.unity import (_memory_budget,
+                                    data_parallel_input_pshapes, full_search,
+                                    graph_optimize, memory_aware_search)
+        from ..sim import (OpCostModel, Simulator, detect_machine_model,
+                           load_machine_model)
         from ..core.machine import mesh_axis_sizes
 
+        cfg = self.config
+        # extra substitution rules (reference: --substitution-json-path,
+        # substitution_loader.cc:78)
+        if cfg.substitution_json_path:
+            from ..search.substitution import load_substitution_json
+
+            load_substitution_json(cfg.substitution_json_path)
+
+        def make_machine(n=None):
+            # --machine-model-file overrides platform detection (reference:
+            # model.cc:3678-3685 EnhancedMachineModel selection)
+            if cfg.machine_model_file:
+                return load_machine_model(cfg.machine_model_file)
+            return detect_machine_model(n)
+
         inputs = self._used_inputs()
-        use_mcmc = getattr(self.config, "search_method", "unity") == "mcmc"
-        if mesh is not None or self.config.mesh_shape:
+        use_mcmc = getattr(cfg, "search_method", "unity") == "mcmc"
+        beam = max(cfg.base_optimize_threshold, 8)
+        if mesh is not None or cfg.mesh_shape:
             # mesh pinned by the user: search strategies on it only
             if mesh is None:
-                mesh = make_mesh(self.config.mesh_shape)
+                mesh = make_mesh(cfg.mesh_shape)
             axis_sizes = mesh_axis_sizes(mesh)
-            machine = detect_machine_model(mesh.devices.size)
-            sim = Simulator(machine, OpCostModel(machine))
-            input_pshapes = data_parallel_input_pshapes(inputs, axis_sizes)
+            machine = make_machine(mesh.devices.size)
+            sim = Simulator(
+                machine, OpCostModel(machine),
+                overlap_grad_sync=cfg.search_overlap_backward_update)
+            input_pshapes = data_parallel_input_pshapes(
+                inputs, axis_sizes, cfg.enable_sample_parallel)
             if use_mcmc:
                 result = mcmc_optimize(
-                    self.layers, input_pshapes, axis_sizes, sim, self.config,
-                    seed=self.config.seed,
+                    self.layers, input_pshapes, axis_sizes, sim, cfg,
+                    seed=cfg.seed,
+                )
+            elif cfg.perform_memory_search:
+                result = memory_aware_search(
+                    self.layers, input_pshapes, axis_sizes, sim, cfg,
+                    beam_width=beam,
+                    memory_budget=_memory_budget(cfg, machine),
                 )
             else:
                 result = graph_optimize(
-                    self.layers, input_pshapes, axis_sizes, sim, self.config,
-                    beam_width=max(self.config.base_optimize_threshold, 8),
+                    self.layers, input_pshapes, axis_sizes, sim, cfg,
+                    beam_width=beam,
                 )
         else:
-            machine = detect_machine_model()
+            machine = make_machine()
             result = full_search(
-                self.layers, inputs, machine, self.config,
-                beam_width=max(self.config.base_optimize_threshold, 8),
+                self.layers, inputs, machine, cfg, beam_width=beam,
             )
             self.config.mesh_shape = result.mesh_shape
             mesh = make_mesh(result.mesh_shape)

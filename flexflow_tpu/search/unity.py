@@ -51,6 +51,7 @@ class GraphSearchResult:
     est_step_time: float
     est_memory: int
     states_explored: int = 0
+    mem_lambda: float = 0.0  # memory-aware search trade-off (graph.cc:2056)
 
 
 def _ps_sig(ps: ParallelTensorShape) -> Tuple:
@@ -77,11 +78,18 @@ def graph_optimize(
     simulator: Simulator,
     config: Optional[FFConfig] = None,
     beam_width: int = 64,
+    mem_lambda: float = 0.0,
 ) -> GraphSearchResult:
     """DP over the layer graph for one fixed mesh shape.
 
     reference: Graph::graph_optimize_task → optimal strategies + views
     (graph.cc:2046-2327). Returns the best per-layer strategy dict.
+
+    ``mem_lambda`` blends memory into the objective (the memory-aware
+    variant, graph.cc:2056): states are ranked by
+    ``step_time + mem_lambda * footprint / hbm_bandwidth`` — the memory
+    term is the time to stream the footprint once, so both terms share
+    units and lambda is a dimensionless trade-off knob.
     """
     # consumer bookkeeping to compute live frontiers
     last_use: Dict[int, int] = {}
@@ -90,7 +98,18 @@ def graph_optimize(
             last_use[t.tensor_id] = li
 
     memory_cap = simulator.machine.chip.hbm_capacity
+    hbm_bw = simulator.machine.chip.hbm_bandwidth
+    opt_mult = simulator.optimizer_state_mult
     cm = simulator.cost_model
+
+    def state_footprint(weight_mem: float, act_mem: float) -> float:
+        # weights + optimizer states + activations (same accounting as
+        # Simulator.memory_usage; graph.cc:2056 hard bound)
+        return weight_mem * (1.0 + opt_mult) + act_mem
+
+    def rank_state(s: "_State") -> float:
+        return s.cost + mem_lambda * state_footprint(
+            s.weight_mem, s.act_mem) / hbm_bw
 
     states: Dict[Tuple, _State] = {
         (): _State(0.0, 0, 0, dict(input_pshapes), {})
@@ -118,13 +137,7 @@ def graph_optimize(
                 step = c.forward_time + c.backward_time + c.sync_time + comm
                 new_w = st.weight_mem + c.weights_memory
                 new_a = st.act_mem + c.outputs_memory
-                # full footprint = weights + optimizer states + activations
-                # (same accounting as Simulator.memory_usage, so the DP and
-                # fits_memory can never disagree; graph.cc:2056 hard bound)
-                footprint = (
-                    new_w * (1.0 + simulator.optimizer_state_mult) + new_a
-                )
-                if footprint > memory_cap:
+                if state_footprint(new_w, new_a) > memory_cap:
                     continue
                 pshapes = dict(st.pshapes)
                 for t, ps in zip(layer.outputs, out_shapes):
@@ -143,24 +156,68 @@ def graph_optimize(
                     {**st.strategies, layer.name: dict(cand)},
                 )
                 old = nxt.get(live)
-                if old is None or cand_state.cost < old.cost:
+                if old is None or rank_state(cand_state) < rank_state(old):
                     nxt[live] = cand_state
         if not nxt:
             raise RuntimeError(f"search dead-ended at layer {layer.name}")
         # beam prune (reference: base_optimize_threshold bound)
         if len(nxt) > beam_width:
             nxt = dict(
-                sorted(nxt.items(), key=lambda kv: kv[1].cost)[:beam_width]
+                sorted(nxt.items(), key=lambda kv: rank_state(kv[1]))[:beam_width]
             )
         states = nxt
 
-    best = min(states.values(), key=lambda s: s.cost)
-    footprint = int(
-        best.weight_mem * (1.0 + simulator.optimizer_state_mult) + best.act_mem
-    )
+    best = min(states.values(), key=rank_state)
+    footprint = int(state_footprint(best.weight_mem, best.act_mem))
     return GraphSearchResult(
-        best.strategies, dict(axis_sizes), best.cost, footprint, explored
+        best.strategies, dict(axis_sizes), best.cost, footprint, explored,
+        mem_lambda,
     )
+
+
+def memory_aware_search(
+    layers: List[Layer],
+    input_pshapes: Dict[int, ParallelTensorShape],
+    axis_sizes: Dict[str, int],
+    simulator: Simulator,
+    config: Optional[FFConfig] = None,
+    beam_width: int = 64,
+    memory_budget: Optional[float] = None,
+    max_iters: int = 8,
+    lam_max: float = 16.0,
+) -> GraphSearchResult:
+    """Runtime/memory lambda binary search (reference:
+    Graph::graph_optimize_task's try_one_lambda loop, graph.cc:2056-2157 +
+    memory_optimization.h:24-38).
+
+    Finds the smallest lambda whose strategy fits ``memory_budget`` —
+    i.e. the fastest strategy that fits — by binary search between the
+    runtime-optimal (lambda=0) and memory-dominated (lam_max) solutions.
+    """
+    budget = memory_budget or simulator.machine.chip.hbm_capacity
+
+    def run(lam: float) -> GraphSearchResult:
+        return graph_optimize(layers, input_pshapes, axis_sizes, simulator,
+                              config, beam_width, mem_lambda=lam)
+
+    r0 = run(0.0)
+    if r0.est_memory <= budget:
+        return r0
+    r1 = run(lam_max)
+    if r1.est_memory > budget:
+        # even the memory-dominated solution exceeds the budget; report it
+        # (the reference likewise reports the trade-off rather than failing,
+        # graph.cc:2134-2157)
+        return r1
+    lo, hi, best = 0.0, lam_max, r1
+    for _ in range(max_iters):
+        mid = 0.5 * (lo + hi)
+        r = run(mid)
+        if r.est_memory <= budget:
+            best, hi = r, mid
+        else:
+            lo = mid
+    return best
 
 
 def enumerate_mesh_shapes(
@@ -197,11 +254,13 @@ def enumerate_mesh_shapes(
     return out
 
 
-def data_parallel_input_pshapes(input_tensors, axis_sizes):
+def data_parallel_input_pshapes(input_tensors, axis_sizes,
+                                sample_parallel: bool = True):
     """Batch-dim-on-"data" input shardings (the single policy shared by the
     search paths and FFModel._run_search): shard dim 0 over the data axis
-    when divisible, replicate otherwise."""
-    data_deg = axis_sizes.get("data", 1)
+    when divisible, replicate otherwise. ``sample_parallel=False``
+    (reference: --enable-sample-parallel off) keeps inputs replicated."""
+    data_deg = axis_sizes.get("data", 1) if sample_parallel else 1
     input_pshapes = {}
     for t in input_tensors:
         dims = [
@@ -228,18 +287,35 @@ def full_search(
 
     n = machine.num_devices()
     if mesh_shapes is None:
-        has_moe = any(l.op_type is OpType.GROUP_BY for l in layers)
+        has_moe = any(l.op_type in (OpType.GROUP_BY, OpType.GROUP_BY_STACKED)
+                      for l in layers)
         has_attn = any(l.op_type is OpType.MULTIHEAD_ATTENTION for l in layers)
         mesh_shapes = enumerate_mesh_shapes(n, has_moe, has_attn)
+    sample_parallel = config is None or config.enable_sample_parallel
+    memory_search = config is not None and config.perform_memory_search
+    budget = _memory_budget(config, machine)
+    overlap = config is None or config.search_overlap_backward_update
+    # ONE memoized cost model across every mesh shape (the reference keeps
+    # a single hash_to_operator_cost across the whole optimize,
+    # simulator.h:750) — the memo key includes the full sharding signature
+    cost_model = OpCostModel(machine)
     best: Optional[GraphSearchResult] = None
     for shape in mesh_shapes:
         axis_sizes = dict(shape)
-        sim = Simulator(machine, OpCostModel(machine))
-        input_pshapes = data_parallel_input_pshapes(input_tensors, axis_sizes)
+        sim = Simulator(machine, cost_model, overlap_grad_sync=overlap)
+        input_pshapes = data_parallel_input_pshapes(
+            input_tensors, axis_sizes, sample_parallel)
         try:
-            r = graph_optimize(
-                layers, input_pshapes, axis_sizes, sim, config, beam_width
-            )
+            if memory_search:
+                r = memory_aware_search(
+                    layers, input_pshapes, axis_sizes, sim, config,
+                    beam_width, memory_budget=budget)
+                if r.est_memory > budget:
+                    continue
+            else:
+                r = graph_optimize(
+                    layers, input_pshapes, axis_sizes, sim, config, beam_width
+                )
         except RuntimeError:
             continue
         if best is None or r.est_step_time < best.est_step_time:
@@ -247,3 +323,12 @@ def full_search(
     if best is None:
         raise RuntimeError("no feasible mesh/strategy found")
     return best
+
+
+def _memory_budget(config: Optional[FFConfig], machine: MachineModel) -> float:
+    """The memory-search budget: --memory-threshold when given, else the
+    machine's HBM capacity (reference: the device-memory threshold of
+    graph_optimize_with_memory)."""
+    if config is not None and getattr(config, "memory_threshold_mb", None):
+        return config.memory_threshold_mb * (1 << 20)
+    return machine.chip.hbm_capacity

@@ -15,6 +15,7 @@ from .machine_model import (
     MultiSliceMachineModel,
     CHIP_PRESETS,
     detect_machine_model,
+    load_machine_model,
 )
 from .cost_model import CostMetrics, OpCostModel, ProfilingCostModel
 from .simulator import MemoryUsage, SimTask, Simulator
@@ -27,6 +28,7 @@ __all__ = [
     "MultiSliceMachineModel",
     "CHIP_PRESETS",
     "detect_machine_model",
+    "load_machine_model",
     "CostMetrics",
     "OpCostModel",
     "ProfilingCostModel",

@@ -215,6 +215,49 @@ class MultiSliceMachineModel(TorusMachineModel):
         return super()._lat(axis)
 
 
+def load_machine_model(path: str) -> MachineModel:
+    """Build a machine model from a JSON config file (reference:
+    --machine-model-file + machine_config_example consumed by
+    EnhancedMachineModel, src/runtime/machine_model.cc; selection
+    model.cc:3678-3685).
+
+    Schema::
+
+        {
+          "version": "simple" | "torus" | "multislice",
+          "chip": "v5e" | {"name": ..., "peak_bf16_flops": ..., ...},
+          "num_devices": 8,                  # simple only
+          "axis_degrees": {"data": 4, "model": 2},   # torus/multislice
+          "axis_links": {"data": 2},         # optional, torus/multislice
+          "wraparound": true,                # optional
+          "dcn_axes": ["data_dcn"]           # multislice only
+        }
+    """
+    import json
+
+    with open(path) as f:
+        cfg = json.load(f)
+    chip_cfg = cfg.get("chip", "v5e")
+    if isinstance(chip_cfg, str):
+        chip = CHIP_PRESETS[chip_cfg]
+    else:
+        chip = TPUChipSpec(**chip_cfg)
+    version = cfg.get("version", "simple")
+    if version == "simple":
+        return SimpleMachineModel(chip, int(cfg.get("num_devices", 1)))
+    if version == "torus":
+        return TorusMachineModel(
+            chip, cfg["axis_degrees"], cfg.get("axis_links"),
+            wraparound=bool(cfg.get("wraparound", True)))
+    if version == "multislice":
+        return MultiSliceMachineModel(
+            chip, cfg["axis_degrees"],
+            dcn_axes=tuple(cfg.get("dcn_axes", ["data_dcn"])),
+            axis_links=cfg.get("axis_links"),
+            wraparound=bool(cfg.get("wraparound", True)))
+    raise ValueError(f"unknown machine model version {version!r} in {path}")
+
+
 def detect_machine_model(n_devices: Optional[int] = None) -> MachineModel:
     """Best-effort detection of the current platform (reference analog:
     FFConfig querying the Realm machine, model.cc:3501)."""

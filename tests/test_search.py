@@ -147,6 +147,179 @@ def test_search_deterministic_across_runs():
     assert results[0] == results[1]
 
 
+def test_memory_lambda_search_finds_fastest_fitting():
+    """The runtime/memory lambda binary search (graph.cc:2056-2157).
+
+    Setup engineered so the trade-off is real: a single dense whose odd
+    out_dim filters the "out" candidate, leaving {} (replicated weights,
+    fast: no activation comm) vs {"in": "model"} (halved weight memory,
+    slow: pays an output all-reduce over deliberately slow ICI). With a
+    budget below the replicated footprint but HBM plenty, the search must
+    switch to the memory-saving strategy via the LAMBDA path (the hard
+    HBM prune never fires) and report the lambda it landed on."""
+    import dataclasses
+
+    from flexflow_tpu.search.unity import memory_aware_search
+
+    B, DIN, DOUT = 256, 128, 65535
+    ff = FFModel(FFConfig(batch_size=B))
+    x = ff.create_tensor((B, DIN), DataType.FLOAT, name="x")
+    ff.dense(x, DOUT, name="big")
+
+    slow_ici = dataclasses.replace(CHIP_PRESETS["test"],
+                                   ici_link_bandwidth=2e9)
+    machine = SimpleMachineModel(slow_ici, n_devices=4)
+    sim = Simulator(machine, OpCostModel(machine))
+    pshapes = _input_ps(x, 2)
+    axis = {"data": 2, "model": 2}
+
+    r_free = memory_aware_search(ff.layers, pshapes, axis, sim,
+                                 memory_budget=machine.chip.hbm_capacity)
+    assert r_free.mem_lambda == 0.0  # fits: runtime-optimal untouched
+    assert r_free.strategies["big"] == {}, r_free.strategies
+
+    budget = 100 * (1 << 20)  # replicated footprint ~128 MiB won't fit
+    r = memory_aware_search(ff.layers, pshapes, axis, sim,
+                            memory_budget=budget)
+    assert r.est_memory <= budget
+    assert r.mem_lambda > 0.0
+    assert r.strategies["big"] == {"in": "model"}, r.strategies
+    # the fitting strategy costs more time than the runtime optimum —
+    # that IS the reported trade-off (graph.cc:2134-2157)
+    assert r.est_step_time >= r_free.est_step_time
+
+
+def test_memory_search_via_compile(tmp_path):
+    """--memory-search + --memory-threshold flow through FFModel.compile."""
+    cfg = FFConfig.parse_args(["--budget", "1", "--memory-search",
+                               "--memory-threshold", "24"])
+    assert cfg.perform_memory_search and cfg.memory_threshold_mb == 24
+    cfg.batch_size = 32
+    cfg.mesh_shape = {"data": 2, "model": 4}
+    ff = FFModel(cfg)
+    x = ff.create_tensor((32, 512), DataType.FLOAT, name="x")
+    h = ff.dense(x, 4096, name="big_up")
+    ff.dense(h, 8, name="head")
+    ff.compile(SGDOptimizer(ff, 0.05),
+               LossType.SPARSE_CATEGORICAL_CROSSENTROPY, [])
+    r = ff.search_result
+    assert r.est_memory <= 24 * (1 << 20)
+    # 24 MiB cannot hold replicated 512x4096 weights + Adam-sized states
+    assert any("model" in str(v) for v in r.strategies.values()), r.strategies
+
+
+def test_substitution_json_changes_search_outcome(tmp_path, monkeypatch):
+    """A JSON rule proposes a strategy the built-in generators never offer
+    (seq-sharding attention over the model axis) and the search adopts it
+    (reference: --substitution-json-path, substitution_loader.cc:78)."""
+    import json
+
+    from flexflow_tpu.search import substitution as sub
+
+    monkeypatch.setattr(sub, "_JSON_RULES", {})  # isolate global rule table
+    rules = tmp_path / "rules.json"
+    rules.write_text(json.dumps(
+        {"rules": {"MULTIHEAD_ATTENTION": [{"seq": "model"}]}}))
+
+    def build():
+        ff = FFModel(FFConfig(batch_size=32))
+        x = ff.create_tensor((32, 64, 128), DataType.FLOAT, name="x")
+        # 2 heads: NOT divisible by the 4-way model axis, so the built-in
+        # heads-sharding candidate is filtered and {} is the only builtin
+        a = ff.multihead_attention(x, x, x, 128, 2, name="attn")
+        ff.dense(a, 1, name="head")
+        return ff, x
+
+    machine = SimpleMachineModel(CHIP_PRESETS["test"], 8)
+    sim = Simulator(machine, OpCostModel(machine))
+    axis = {"data": 2, "model": 4}
+
+    ff, x = build()
+    r_before = graph_optimize(ff.layers, _input_ps(x, 2), axis, sim)
+    assert r_before.strategies["attn"] == {}
+
+    assert sub.load_substitution_json(str(rules)) == 1
+    ff, x = build()
+    r_after = graph_optimize(ff.layers, _input_ps(x, 2), axis, sim)
+    assert r_after.strategies["attn"] == {"seq": "model"}, r_after.strategies
+
+
+def test_load_machine_model_file(tmp_path):
+    """--machine-model-file constructs Simple/Torus/MultiSlice models
+    (reference: machine_config_example -> EnhancedMachineModel,
+    model.cc:3678-3685)."""
+    import json
+
+    from flexflow_tpu.sim import (MultiSliceMachineModel, TorusMachineModel,
+                                  load_machine_model)
+
+    p = tmp_path / "simple.json"
+    p.write_text(json.dumps({"version": "simple", "chip": "v5p",
+                             "num_devices": 16}))
+    m = load_machine_model(str(p))
+    assert m.num_devices() == 16 and m.chip.name == "v5p"
+
+    p = tmp_path / "torus.json"
+    p.write_text(json.dumps({
+        "version": "torus", "chip": "v4",
+        "axis_degrees": {"data": 16, "model": 4},
+        "axis_links": {"data": 2}}))
+    m = load_machine_model(str(p))
+    assert isinstance(m, TorusMachineModel)
+    assert m.num_devices() == 64
+    # the 2-link axis gets twice the bandwidth of a 1-link axis
+    assert m._bw("data") == 2 * m._bw("model")
+
+    p = tmp_path / "ms.json"
+    p.write_text(json.dumps({
+        "version": "multislice",
+        "chip": {"name": "custom", "peak_bf16_flops": 1e14,
+                 "hbm_bandwidth": 1e12, "hbm_capacity": 2 ** 34,
+                 "ici_link_bandwidth": 4.5e10, "ici_num_links": 4},
+        "axis_degrees": {"data_dcn": 2, "data": 8},
+        "dcn_axes": ["data_dcn"]}))
+    m = load_machine_model(str(p))
+    assert isinstance(m, MultiSliceMachineModel)
+    assert m.chip.name == "custom"
+    # DCN axis is slower than ICI axes
+    assert m._bw("data_dcn") < m._bw("data")
+
+
+def test_machine_model_file_used_by_search(tmp_path, monkeypatch):
+    import json
+
+    import flexflow_tpu.sim as sim_pkg
+
+    p = tmp_path / "mm.json"
+    p.write_text(json.dumps({"version": "simple", "chip": "v5e",
+                             "num_devices": 8}))
+    calls = []
+    real = sim_pkg.load_machine_model
+    monkeypatch.setattr(sim_pkg, "load_machine_model",
+                        lambda path: (calls.append(path), real(path))[1])
+    cfg = FFConfig(batch_size=32, search_budget=1,
+                   mesh_shape={"data": 2, "model": 4},
+                   machine_model_file=str(p))
+    ff = FFModel(cfg)
+    x = ff.create_tensor((32, 64), DataType.FLOAT, name="x")
+    ff.dense(x, 128, name="fc")
+    ff.compile(SGDOptimizer(ff, 0.05),
+               LossType.MEAN_SQUARED_ERROR_AVG_REDUCE, [])
+    assert calls == [str(p)]
+
+
+def test_disable_sample_parallel_replicates_inputs():
+    cfg = FFConfig(batch_size=32, enable_sample_parallel=False,
+                   mesh_shape={"data": 8})
+    ff = FFModel(cfg)
+    x = ff.create_tensor((32, 64), DataType.FLOAT, name="x")
+    ff.dense(x, 8, name="fc")
+    ff.compile(SGDOptimizer(ff, 0.05),
+               LossType.MEAN_SQUARED_ERROR_AVG_REDUCE, [])
+    spec = ff.compiled.input_shardings[0].spec
+    assert tuple(spec) == (None, None), spec
+
+
 def test_memory_cap_forces_model_parallelism():
     """With HBM too small for replicated weights, the DP search must pick
     weight-sharding strategies (the memory-aware behavior of
