@@ -657,6 +657,22 @@ class FFModel:
             from ..ops.fused import apply_fusion
 
             compile_layers = apply_fusion(self.layers, {logits.tensor_id})
+        if pipeline is None and mesh is not None:
+            # the search may have chosen a pipe-prefixed mesh; honor it by
+            # auto-enabling the GPipe engine (stage count = pipe degree).
+            # Guard against fusion shrinking the graph below the stage
+            # count — then pipelining is impossible and we compile plain
+            # (the pipe axis stays unused/replicated rather than crashing).
+            from ..core.machine import mesh_axis_sizes as _mas
+
+            pipe_deg = _mas(mesh).get("pipe", 1)
+            if pipe_deg > 1 and len(compile_layers) >= pipe_deg:
+                from ..parallel.pipeline import PipelineConfig
+                from ..search.unity import pipe_microbatches
+
+                pipeline = PipelineConfig(
+                    num_stages=pipe_deg,
+                    num_microbatches=pipe_microbatches(self.config.batch_size))
         self.compiled = compile_model(
             self.config,
             compile_layers,
@@ -726,12 +742,14 @@ class FFModel:
         from ..core.machine import mesh_axis_sizes
 
         cfg = self.config
-        # extra substitution rules (reference: --substitution-json-path,
-        # substitution_loader.cc:78)
+        # extra substitution rules, scoped to THIS config so they never
+        # leak into other models' searches (reference:
+        # --substitution-json-path, substitution_loader.cc:78)
         if cfg.substitution_json_path:
-            from ..search.substitution import load_substitution_json
+            from ..search.substitution import load_substitution_rules
 
-            load_substitution_json(cfg.substitution_json_path)
+            cfg._substitution_rules = load_substitution_rules(
+                cfg.substitution_json_path)
 
         def make_machine(n=None):
             # --machine-model-file overrides platform detection (reference:

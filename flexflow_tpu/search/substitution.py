@@ -40,15 +40,27 @@ from ..core.layer import Layer
 _JSON_RULES: Dict[str, List[Dict[str, str]]] = {}
 
 
-def load_substitution_json(path: str) -> int:
-    """Load extra candidate rules (reference: substitution_loader.cc:78,
-    ``--substitution-json-path``). Returns number of rules loaded."""
+def load_substitution_rules(path: str) -> Dict[str, List[Dict[str, str]]]:
+    """Parse a rules file WITHOUT touching process-global state — the
+    config-scoped path (FFConfig.substitution_json_path) uses this so one
+    model's rules never leak into another model's search."""
     with open(path) as f:
         data = json.load(f)
+    return {op: list(cands) for op, cands in data.get("rules", {}).items()}
+
+
+def load_substitution_json(path: str) -> int:
+    """Load extra candidate rules into the process-global table
+    (reference: substitution_loader.cc:78, ``--substitution-json-path``).
+    Idempotent: already-present templates are skipped. Returns the number
+    of rules newly added."""
     n = 0
-    for op_name, cands in data.get("rules", {}).items():
-        _JSON_RULES.setdefault(op_name, []).extend(cands)
-        n += len(cands)
+    for op_name, cands in load_substitution_rules(path).items():
+        have = _JSON_RULES.setdefault(op_name, [])
+        for c in cands:
+            if c not in have:
+                have.append(c)
+                n += 1
     return n
 
 
@@ -126,7 +138,8 @@ def candidate_strategies(
             if sz > 1 and a != "pipe" and n_exp % sz == 0:
                 cands.append({"expert": a})
 
-    for template in _JSON_RULES.get(t.name, []):
+    scoped = getattr(config, "_substitution_rules", None) or {}
+    for template in _JSON_RULES.get(t.name, []) + scoped.get(t.name, []):
         c = _expand(template, axis_sizes)
         if c is not None and c not in cands:
             cands.append(c)

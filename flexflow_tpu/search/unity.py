@@ -79,6 +79,7 @@ def graph_optimize(
     config: Optional[FFConfig] = None,
     beam_width: int = 64,
     mem_lambda: float = 0.0,
+    memory_cap: Optional[float] = None,
 ) -> GraphSearchResult:
     """DP over the layer graph for one fixed mesh shape.
 
@@ -90,6 +91,10 @@ def graph_optimize(
     ``step_time + mem_lambda * footprint / hbm_bandwidth`` — the memory
     term is the time to stream the footprint once, so both terms share
     units and lambda is a dimensionless trade-off knob.
+
+    ``memory_cap`` overrides the hard infeasibility prune (default: the
+    machine's HBM capacity); pipe-prefixed searches raise it by the stage
+    count because each stage holds only ~1/P of the model.
     """
     # consumer bookkeeping to compute live frontiers
     last_use: Dict[int, int] = {}
@@ -97,7 +102,8 @@ def graph_optimize(
         for t in layer.inputs:
             last_use[t.tensor_id] = li
 
-    memory_cap = simulator.machine.chip.hbm_capacity
+    if memory_cap is None:
+        memory_cap = simulator.machine.chip.hbm_capacity
     hbm_bw = simulator.machine.chip.hbm_bandwidth
     opt_mult = simulator.optimizer_state_mult
     cm = simulator.cost_model
@@ -185,6 +191,7 @@ def memory_aware_search(
     memory_budget: Optional[float] = None,
     max_iters: int = 8,
     lam_max: float = 16.0,
+    memory_cap: Optional[float] = None,
 ) -> GraphSearchResult:
     """Runtime/memory lambda binary search (reference:
     Graph::graph_optimize_task's try_one_lambda loop, graph.cc:2056-2157 +
@@ -198,7 +205,8 @@ def memory_aware_search(
 
     def run(lam: float) -> GraphSearchResult:
         return graph_optimize(layers, input_pshapes, axis_sizes, simulator,
-                              config, beam_width, mem_lambda=lam)
+                              config, beam_width, mem_lambda=lam,
+                              memory_cap=memory_cap)
 
     r0 = run(0.0)
     if r0.est_memory <= budget:
@@ -224,11 +232,13 @@ def enumerate_mesh_shapes(
     n_devices: int,
     has_moe: bool = False,
     has_attention: bool = False,
+    max_pipe: int = 0,
 ) -> List[Dict[str, int]]:
     """Candidate mesh layouts (reference: register_all_machine_views
     graph.cc:2329 — 1-D views over every divisor of the GPU count; here 2-D
-    named meshes {data×model} plus expert/seq axes when the graph can use
-    them)."""
+    named meshes {data×model}, 3-axis {data×model×seq|expert} triples when
+    the graph can use them, and pipe-prefixed variants up to ``max_pipe``
+    stages — a generalization the reference reserved but never built)."""
     shapes: List[Dict[str, int]] = []
     for d in range(1, n_devices + 1):
         if n_devices % d != 0:
@@ -244,6 +254,28 @@ def enumerate_mesh_shapes(
             shapes.append({"expert": m} if d == 1 else {"data": d, "expert": m})
         if has_attention and m > 1:
             shapes.append({"seq": m} if d == 1 else {"data": d, "seq": m})
+        # three-axis splits of the model factor: data × model × seq/expert
+        if m > 1:
+            for m1 in range(2, m):
+                if m % m1 != 0:
+                    continue
+                m2 = m // m1
+                if m2 <= 1:
+                    continue
+                base = {"data": d} if d > 1 else {}
+                if has_attention:
+                    shapes.append({**base, "model": m1, "seq": m2})
+                if has_moe:
+                    shapes.append({**base, "model": m1, "expert": m2})
+    # pipeline-prefixed variants: pipe × (every shape over the remaining
+    # devices); costed by the GPipe bubble model in full_search
+    if max_pipe > 1:
+        for p in range(2, max_pipe + 1):
+            if n_devices % p != 0:
+                continue
+            rest = n_devices // p
+            for s in enumerate_mesh_shapes(rest, has_moe, has_attention):
+                shapes.append({"pipe": p, **s})
     # dedup, preserve order
     seen, out = set(), []
     for s in shapes:
@@ -290,7 +322,9 @@ def full_search(
         has_moe = any(l.op_type in (OpType.GROUP_BY, OpType.GROUP_BY_STACKED)
                       for l in layers)
         has_attn = any(l.op_type is OpType.MULTIHEAD_ATTENTION for l in layers)
-        mesh_shapes = enumerate_mesh_shapes(n, has_moe, has_attn)
+        # pipe candidates need >=2 layers per stage to be meaningful
+        max_pipe = min(n, max(1, len(layers) // 2))
+        mesh_shapes = enumerate_mesh_shapes(n, has_moe, has_attn, max_pipe)
     sample_parallel = config is None or config.enable_sample_parallel
     memory_search = config is not None and config.perform_memory_search
     budget = _memory_budget(config, machine)
@@ -301,28 +335,89 @@ def full_search(
     cost_model = OpCostModel(machine)
     best: Optional[GraphSearchResult] = None
     for shape in mesh_shapes:
-        axis_sizes = dict(shape)
+        pipe = shape.get("pipe", 1)
+        axis_sizes = {a: s for a, s in shape.items() if a != "pipe"}
         sim = Simulator(machine, cost_model, overlap_grad_sync=overlap)
         input_pshapes = data_parallel_input_pshapes(
             input_tensors, axis_sizes, sample_parallel)
+        # each pipe stage holds only ~1/P of the model, so both the hard
+        # HBM prune and the memory budget scale by the stage count —
+        # pipelining's primary use case is exactly the model that does NOT
+        # fit unsplit
+        cap = machine.chip.hbm_capacity * pipe
         try:
             if memory_search:
                 r = memory_aware_search(
                     layers, input_pshapes, axis_sizes, sim, config,
-                    beam_width, memory_budget=budget)
-                if r.est_memory > budget:
+                    beam_width, memory_budget=budget * pipe, memory_cap=cap)
+                if r.est_memory > budget * pipe:
                     continue
             else:
                 r = graph_optimize(
-                    layers, input_pshapes, axis_sizes, sim, config, beam_width
+                    layers, input_pshapes, axis_sizes, sim, config,
+                    beam_width, memory_cap=cap,
                 )
         except RuntimeError:
             continue
+        if pipe > 1:
+            r = _pipe_adjusted(r, layers, pipe, machine,
+                               config.batch_size if config else None)
         if best is None or r.est_step_time < best.est_step_time:
             best = r
     if best is None:
         raise RuntimeError("no feasible mesh/strategy found")
     return best
+
+
+def pipe_microbatches(batch_size: Optional[int]) -> int:
+    """GPipe schedule depth — the SINGLE source of truth shared by the
+    search's bubble cost model and compile()'s auto-enabled pipeline, so
+    the search never credits an overlap the runtime won't deliver."""
+    if batch_size is None:
+        return 4
+    return next((m for m in (4, 2, 1) if batch_size % m == 0), 1)
+
+
+def _pipe_adjusted(
+    r: GraphSearchResult, layers: List[Layer], pipe: int,
+    machine: MachineModel, batch_size: Optional[int] = None,
+) -> GraphSearchResult:
+    """GPipe bubble cost model for a pipe-prefixed mesh.
+
+    The inner DP estimated one step of the WHOLE model on the per-stage
+    submesh (the non-pipe axes). Pipelining splits that work over ``pipe``
+    stages fed with M microbatches: steady-state step time is
+    ``T * (M + P - 1) / (M * P)`` (the classic GPipe bubble), plus the
+    stage-boundary activation traffic over ICI. Per-device memory drops to
+    ~1/P of the whole-model footprint (each stage holds only its layers).
+    No reference equivalent — PP is reserved but unimplemented upstream
+    (model.h:190-192).
+    """
+    M = pipe_microbatches(batch_size)
+    bubble = (M + pipe - 1) / (M * pipe)
+    # boundary traffic: approximate each of the P-1 cut points by the mean
+    # layer-output size; forward activation + backward cotangent per step
+    out_bytes = [
+        4.0 * _numel(t.dims) for layer in layers for t in layer.outputs
+    ]
+    mean_out = sum(out_bytes) / max(1, len(out_bytes))
+    bw = machine.chip.ici_link_bandwidth
+    comm = 2.0 * (pipe - 1) * mean_out / bw
+    return GraphSearchResult(
+        r.strategies,
+        {"pipe": pipe, **r.mesh_shape},
+        r.est_step_time * bubble + comm,
+        int(r.est_memory / pipe),
+        r.states_explored,
+        r.mem_lambda,
+    )
+
+
+def _numel(dims) -> float:
+    n = 1.0
+    for d in dims:
+        n *= d
+    return n
 
 
 def _memory_budget(config: Optional[FFConfig], machine: MachineModel) -> float:

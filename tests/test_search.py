@@ -147,6 +147,71 @@ def test_search_deterministic_across_runs():
     assert results[0] == results[1]
 
 
+def test_enumerate_three_axis_and_pipe_shapes():
+    """3-axis {data x model x seq/expert} triples and pipe-prefixed shapes
+    (reference only ever enumerated 1-D views, graph.cc:2329)."""
+    shapes = enumerate_mesh_shapes(8, has_moe=True, has_attention=True,
+                                   max_pipe=2)
+    assert {"data": 2, "model": 2, "seq": 2} in shapes
+    assert {"data": 2, "model": 2, "expert": 2} in shapes
+    assert {"model": 2, "seq": 4} in shapes
+    assert any(s.get("pipe", 1) > 1 for s in shapes)
+    assert {"pipe": 2, "data": 2, "model": 2} in shapes
+    # no pipe shapes when not requested
+    assert all(s.get("pipe", 1) == 1 for s in enumerate_mesh_shapes(8))
+
+
+def test_full_search_considers_three_axis_mesh():
+    """The bench transformer's search space includes a 3-axis mesh and the
+    search completes over it (VERDICT round-1 item 7)."""
+    ff, x = _transformer_ish(B=64, D=128, H=8, layers=2)
+    machine = SimpleMachineModel(CHIP_PRESETS["test"], 8)
+    shapes = enumerate_mesh_shapes(8, has_moe=False, has_attention=True)
+    triples = [s for s in shapes if len(s) == 3]
+    assert triples, shapes
+    r = full_search(ff.layers, [x], machine, FFConfig(batch_size=64),
+                    mesh_shapes=triples)
+    assert set(r.mesh_shape) == {"data", "model", "seq"}
+    assert r.est_step_time > 0
+
+
+def test_pipe_mesh_wins_when_sync_dominates(monkeypatch):
+    """GPipe bubble model: when weight-grad sync dominates (huge weights,
+    tiny batch, slow ICI), a pipe-split — each stage syncing only its own
+    weights over its submesh — beats pure DP, and compile() honors the
+    pipe mesh by auto-enabling the pipeline engine."""
+    import dataclasses
+
+    from flexflow_tpu.sim import machine_model as mm
+
+    slow = dataclasses.replace(CHIP_PRESETS["test"],
+                               ici_link_bandwidth=1e9)
+    monkeypatch.setattr(mm, "detect_machine_model",
+                        lambda n=None: SimpleMachineModel(slow, 8))
+    import flexflow_tpu.sim as sim_pkg
+    monkeypatch.setattr(sim_pkg, "detect_machine_model",
+                        lambda n=None: SimpleMachineModel(slow, 8))
+
+    B, D = 8, 1024
+    cfg = FFConfig(batch_size=B, search_budget=1)
+    ff = FFModel(cfg)
+    x = ff.create_tensor((B, D), DataType.FLOAT, name="x")
+    h = x
+    for i in range(6):
+        h = ff.dense(h, D, name=f"fc{i}")
+        h = ff.relu(h, name=f"a{i}")
+    ff.dense(h, 8, name="head")
+    ff.compile(SGDOptimizer(ff, 0.05),
+               LossType.SPARSE_CATEGORICAL_CROSSENTROPY, [])
+    assert ff.search_result.mesh_shape.get("pipe", 1) > 1, \
+        ff.search_result.mesh_shape
+    assert ff.pipelined is not None  # compile honored the pipe mesh
+    X = np.random.default_rng(0).normal(size=(16, D)).astype(np.float32)
+    Y = np.random.default_rng(1).integers(0, 8, size=(16, 1)).astype(np.int32)
+    hist = ff.fit(X, Y, epochs=1, batch_size=8, verbose=False)
+    assert len(hist) == 1
+
+
 def test_memory_lambda_search_finds_fastest_fitting():
     """The runtime/memory lambda binary search (graph.cc:2056-2157).
 
