@@ -750,6 +750,8 @@ class FFModel:
 
             cfg._substitution_rules = load_substitution_rules(
                 cfg.substitution_json_path)
+        else:
+            cfg._substitution_rules = None  # drop stale rules on recompile
 
         def make_machine(n=None):
             # --machine-model-file overrides platform detection (reference:
@@ -761,12 +763,30 @@ class FFModel:
         inputs = self._used_inputs()
         use_mcmc = getattr(cfg, "search_method", "unity") == "mcmc"
         beam = max(cfg.base_optimize_threshold, 8)
+        # pipe-stage bound: the POST-fusion graph must still have one op
+        # per stage, else compile() cannot honor a pipe mesh
+        n_effective = len(self.layers)
+        if cfg.perform_fusion:
+            from ..ops.fused import apply_fusion
+
+            n_effective = len(
+                apply_fusion(self.layers, {self._final_output().tensor_id}))
         if mesh is not None or cfg.mesh_shape:
-            # mesh pinned by the user: search strategies on it only
+            # mesh pinned by the user: search strategies on it only. A
+            # pipe axis (user-pinned or persisted from a previous search)
+            # is handled like full_search does: the inner DP runs on the
+            # per-stage submesh with the HBM cap scaled by the stage count,
+            # and the GPipe bubble model adjusts the result.
+            from ..search.unity import _pipe_adjusted
+
             if mesh is None:
                 mesh = make_mesh(cfg.mesh_shape)
-            axis_sizes = mesh_axis_sizes(mesh)
+            full_axis_sizes = mesh_axis_sizes(mesh)
+            pipe = full_axis_sizes.get("pipe", 1)
+            axis_sizes = {a: s for a, s in full_axis_sizes.items()
+                          if a != "pipe"}
             machine = make_machine(mesh.devices.size)
+            cap = machine.chip.hbm_capacity * pipe
             sim = Simulator(
                 machine, OpCostModel(machine),
                 overlap_grad_sync=cfg.search_overlap_backward_update)
@@ -781,17 +801,22 @@ class FFModel:
                 result = memory_aware_search(
                     self.layers, input_pshapes, axis_sizes, sim, cfg,
                     beam_width=beam,
-                    memory_budget=_memory_budget(cfg, machine),
+                    memory_budget=_memory_budget(cfg, machine) * pipe,
+                    memory_cap=cap,
                 )
             else:
                 result = graph_optimize(
                     self.layers, input_pshapes, axis_sizes, sim, cfg,
-                    beam_width=beam,
+                    beam_width=beam, memory_cap=cap,
                 )
+            if pipe > 1:
+                result = _pipe_adjusted(result, self.layers, pipe, machine,
+                                        cfg.batch_size)
         else:
             machine = make_machine()
             result = full_search(
                 self.layers, inputs, machine, cfg, beam_width=beam,
+                max_pipe=max(1, n_effective // 2),
             )
             self.config.mesh_shape = result.mesh_shape
             mesh = make_mesh(result.mesh_shape)
@@ -850,6 +875,14 @@ class FFModel:
         xs = x if isinstance(x, (list, tuple)) else [x]
         epochs = epochs or self.config.epochs
         bs = batch_size or self.config.batch_size
+        if self.pipelined is not None:
+            mb = self.pipelined.cfg.num_microbatches
+            if bs % mb != 0:
+                raise ValueError(
+                    f"batch_size {bs} is not divisible by the pipeline's "
+                    f"{mb} microbatches (set when the model was compiled "
+                    f"for the pipe mesh); pass a compatible batch_size or "
+                    f"recompile with pipeline=PipelineConfig(...)")
         loaders = [
             SingleDataLoader(np.asarray(a), bs, sh)
             for a, sh in zip(xs, cm.input_shardings)

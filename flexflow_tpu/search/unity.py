@@ -312,9 +312,14 @@ def full_search(
     config: Optional[FFConfig] = None,
     beam_width: int = 64,
     mesh_shapes: Optional[List[Dict[str, int]]] = None,
+    max_pipe: Optional[int] = None,
 ) -> GraphSearchResult:
     """Outer loop over mesh shapes × inner DP (reference: the top-level
-    try_one_lambda / machine-mapping enumeration in graph_optimize_task)."""
+    try_one_lambda / machine-mapping enumeration in graph_optimize_task).
+
+    ``max_pipe`` bounds pipe-prefixed candidates; the caller passes the
+    POST-fusion op count so a fused graph is never promised more stages
+    than compile() can split."""
     from ..ffconst import OpType
 
     n = machine.num_devices()
@@ -322,9 +327,11 @@ def full_search(
         has_moe = any(l.op_type in (OpType.GROUP_BY, OpType.GROUP_BY_STACKED)
                       for l in layers)
         has_attn = any(l.op_type is OpType.MULTIHEAD_ATTENTION for l in layers)
-        # pipe candidates need >=2 layers per stage to be meaningful
-        max_pipe = min(n, max(1, len(layers) // 2))
-        mesh_shapes = enumerate_mesh_shapes(n, has_moe, has_attn, max_pipe)
+        if max_pipe is None:
+            # pipe candidates need >=2 layers per stage to be meaningful
+            max_pipe = max(1, len(layers) // 2)
+        mesh_shapes = enumerate_mesh_shapes(n, has_moe, has_attn,
+                                            min(n, max_pipe))
     sample_parallel = config is None or config.enable_sample_parallel
     memory_search = config is not None and config.perform_memory_search
     budget = _memory_budget(config, machine)
@@ -396,11 +403,14 @@ def _pipe_adjusted(
     M = pipe_microbatches(batch_size)
     bubble = (M + pipe - 1) / (M * pipe)
     # boundary traffic: approximate each of the P-1 cut points by the mean
-    # layer-output size; forward activation + backward cotangent per step
+    # layer-output size; forward activation + backward cotangent per step.
+    # Boundary tensors stay batch-sharded over the inner data axis, so each
+    # device moves only its shard.
     out_bytes = [
         4.0 * _numel(t.dims) for layer in layers for t in layer.outputs
     ]
     mean_out = sum(out_bytes) / max(1, len(out_bytes))
+    mean_out /= max(1, r.mesh_shape.get("data", 1))
     bw = machine.chip.ici_link_bandwidth
     comm = 2.0 * (pipe - 1) * mean_out / bw
     return GraphSearchResult(

@@ -240,7 +240,7 @@ class Simulator:
             for i, t in enumerate(tasks):
                 t.start_time = float(starts[i])
                 t.ready_time = max((finish[d] for d in t.deps), default=0.0)
-            return float(total)
+            return float(total) + self.machine.chip.step_overhead
 
         # Python fallback: the same event-driven replay as the native
         # engine (pop by (dep-ready time, task id), serialize per lane) so
@@ -273,7 +273,7 @@ class Simulator:
                 indeg[s] -= 1
                 if indeg[s] == 0:
                     heapq.heappush(heap, (ready[s], s))
-        return total
+        return total + self.machine.chip.step_overhead
 
     def memory_usage(self, ops: List[Op]) -> MemoryUsage:
         mu = MemoryUsage()

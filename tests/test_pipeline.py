@@ -201,8 +201,10 @@ def test_pipeline_step_overhead_bounded():
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
             timeout=600,
             env={**os.environ,
-                 "PYTHONPATH": os.path.dirname(os.path.dirname(
-                     os.path.abspath(__file__)))},
+                 "PYTHONPATH": os.pathsep.join(filter(None, [
+                     os.path.dirname(os.path.dirname(
+                         os.path.abspath(__file__))),
+                     os.environ.get("PYTHONPATH")]))},
         )
         line = next((l for l in proc.stdout.splitlines()
                      if l.startswith("RESULT")), None)

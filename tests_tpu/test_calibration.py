@@ -1,0 +1,41 @@
+"""Simulator-vs-hardware regression (VERDICT round-1 item 4: "simulated
+step-time within 2x of measured for the bench transformer").
+
+Runs only when a real TPU backend is present. The default machine model
+(detect_machine_model) carries the calibrated chip constants from
+CHIP_PRESETS / CALIBRATION.md; this test asserts those constants still
+track reality within 2x in BOTH directions.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+if jax.default_backend() == "cpu":
+    pytest.skip("no TPU backend; calibration regression needs a chip",
+                allow_module_level=True)
+
+
+@pytest.mark.parametrize(
+    "name,b,L,s,h,heads",
+    [
+        ("small", 8, 4, 256, 512, 8),
+        ("bert-base-bench", 8, 12, 512, 1024, 16),
+    ],
+)
+def test_simulated_step_within_2x_of_measured(name, b, L, s, h, heads):
+    from flexflow_tpu.sim import OpCostModel, Simulator, detect_machine_model
+    from flexflow_tpu.sim.calibrate import (_build_transformer,
+                                            measure_step_time)
+
+    ff = _build_transformer(b, L, s, h, heads)
+    real = measure_step_time(ff, b, s, h, iters=15)
+    machine = detect_machine_model(1)
+    sim = Simulator(machine, OpCostModel(machine))
+    est = sim.simulate_runtime(ff.compiled.ops)
+    ratio = est / real
+    assert 0.5 <= ratio <= 2.0, (
+        f"{name}: simulated {est * 1e3:.2f} ms vs measured "
+        f"{real * 1e3:.2f} ms (ratio {ratio:.2f}) — recalibrate via "
+        f"flexflow_tpu.sim.calibrate (see CALIBRATION.md)")
