@@ -1,0 +1,164 @@
+"""Multi-host / multi-slice execution.
+
+TPU-native equivalent of the reference's multi-node story
+(reference: MULTI-NODE.md + .github/workflows/multinode-test.yml:82-158 —
+Legion over GASNet-EX/UCX/MPI conduits, launched under mpirun). Here the
+control plane is **jax.distributed** (one Python process per host, a
+coordinator service, all hosts executing the same SPMD program) and the
+data plane is XLA collectives: ICI within a slice, DCN across slices.
+
+Three pieces:
+
+* :func:`distributed_init` — process bootstrap (the ``mpirun`` env wiring
+  of multinode-test.yml, with SLURM/OpenMPI/manual env fallbacks);
+* :func:`make_multihost_mesh` — a global mesh over every process's
+  devices, optionally hybrid ICI x DCN so the slowest (DCN) hops carry
+  only the outermost axis (reference analog: inter-node bandwidth in its
+  machine models);
+* :func:`process_local_batch` — assemble a GLOBAL batch array from each
+  process's local rows (the process-count-aware dataloader path; the
+  reference's per-node zero-copy DRAM + per-device copy tasks,
+  dataloader.cc:232).
+
+See MULTIHOST.md for the launch recipe; hermetically testable on one
+machine via two localhost processes with CPU devices
+(tests/test_multihost.py).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+
+from ..core.machine import make_mesh
+
+
+def distributed_init(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids: Optional[Sequence[int]] = None,
+) -> None:
+    """Initialize the multi-process runtime (reference: the mpirun +
+    GASNet/UCX bootstrap of MULTI-NODE.md).
+
+    Arguments default from the environment so one launch script serves
+    every scheduler, in priority order:
+
+    * explicit arguments;
+    * ``FLEXFLOW_COORDINATOR`` / ``FLEXFLOW_NUM_PROCESSES`` /
+      ``FLEXFLOW_PROCESS_ID`` (this framework's spellings);
+    * OpenMPI (``OMPI_COMM_WORLD_RANK`` / ``OMPI_COMM_WORLD_SIZE``) and
+      SLURM (``SLURM_PROCID`` / ``SLURM_NTASKS``) env;
+    * jax's own auto-detection (TPU pods discover their topology without
+      any of this — on Cloud TPU just call ``distributed_init()``).
+
+    Idempotent: a second call in an initialized process is a no-op.
+    """
+    if getattr(distributed_init, "_done", False):
+        return
+    env = os.environ
+    coordinator_address = (
+        coordinator_address or env.get("FLEXFLOW_COORDINATOR") or None
+    )
+
+    def _int(v):
+        return int(v) if v is not None else None
+
+    num_processes = _int(
+        num_processes if num_processes is not None
+        else env.get("FLEXFLOW_NUM_PROCESSES")
+        or env.get("OMPI_COMM_WORLD_SIZE") or env.get("SLURM_NTASKS")
+    )
+    process_id = _int(
+        process_id if process_id is not None
+        else env.get("FLEXFLOW_PROCESS_ID")
+        or env.get("OMPI_COMM_WORLD_RANK") or env.get("SLURM_PROCID")
+    )
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+    )
+    distributed_init._done = True
+
+
+def make_multihost_mesh(
+    mesh_shape: Optional[Dict[str, int]] = None,
+    dcn_mesh_shape: Optional[Dict[str, int]] = None,
+) -> Mesh:
+    """Global mesh over all processes' devices.
+
+    Without ``dcn_mesh_shape`` this is :func:`make_mesh` over the GLOBAL
+    device list (jax.devices() spans every process after
+    :func:`distributed_init`).
+
+    With ``dcn_mesh_shape`` (e.g. ``{"data": n_slices}``) the mesh is
+    hybrid: the DCN axes are outermost and only they cross slice
+    boundaries, so every collective on the inner (ICI) axes rides the
+    torus (reference analog: its machine models price inter-node hops
+    separately; here the LAYOUT guarantees the slow hops are the
+    data-parallel all-reduce only). Axis order: DCN axes then ICI axes —
+    an axis named in both composes (dcn_degree * ici_degree).
+    """
+    if not dcn_mesh_shape:
+        return make_mesh(mesh_shape)
+    from jax.experimental import mesh_utils
+
+    mesh_shape = dict(mesh_shape or {})
+    dcn = dict(dcn_mesh_shape)
+    # one flat axis list: DCN axes first (outermost = slowest network)
+    names = list(dict.fromkeys(list(dcn.keys()) + list(mesh_shape.keys())))
+    ici_sizes = [mesh_shape.get(a, 1) for a in names]
+    dcn_sizes = [dcn.get(a, 1) for a in names]
+    try:
+        # real TPU slices: granule = slice (devices carry slice_index)
+        devs = mesh_utils.create_hybrid_device_mesh(
+            ici_sizes, dcn_sizes, devices=jax.devices())
+    except (ValueError, AttributeError, KeyError) as e_slice:
+        try:
+            # no slice metadata (CPU / single-slice): granule = process
+            devs = mesh_utils.create_hybrid_device_mesh(
+                ici_sizes, dcn_sizes, devices=jax.devices(),
+                process_is_granule=True)
+        except (ValueError, AttributeError, KeyError) as e_proc:
+            # flat fallback: jax.devices() orders by (process, local id),
+            # so folding the DCN degree into the outermost position still
+            # puts the slow hops on the leading axis — but the
+            # hybrid-layout guarantee is weakened, so say so loudly
+            import warnings
+
+            warnings.warn(
+                f"make_multihost_mesh: hybrid ICI x DCN construction "
+                f"failed (slice granule: {e_slice}; process granule: "
+                f"{e_proc}); falling back to a flat mesh with the DCN "
+                f"axes outermost. On multi-slice hardware verify the "
+                f"requested shapes match the per-slice device count.",
+                stacklevel=2)
+            merged = {a: dcn.get(a, 1) * mesh_shape.get(a, 1) for a in names}
+            return make_mesh(merged)
+    return Mesh(devs, tuple(names))
+
+
+def process_local_batch(
+    global_batch: np.ndarray, sharding: NamedSharding
+) -> jax.Array:
+    """Build the global on-device batch from THIS process's rows.
+
+    Every process holds the full dataset in host memory (the reference
+    keeps it in per-node zero-copy DRAM, dataloader.h:34-125);
+    ``jax.make_array_from_callback`` asks for exactly the index-slice each
+    ADDRESSABLE device owns — derived from the sharding itself, so any
+    layout works (data degree above, equal to, or below the process
+    count; replication across model-sharded processes) with no cross-host
+    transfer.
+    """
+    if jax.process_count() == 1:
+        return jax.device_put(global_batch, sharding)
+    return jax.make_array_from_callback(
+        global_batch.shape, sharding, lambda idx: global_batch[idx])

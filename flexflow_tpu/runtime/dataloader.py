@@ -65,6 +65,12 @@ class SingleDataLoader:
         else:
             batch = self.data[i : i + self.batch_size]
         self.next_index = i + self.batch_size
+        if jax.process_count() > 1 and self.sharding is not None:
+            # multi-host: this process contributes only the rows its
+            # addressable devices own (parallel/multihost.py)
+            from ..parallel.multihost import process_local_batch
+
+            return process_local_batch(batch, self.sharding)
         return jax.device_put(batch, self.sharding)
 
 
@@ -125,6 +131,15 @@ class DataLoaderGroup:
             if rows is None:  # epoch end: wrap like SingleDataLoader does
                 self._native.reset(reshuffle=False)
                 rows = self._native.next_batch()
+            if jax.process_count() > 1:
+                # multi-host: same routing as SingleDataLoader.next_batch
+                # (device_put cannot target non-addressable devices)
+                from ..parallel.multihost import process_local_batch
+
+                return [
+                    process_local_batch(np.asarray(r), l.sharding)
+                    for r, l in zip(rows, self.loaders)
+                ]
             return [
                 jax.device_put(r, l.sharding)
                 for r, l in zip(rows, self.loaders)
