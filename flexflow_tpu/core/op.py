@@ -62,6 +62,12 @@ class LowerCtx:
     # the reference injects these as hand-written gradients in aggregate.cu;
     # here they are differentiable terms added to the training loss)
     aux_losses: Optional[list] = None
+    # non-trainable state written during the training forward (BatchNorm
+    # running statistics): {(op_name, weight_name): new_value}. The train
+    # step writes these back into params AFTER the optimizer update, under
+    # stop_gradient (their grads are zero anyway: training never reads
+    # them). None = caller doesn't track state (eval / pipeline stages).
+    state_updates: Optional[dict] = None
 
 
 class Op:

@@ -85,6 +85,22 @@ class ONNXModel:
         strides = a.get("strides", [1, 1])
         pads = a.get("pads", [0, 0, 0, 0])
         group = a.get("group", 1)
+        # reject silently-wrong imports instead of dropping attributes
+        # (reference walker handles only the symmetric/undilated subset too)
+        dil = list(a.get("dilations", [1, 1]))
+        if any(d != 1 for d in dil):
+            raise ValueError(
+                f"Conv {node.name!r}: dilations={dil} unsupported")
+        if len(pads) >= 4 and list(pads[:2]) != list(pads[2:4]):
+            raise ValueError(
+                f"Conv {node.name!r}: asymmetric pads={list(pads)} "
+                f"unsupported (begin must equal end)")
+        auto_pad = a.get("auto_pad", b"NOTSET")
+        auto_pad = auto_pad.decode() if isinstance(auto_pad, bytes) else auto_pad
+        if auto_pad not in ("NOTSET", "VALID"):
+            raise ValueError(
+                f"Conv {node.name!r}: auto_pad={auto_pad!r} unsupported "
+                f"(export with explicit pads)")
         return ff.conv2d(env[node.input[0]], out_c, kh, kw, strides[0],
                          strides[1], pads[0], pads[1], groups=group,
                          use_bias=len(node.input) > 2, name=node.name or None)
@@ -106,6 +122,16 @@ class ONNXModel:
     def handleGemm(self, ff, node, env):
         w = self.inits[node.input[1]]
         a = _attrs(node)
+        # reject attribute values the dense lowering would silently ignore
+        if a.get("transA", 0):
+            raise ValueError(f"Gemm {node.name!r}: transA=1 unsupported")
+        if float(a.get("alpha", 1.0)) != 1.0:
+            raise ValueError(
+                f"Gemm {node.name!r}: alpha={a.get('alpha')} unsupported")
+        # beta only matters when a C (bias) input exists
+        if len(node.input) > 2 and float(a.get("beta", 1.0)) != 1.0:
+            raise ValueError(
+                f"Gemm {node.name!r}: beta={a.get('beta')} unsupported")
         out_dim = w.shape[0] if a.get("transB", 0) else w.shape[1]
         return ff.dense(env[node.input[0]], int(out_dim),
                         use_bias=len(node.input) > 2, name=node.name or None)

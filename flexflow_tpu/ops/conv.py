@@ -161,10 +161,18 @@ class Pool2D(Op):
 class BatchNorm(Op):
     """Batch normalization over N,H,W per channel (NCHW).
 
-    reference: src/ops/batch_norm.cc (cuDNN spatial BN). Round-1 note:
-    normalization uses batch statistics in both modes; running-average
-    state for inference-mode parity is tracked in the model-state pytree
-    once that lands (see runtime/compiler.py TODO).
+    reference: src/ops/batch_norm.cc (cuDNN spatial BN: batch statistics
+    in training with exponential running averages; running statistics in
+    inference). Running mean/var live as non-trainable weights — their
+    gradients are structurally zero (the training path never reads them)
+    and the train step writes the updated averages back after the
+    optimizer update via ``LowerCtx.state_updates``. Update rule matches
+    torch: ``new = (1 - momentum) * old + momentum * batch`` with the
+    UNBIASED batch variance feeding running_var.
+
+    Running statistics update through ``fit``'s jitted train step only:
+    the manual forward()/backward()/update() verbs and pipelined training
+    do not track state updates (the pipeline engine warns).
     """
 
     op_type = OpType.BATCHNORM
@@ -178,13 +186,31 @@ class BatchNorm(Op):
         return [
             WeightSpec("scale", (c,), dt, ConstantInitializer(1.0), weight_decay=False),
             WeightSpec("bias", (c,), dt, ZeroInitializer(), weight_decay=False),
+            WeightSpec("running_mean", (c,), dt, ZeroInitializer(),
+                       weight_decay=False),
+            WeightSpec("running_var", (c,), dt, ConstantInitializer(1.0),
+                       weight_decay=False),
         ]
 
     def forward(self, ctx, inputs, weights):
         (x,) = inputs
         eps = 1e-5
-        mean = jnp.mean(x, axis=(0, 2, 3), keepdims=True)
-        var = jnp.var(x, axis=(0, 2, 3), keepdims=True)
+        if ctx.training:
+            mean = jnp.mean(x, axis=(0, 2, 3), keepdims=True)
+            var = jnp.var(x, axis=(0, 2, 3), keepdims=True)
+            if ctx.state_updates is not None:
+                m = float(self.attrs.get("momentum", 0.1))
+                n = x.shape[0] * x.shape[2] * x.shape[3]
+                unbiased = var[0, :, 0, 0] * (n / max(1, n - 1))
+                ctx.state_updates[(self.name, "running_mean")] = (
+                    (1.0 - m) * weights["running_mean"] + m * mean[0, :, 0, 0]
+                )
+                ctx.state_updates[(self.name, "running_var")] = (
+                    (1.0 - m) * weights["running_var"] + m * unbiased
+                )
+        else:
+            mean = weights["running_mean"][None, :, None, None]
+            var = weights["running_var"][None, :, None, None]
         y = (x - mean) * jax.lax.rsqrt(var + eps)
         y = y * weights["scale"][None, :, None, None] + weights["bias"][None, :, None, None]
         if self.attrs.get("relu", True):

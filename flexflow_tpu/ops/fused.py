@@ -100,9 +100,10 @@ def apply_fusion(layers: List[Layer], protected: Set[int]) -> List[Layer]:
                        name="fused_" + "_".join(l.name for l in run),
                        inputs=list(run[0].inputs),
                        attrs={"sub_layers": list(run)})
+            # non-mutating: the shared Tensor objects keep their original
+            # owner_layer, so a later compile() with fusion disabled sees
+            # the pristine builder graph (toposort validates by tensor id)
             fl.outputs = list(run[-1].outputs)
-            for t in fl.outputs:
-                t.owner_layer = fl
             fused.append(fl)
         else:
             fused.extend(run)

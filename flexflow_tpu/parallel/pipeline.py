@@ -106,6 +106,16 @@ class PipelinedModel:
                 f"num_stages={cfg.num_stages} must equal mesh {cfg.axis} "
                 f"size {S}"
             )
+        from ..ffconst import OpType
+
+        if any(op.op_type is OpType.BATCHNORM for op in ops):
+            import warnings
+
+            warnings.warn(
+                "pipelined training does not update BatchNorm running "
+                "statistics (stage programs don't track state updates); "
+                "eval will normalize with the initial running stats",
+                stacklevel=3)
         self.cfg = cfg
         self.mesh = mesh
         self.optimizer = optimizer

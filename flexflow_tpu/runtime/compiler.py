@@ -77,13 +77,22 @@ class CompiledModel:
 def toposort_layers(layers: List[Layer]) -> List[Layer]:
     """Builder order is already topological (each layer only consumes
     previously-created tensors), mirroring the reference's operator list
-    ordering; validate rather than re-sort."""
+    ordering; validate rather than re-sort.
+
+    Validation is by produced TENSOR ids, not owner_layer pointers, so
+    graph passes that re-wrap layers (fusion) need not mutate the shared
+    Tensor objects' owner_layer fields."""
+    produced = set()
+    for l in layers:
+        for t in l.outputs:
+            produced.add(t.tensor_id)
     seen = set()
     for l in layers:
         for t in l.inputs:
-            if t.owner_layer is not None and t.owner_layer.layer_guid not in seen:
+            if t.tensor_id in produced and t.tensor_id not in seen:
                 raise ValueError(f"layer graph not topologically ordered at {l}")
-        seen.add(l.layer_guid)
+        for t in l.outputs:
+            seen.add(t.tensor_id)
     return layers
 
 
@@ -175,12 +184,13 @@ def _forward_graph(
     rng: Optional[jax.Array],
     seq_length: int = -1,
 ):
-    """Run the op graph; returns (dict tensor_id -> activation, aux_losses).
+    """Run the op graph; returns (acts dict, aux_losses, state_updates).
 
     Sharding constraints on op outputs realize the PCG's parallel-op
     transitions (SURVEY.md §7: Partition/Combine/Replicate/Reduction map to
     resharding)."""
-    ctx = LowerCtx(mesh=mesh, training=training, seq_length=seq_length, aux_losses=[])
+    ctx = LowerCtx(mesh=mesh, training=training, seq_length=seq_length,
+                   aux_losses=[], state_updates={} if training else None)
     acts: Dict[int, jnp.ndarray] = dict(inputs)
     for oi, op in enumerate(ops):
         ins = [acts[t.tensor_id] for t in op.layer.inputs]
@@ -193,7 +203,7 @@ def _forward_graph(
             ):
                 out = jax.lax.with_sharding_constraint(out, _named_sharding(mesh, ps))
             acts[t.tensor_id] = out
-    return acts, ctx.aux_losses
+    return acts, ctx.aux_losses, ctx.state_updates or {}
 
 
 def compile_model(
@@ -282,33 +292,49 @@ def compile_model(
     from_logits = _logits_op is None or _logits_op.op_type is not OpType.SOFTMAX
 
     # ---- train step --------------------------------------------------------
-    def train_step(params, opt_state, rng, *batch):
+    # ``seq_length`` is a leading STATIC argument on every step function:
+    # each distinct value compiles its own executable (bucketed compile) —
+    # the iteration-level truncation of the reference's
+    # FFIterationConfig.seq_length (config.h:162-167, consumed by
+    # BatchMatmul's a/b_seq_length_dim, model.cc:2415-2420). The public
+    # wrappers keep the old calling convention with seq_length as a
+    # keyword defaulting to -1 (no truncation).
+    def train_step(seq_length, params, opt_state, rng, *batch):
         xs = batch[:n_inputs]
         y = batch[n_inputs]
 
         def loss_fn(params):
-            acts, aux = _forward_graph(
-                ops, mesh, params, dict(zip(input_ids, xs)), True, rng
+            acts, aux, updates = _forward_graph(
+                ops, mesh, params, dict(zip(input_ids, xs)), True, rng,
+                seq_length,
             )
             logits = acts[logits_id]
             loss = compute_loss(loss_type, logits, y, from_logits)
             for a in aux:
                 loss = loss + a
-            return loss, logits
+            return loss, (logits, updates)
 
-        (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        (loss, (logits, updates)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
         batch_metrics = compute_batch_metrics(metrics, loss_type, logits, y, from_logits)
         new_params, new_opt_state = optimizer.update(params, grads, opt_state, wd_mask)
+        # non-trainable state (BatchNorm running stats) written after the
+        # optimizer update — reference: cuDNN BN forward-training updates
+        # the running averages in the same pass (batch_norm.cu)
+        for (opn, wn), v in updates.items():
+            new_params[opn] = {**new_params[opn],
+                               wn: jax.lax.stop_gradient(v)}
         return new_params, new_opt_state, loss, batch_metrics
 
     # ---- standalone grad step (for the manual backward() verb) ------------
-    def grad_step(params, rng, *batch):
+    def grad_step(seq_length, params, rng, *batch):
         xs = batch[:n_inputs]
         y = batch[n_inputs]
 
         def loss_fn(params):
-            acts, aux = _forward_graph(
-                ops, mesh, params, dict(zip(input_ids, xs)), True, rng
+            acts, aux, _updates = _forward_graph(
+                ops, mesh, params, dict(zip(input_ids, xs)), True, rng,
+                seq_length,
             )
             loss = compute_loss(loss_type, acts[logits_id], y, from_logits)
             for a in aux:
@@ -318,25 +344,37 @@ def compile_model(
         return jax.grad(loss_fn)(params)
 
     # ---- eval / forward ----------------------------------------------------
-    def eval_step(params, *batch):
+    def eval_step(seq_length, params, *batch):
         xs = batch[:n_inputs]
         y = batch[n_inputs]
-        acts, _ = _forward_graph(ops, mesh, params, dict(zip(input_ids, xs)), False, None)
+        acts, _, _ = _forward_graph(ops, mesh, params, dict(zip(input_ids, xs)),
+                                    False, None, seq_length)
         logits = acts[logits_id]
         loss = compute_loss(loss_type, logits, y, from_logits) if loss_type else jnp.zeros(())
         return loss, logits, compute_batch_metrics(metrics, loss_type, logits, y, from_logits)
 
-    def forward_fn(params, *xs):
-        acts, _ = _forward_graph(ops, mesh, params, dict(zip(input_ids, xs)), False, None)
+    def forward_fn(params, *xs, seq_length: int = -1):
+        acts, _, _ = _forward_graph(ops, mesh, params, dict(zip(input_ids, xs)),
+                                    False, None, seq_length)
         return acts[logits_id]
+
+    def _wrap(jitted):
+        """seq_length keyword -> leading static positional."""
+        def call(*args, seq_length: int = -1):
+            return jitted(seq_length, *args)
+        return call
 
     jit_train = None
     jit_grad = None
     if optimizer is not None and loss_type is not None:
-        jit_train = jax.jit(train_step, donate_argnums=(0, 1))
-        jit_grad = jax.jit(grad_step)
-    jit_eval = jax.jit(eval_step)
-    jit_forward = jax.jit(forward_fn)
+        jit_train = _wrap(
+            jax.jit(train_step, static_argnums=0, donate_argnums=(1, 2)))
+        jit_grad = _wrap(jax.jit(grad_step, static_argnums=0))
+    jit_eval = _wrap(jax.jit(eval_step, static_argnums=0))
+    _jit_fwd = jax.jit(forward_fn, static_argnames=("seq_length",))
+
+    def jit_forward(params, *xs, seq_length: int = -1):
+        return _jit_fwd(params, *xs, seq_length=seq_length)
 
     return CompiledModel(
         config=config,

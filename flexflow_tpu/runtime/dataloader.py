@@ -22,6 +22,17 @@ import jax
 from jax.sharding import NamedSharding
 
 
+def _put(batch: np.ndarray, sharding: Optional[NamedSharding]) -> jax.Array:
+    """Place a host batch: sharded placement routes through the
+    process-aware path (parallel/multihost.py — single-process it is a
+    plain device_put); unsharded falls back to the default device."""
+    if sharding is None:
+        return jax.device_put(batch)
+    from ..parallel.multihost import process_local_batch
+
+    return process_local_batch(batch, sharding)
+
+
 class SingleDataLoader:
     """One tensor's dataloader (reference: dataloader.h:34).
 
@@ -65,13 +76,7 @@ class SingleDataLoader:
         else:
             batch = self.data[i : i + self.batch_size]
         self.next_index = i + self.batch_size
-        if jax.process_count() > 1 and self.sharding is not None:
-            # multi-host: this process contributes only the rows its
-            # addressable devices own (parallel/multihost.py)
-            from ..parallel.multihost import process_local_batch
-
-            return process_local_batch(batch, self.sharding)
-        return jax.device_put(batch, self.sharding)
+        return _put(batch, self.sharding)
 
 
 class DataLoaderGroup:
@@ -131,17 +136,8 @@ class DataLoaderGroup:
             if rows is None:  # epoch end: wrap like SingleDataLoader does
                 self._native.reset(reshuffle=False)
                 rows = self._native.next_batch()
-            if jax.process_count() > 1:
-                # multi-host: same routing as SingleDataLoader.next_batch
-                # (device_put cannot target non-addressable devices)
-                from ..parallel.multihost import process_local_batch
-
-                return [
-                    process_local_batch(np.asarray(r), l.sharding)
-                    for r, l in zip(rows, self.loaders)
-                ]
             return [
-                jax.device_put(r, l.sharding)
+                _put(np.asarray(r), l.sharding)
                 for r, l in zip(rows, self.loaders)
             ]
         return [l.next_batch() for l in self.loaders]

@@ -39,6 +39,22 @@ class PerfMetrics:
             if k in batch:
                 setattr(self, k, getattr(self, k) + float(batch[k]))
 
+    # -- device-side accumulation (fit/eval loops) ------------------------ #
+    # Per-batch metrics stay on device across an epoch (tiny eager adds,
+    # no host sync per step — the reference chains PerfMetrics through
+    # futures for the same reason, model.cc:2880); flush() converts once.
+    def accumulate(self, batch: Dict) -> None:
+        acc = getattr(self, "_dev_acc", None)
+        self._dev_acc = batch if acc is None else {
+            k: acc[k] + v for k, v in batch.items()
+        }
+
+    def flush(self) -> None:
+        acc = getattr(self, "_dev_acc", None)
+        if acc:
+            self.update({k: float(v) for k, v in acc.items()})
+        self._dev_acc = None
+
     @property
     def accuracy(self) -> float:
         return self.train_correct / max(1, self.train_all)

@@ -905,9 +905,10 @@ class FFModel:
                     )
                 else:
                     cm.params, cm.opt_state, loss, bm = cm.train_step(
-                        cm.params, cm.opt_state, self._next_rng(), *batch
+                        cm.params, cm.opt_state, self._next_rng(), *batch,
+                        seq_length=self.iter_config.seq_length,
                     )
-                pm.update({k: float(v) for k, v in bm.items()})
+                pm.accumulate(bm)
                 last_loss = loss
                 cm._iteration += 1
                 if recompile_state is not None:
@@ -918,6 +919,7 @@ class FFModel:
                     recompile_state.last_metric = float(loss)
                     if recompile_on_condition(self, recompile_state):
                         cm = self.compiled
+            pm.flush()
             if verbose:
                 lv = float(last_loss) if last_loss is not None else float("nan")
                 print(
@@ -950,8 +952,11 @@ class FFModel:
         pm = PerfMetrics()
         for _ in range(group.num_batches):
             batch = group.next_batch()
-            loss, logits, bm = cm.eval_step(cm.params, *batch)
-            pm.update({k: float(v) for k, v in bm.items()})
+            loss, logits, bm = cm.eval_step(
+                cm.params, *batch,
+                seq_length=self.iter_config.seq_length)
+            pm.accumulate(bm)
+        pm.flush()
         if verbose:
             print(f"eval: {pm.report(cm.metrics)}", flush=True)
         return pm
@@ -965,11 +970,14 @@ class FFModel:
         self._cur_batch = batch
 
     def forward(self, seq_length: Optional[int] = None) -> jax.Array:
-        """reference: FFModel::forward (model.cc:2415)."""
+        """reference: FFModel::forward (model.cc:2415). ``seq_length``
+        truncates sequence ops for this iteration (FFIterationConfig —
+        each distinct value is its own compiled executable)."""
         cm = self.compiled
         assert self._cur_batch is not None, "set_batch first"
         xs = self._cur_batch[: len(cm.input_tensors)]
-        self._cur_logits = cm.forward_fn(cm.params, *xs)
+        sl = self.iter_config.seq_length if seq_length is None else seq_length
+        self._cur_logits = cm.forward_fn(cm.params, *xs, seq_length=sl)
         return self._cur_logits
 
     def zero_gradients(self) -> None:
@@ -983,7 +991,9 @@ class FFModel:
         at compile time."""
         cm = self.compiled
         assert self._cur_batch is not None and cm.loss_type is not None
-        self._cur_grads = cm.grad_step(cm.params, self._next_rng(), *self._cur_batch)
+        sl = self.iter_config.seq_length if seq_length is None else seq_length
+        self._cur_grads = cm.grad_step(cm.params, self._next_rng(),
+                                       *self._cur_batch, seq_length=sl)
 
     def update(self) -> None:
         """reference: FFModel::update (model.cc:2469) — optimizer step."""

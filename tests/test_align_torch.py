@@ -196,6 +196,85 @@ def test_align_mean_reduce():
     _check(ff, g, tf, tg)
 
 
+def test_align_batch_norm_both_modes():
+    """BatchNorm vs torch in TRAINING (batch stats + running-average
+    update) and EVAL (running stats) — the reference's cuDNN BN semantics
+    (src/ops/batch_norm.cc); round-1 lacked running statistics entirely."""
+    x = RNG.normal(size=(8, 3, 5, 5)).astype(np.float32)
+    scale = RNG.normal(size=(3,)).astype(np.float32)
+    bias = RNG.normal(size=(3,)).astype(np.float32)
+    rm = RNG.normal(size=(3,)).astype(np.float32)
+    rv = RNG.uniform(0.5, 2.0, size=(3,)).astype(np.float32)
+
+    pshape = [ParallelTensorShape.unpartitioned(x.shape, DataType.FLOAT)]
+    layer = Layer(OpType.BATCHNORM, name="bn", attrs={"relu": False})
+    op = create_op(layer, pshape)
+    weights = {"scale": jnp.asarray(scale), "bias": jnp.asarray(bias),
+               "running_mean": jnp.asarray(rm), "running_var": jnp.asarray(rv)}
+
+    tbn = torch.nn.BatchNorm2d(3, eps=1e-5, momentum=0.1)
+    with torch.no_grad():
+        tbn.weight.copy_(torch.tensor(scale))
+        tbn.bias.copy_(torch.tensor(bias))
+        tbn.running_mean.copy_(torch.tensor(rm))
+        tbn.running_var.copy_(torch.tensor(rv))
+
+    # training mode: output uses batch stats; running averages update
+    ctx = LowerCtx(mesh=None, training=True, rng=None, state_updates={})
+    (y_tr,) = op.forward(ctx, [jnp.asarray(x)], weights)
+    tbn.train()
+    y_t = tbn(torch.tensor(x))
+    np.testing.assert_allclose(np.asarray(y_tr), y_t.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(ctx.state_updates[("bn", "running_mean")]),
+        tbn.running_mean.numpy(), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(ctx.state_updates[("bn", "running_var")]),
+        tbn.running_var.numpy(), rtol=1e-4, atol=1e-5)
+
+    # eval mode: output uses the ORIGINAL running stats
+    ctx_e = LowerCtx(mesh=None, training=False, rng=None)
+    (y_ev,) = op.forward(ctx_e, [jnp.asarray(x)], weights)
+    tbn2 = torch.nn.BatchNorm2d(3, eps=1e-5)
+    with torch.no_grad():
+        tbn2.weight.copy_(torch.tensor(scale))
+        tbn2.bias.copy_(torch.tensor(bias))
+        tbn2.running_mean.copy_(torch.tensor(rm))
+        tbn2.running_var.copy_(torch.tensor(rv))
+    tbn2.eval()
+    y_te = tbn2(torch.tensor(x))
+    np.testing.assert_allclose(np.asarray(y_ev), y_te.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_batch_norm_running_stats_through_fit():
+    """End-to-end: fit() updates running stats in cm.params; eval uses
+    them (previously eval normalized with batch statistics)."""
+    from flexflow_tpu import DataType as DT
+    from flexflow_tpu import FFConfig, FFModel, LossType, make_mesh
+    from flexflow_tpu.runtime.optimizer import SGDOptimizer
+
+    bs = 16
+    ff = FFModel(FFConfig(batch_size=bs, epochs=2, seed=0))
+    t = ff.create_tensor((bs, 3, 8, 8), DT.FLOAT, name="input")
+    t = ff.conv2d(t, 4, 3, 3, 1, 1, 1, 1, name="conv")
+    t = ff.batch_norm(t, relu=True, name="bn")
+    t = ff.flat(t)
+    t = ff.dense(t, 4, name="head")
+    ff.softmax(t)
+    ff.compile(optimizer=SGDOptimizer(lr=0.05),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=[],
+               mesh=make_mesh({"data": 1}, devices=jax.devices()[:1]))
+    before = np.asarray(ff.compiled.params["bn"]["running_mean"])
+    x = RNG.normal(size=(32, 3, 8, 8)).astype(np.float32) + 2.0
+    y = RNG.integers(0, 4, size=(32, 1)).astype(np.int32)
+    ff.fit(x, y, verbose=False)
+    after = np.asarray(ff.compiled.params["bn"]["running_mean"])
+    assert not np.allclose(before, after), "running stats never updated"
+
+
 def test_align_multihead_attention():
     """Self-attention vs torch.nn.functional.scaled_dot_product_attention
     (projection-free comparison via identity-shaped weights)."""

@@ -66,6 +66,46 @@ def test_mlp_adam_and_eval():
     assert pm.accuracy > 0.85
 
 
+def test_seq_length_truncation_threaded():
+    """FFIterationConfig.seq_length reaches the jitted step: BatchMatmul
+    slices its seq dim per iteration (reference: forward(seq_length)
+    model.cc:2415-2420 consumed by a_seq_length_dim; previously the
+    argument was accepted and discarded)."""
+    import jax
+
+    from flexflow_tpu import DataType, FFConfig, FFModel, make_mesh
+
+    B, S, D = 2, 8, 4
+    ff = FFModel(FFConfig(batch_size=B, seed=0))
+    a = ff.create_tensor((B, S, D), DataType.FLOAT, name="a")
+    b = ff.create_tensor((B, D, S), DataType.FLOAT, name="b")
+    ff.batch_matmul(a, b, a_seq_length_dim=1, name="bmm")
+    ff.compile(optimizer=None, loss_type=None, metrics=[],
+               mesh=make_mesh({"data": 1}, devices=jax.devices()[:1]))
+
+    rng = np.random.default_rng(0)
+    av = rng.normal(size=(B, S, D)).astype(np.float32)
+    bv = rng.normal(size=(B, D, S)).astype(np.float32)
+
+    full = np.asarray(ff.compiled.forward_fn(ff.compiled.params, av, bv))
+    assert full.shape == (B, S, S)
+
+    # iteration-level truncation via the manual verbs
+    ff.set_batch([av, bv])
+    ff.iter_config.seq_length = 4
+    out = np.asarray(ff.forward())
+    assert out.shape == (B, 4, S)
+    np.testing.assert_allclose(out, av[:, :4] @ bv, rtol=1e-5)
+
+    # explicit argument wins over iter_config; -1 restores full length
+    out2 = np.asarray(ff.forward(seq_length=2))
+    assert out2.shape == (B, 2, S)
+    ff.iter_config.reset()
+    out3 = np.asarray(ff.forward())
+    assert out3.shape == (B, S, S)
+    np.testing.assert_allclose(out3, full, rtol=1e-6)
+
+
 def test_manual_training_verbs():
     """forward/zero_gradients/backward/update parity loop
     (reference: flexflow_cffi.py fit internals)."""

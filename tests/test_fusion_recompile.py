@@ -46,6 +46,22 @@ def test_fusion_shrinks_graph_and_matches():
     assert abs(hf[-1].accuracy - hn[-1].accuracy) < 1e-9
 
 
+def test_fusion_pass_is_non_mutating():
+    """Round-1 advisor: apply_fusion mutated shared Tensors' owner_layer,
+    so a recompile with fusion disabled failed toposort. Fusing then
+    recompiling plain on the same FFModel must work."""
+    ff = _chain_model(fusion=True)
+    assert any(op.op_type is OpType.FUSED for op in ff.compiled.ops)
+    ff.config.perform_fusion = False
+    ff.compile(optimizer=AdamOptimizer(alpha=0.01),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=[MetricsType.ACCURACY])
+    assert all(op.op_type is not OpType.FUSED for op in ff.compiled.ops)
+    x, y = _data()
+    hist = ff.fit(x, y, epochs=1, verbose=False)
+    assert len(hist) == 1
+
+
 def test_fusion_respects_multi_consumer():
     ff = FFModel(FFConfig(batch_size=8, seed=0))
     ff.config.perform_fusion = True
