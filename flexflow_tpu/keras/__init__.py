@@ -30,6 +30,15 @@ from .layers import (
     Reshape,
     Subtract,
 )
+from .callbacks import (
+    Callback,
+    EarlyStopping,
+    EpochVerifyMetrics,
+    History,
+    LearningRateScheduler,
+    ModelAccuracy,
+    VerifyMetrics,
+)
 from .models import Model, Sequential
 from .optimizers import SGD, Adam
 
@@ -38,4 +47,6 @@ __all__ = [
     "Concatenate", "Conv2D", "Dense", "Dropout", "Embedding", "Flatten",
     "Input", "LayerNormalization", "MaxPooling2D", "Multiply", "Reshape",
     "Subtract", "Model", "Sequential", "SGD", "Adam",
+    "Callback", "EarlyStopping", "EpochVerifyMetrics", "History",
+    "LearningRateScheduler", "ModelAccuracy", "VerifyMetrics",
 ]

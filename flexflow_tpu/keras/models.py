@@ -57,10 +57,13 @@ class _BaseModel:
         self._seed = seed
 
     def fit(self, x, y, epochs: int = 1, batch_size: int = 32,
-            shuffle: bool = True, verbose: bool = False):
+            shuffle: bool = True, verbose: bool = False, callbacks=None):
         """reference: BaseModel.fit (base_model.py:198). A changed
         batch_size forces a rebuild (the graph is compiled batch-first);
-        epochs is honored on every call."""
+        epochs is honored on every call. ``callbacks`` follow the
+        reference's keras callback surface (keras/callbacks.py); with
+        callbacks present, training runs one epoch per FFModel.fit call so
+        epoch hooks see fresh metrics."""
         xs = x if isinstance(x, (list, tuple)) else [x]
         if (self.ffmodel is not None
                 and self.ffmodel.config.batch_size != batch_size):
@@ -82,8 +85,46 @@ class _BaseModel:
                         cm.params[name][w] = jax.device_put(
                             old, cm.param_shardings[name][w])
         self._build(xs, batch_size, epochs)
-        return self.ffmodel.fit(list(xs), y, epochs=epochs, shuffle=shuffle,
-                                verbose=verbose)
+        if not callbacks:
+            return self.ffmodel.fit(list(xs), y, epochs=epochs,
+                                    shuffle=shuffle, verbose=verbose)
+
+        from .callbacks import CallbackList
+
+        self.stop_training = False
+        cl = CallbackList(callbacks, self,
+                          {"epochs": epochs, "batch_size": batch_size})
+        cl.on_train_begin()
+        history = []
+        logs: Dict[str, float] = {}
+        base_seed = self.ffmodel.config.seed
+        try:
+            for epoch in range(epochs):
+                cl.on_epoch_begin(epoch)
+                # distinct shuffle permutation per epoch: each one-epoch
+                # fit builds a fresh DataLoaderGroup from config.seed
+                self.ffmodel.config.seed = base_seed + epoch
+                pms = self.ffmodel.fit(list(xs), y, epochs=1,
+                                       shuffle=shuffle, verbose=verbose)
+                pm = pms[-1]
+                history.extend(pms)
+                logs = {"accuracy": pm.accuracy}
+                loss_alias = None
+                for k in ("cce_loss", "sparse_cce_loss", "mse_loss",
+                          "rmse_loss", "mae_loss"):
+                    v = getattr(pm, k)
+                    if v:
+                        logs[k] = v / max(1, pm.train_all)
+                        loss_alias = loss_alias or logs[k]
+                if loss_alias is not None:
+                    logs["loss"] = loss_alias  # generic monitor key
+                cl.on_epoch_end(epoch, logs)
+                if getattr(self, "stop_training", False):
+                    break
+        finally:
+            self.ffmodel.config.seed = base_seed
+        cl.on_train_end(logs)
+        return history
 
     def evaluate(self, x, y, batch_size: int = 32, verbose: bool = False):
         xs = x if isinstance(x, (list, tuple)) else [x]

@@ -4,6 +4,10 @@
 from .mlp import build_mlp
 from .alexnet import build_alexnet
 from .resnet import build_resnet50
+from .resnext import build_resnext50
+from .inception import build_inception_v3
 from .transformer import build_transformer, build_bert_proxy, TransformerConfig
 from .dlrm import build_dlrm, DLRMConfig
 from .moe import build_moe_mnist, MoeConfig
+from .xdl import build_xdl, XDLConfig
+from .candle_uno import build_candle_uno, CandleUnoConfig

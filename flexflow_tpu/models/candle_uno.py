@@ -1,0 +1,72 @@
+"""CANDLE-Uno drug-response workload (reference:
+examples/cpp/candle_uno/candle_uno.cc:28-150 — the OSDI'22 AE workload
+scripts/osdi22ae/candle_uno.sh): per-feature-TYPE dense encoder towers
+(shared across inputs of the same type, like the reference's
+feature_shapes/input_features maps), concat of the seven encoded inputs,
+then the top dense stack to a scalar response with MSE loss."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+from ..ffconst import ActiMode, DataType
+from ..runtime.model import FFModel
+
+
+@dataclasses.dataclass
+class CandleUnoConfig:
+    """reference: CandleConfig (candle_uno.cc:28-47)."""
+
+    dense_layers: List[int] = dataclasses.field(
+        default_factory=lambda: [4192] * 4)
+    dense_feature_layers: List[int] = dataclasses.field(
+        default_factory=lambda: [4192] * 8)
+    feature_shapes: Dict[str, int] = dataclasses.field(
+        default_factory=lambda: {
+            "dose": 1,
+            "cell.rnaseq": 942,
+            "drug.descriptors": 5270,
+            "drug.fingerprints": 2048,
+        })
+    input_features: Dict[str, str] = dataclasses.field(
+        default_factory=lambda: {
+            "dose1": "dose",
+            "dose2": "dose",
+            "cell.rnaseq": "cell.rnaseq",
+            "drug1.descriptors": "drug.descriptors",
+            "drug1.fingerprints": "drug.fingerprints",
+            "drug2.descriptors": "drug.descriptors",
+            "drug2.fingerprints": "drug.fingerprints",
+        })
+
+
+def build_candle_uno(ff: FFModel, batch_size: int,
+                     cfg: Optional[CandleUnoConfig] = None):
+    """reference: candle_uno.cc:49-56 build_feature_model (relu, no bias)
+    + the top_level_task graph: dose inputs skip the towers; every other
+    input runs through its feature type's encoder stack; concat; top
+    dense_layers; dense(1)."""
+    cfg = cfg or CandleUnoConfig()
+    inputs = []
+    encoded = []
+    for name, ftype in cfg.input_features.items():
+        dim = cfg.feature_shapes[ftype]
+        x = ff.create_tensor((batch_size, dim), DataType.FLOAT,
+                             name=name.replace(".", "_"))
+        inputs.append(x)
+        t = x
+        if ftype != "dose":
+            # feature towers (reference: build_feature_model); weights are
+            # NOT shared across same-type inputs here — the reference
+            # builds a fresh tower per input as well (candle_uno.cc:113)
+            for li, width in enumerate(cfg.dense_feature_layers):
+                t = ff.dense(t, width, ActiMode.RELU, use_bias=False,
+                             name=f"{name.replace('.', '_')}_t{li}")
+        encoded.append(t)
+    out = ff.concat(encoded, axis=-1)
+    for li, width in enumerate(cfg.dense_layers):
+        out = ff.dense(out, width, ActiMode.RELU, use_bias=False,
+                       name=f"top{li}")
+    out = ff.dense(out, 1, ActiMode.NONE, use_bias=False, name="response")
+    return inputs, out

@@ -1,0 +1,54 @@
+"""XDL ads-ranking workload (reference: examples/cpp/XDL/xdl.cc:40-160 —
+the OSDI'22 AE workload scripts/osdi22ae/xdl.sh): N sparse id inputs →
+sum-aggregated embeddings (vocab 1M, dim 64 by default; the
+parameter-parallel shard target) → concat → top MLP with a sigmoid
+head."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+from ..ffconst import ActiMode, AggrMode, DataType
+from ..runtime.model import FFModel
+
+
+@dataclasses.dataclass
+class XDLConfig:
+    """reference: XDLConfig defaults (xdl.cc:26-33, xdl.h)."""
+
+    embedding_size: List[int] = dataclasses.field(
+        default_factory=lambda: [1_000_000] * 4)
+    embedding_bag_size: int = 1
+    sparse_feature_size: int = 64
+    mlp_top: List[int] = dataclasses.field(
+        default_factory=lambda: [256, 512, 512, 1])
+
+
+def build_xdl(ff: FFModel, batch_size: int,
+              cfg: Optional[XDLConfig] = None,
+              embedding_strategy: Optional[dict] = None):
+    """reference: top_level_task wiring (xdl.cc:118-140): per-table
+    create_emb → interact_features (concat) → create_mlp with the sigmoid
+    on the second-to-last layer. ``embedding_strategy`` (e.g.
+    ``{"vocab": "model"}``) pins the DLRM-style vocab-dim parameter
+    parallelism on every table."""
+    cfg = cfg or XDLConfig()
+    inputs = []
+    embedded = []
+    for i, vocab in enumerate(cfg.embedding_size):
+        s = ff.create_tensor((batch_size, cfg.embedding_bag_size),
+                             DataType.INT32, name=f"sparse{i}")
+        inputs.append(s)
+        e = ff.embedding(s, vocab, cfg.sparse_feature_size, AggrMode.SUM,
+                         name=f"emb{i}", strategy=embedding_strategy)
+        embedded.append(e)
+    z = ff.concat(embedded, axis=-1)
+    sigmoid_layer = len(cfg.mlp_top) - 2
+    t = z
+    for i, out_dim in enumerate(cfg.mlp_top):
+        act = ActiMode.SIGMOID if i == sigmoid_layer else ActiMode.RELU
+        if i == len(cfg.mlp_top) - 1:
+            act = ActiMode.NONE
+        t = ff.dense(t, out_dim, act, use_bias=False, name=f"mlp{i}")
+    return inputs, t

@@ -493,6 +493,13 @@ class PipelinedModel:
             merged = self.optimizer.merge_state(merged, placed)
         cm.opt_state = merged
 
+    def refresh_updates(self) -> None:
+        """Re-trace the per-stage optimizer updates after a hyperparameter
+        change (learning-rate schedules): the jitted closures bake the
+        optimizer's attributes in at trace time."""
+        self._stage_update = [self._make_stage_update(s)
+                              for s in range(len(self.stages))]
+
     def sync_from(self, cm) -> None:
         """Re-seed stage params/opt_state from the CompiledModel (after a
         checkpoint restore into cm)."""

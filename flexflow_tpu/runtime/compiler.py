@@ -72,6 +72,10 @@ class CompiledModel:
     tensor_pshapes: Dict[int, ParallelTensorShape]
     from_logits: bool = False  # CE loss path: graph does not end in softmax
     _iteration: int = 0
+    # re-trace the train step after mutating optimizer hyperparameters
+    # (learning-rate schedules): the compiled step bakes them in at trace
+    # time. Set by compile_model; costs one XLA compile per call.
+    refresh_train_step: Any = None
 
 
 def toposort_layers(layers: List[Layer]) -> List[Layer]:
@@ -376,7 +380,7 @@ def compile_model(
     def jit_forward(params, *xs, seq_length: int = -1):
         return _jit_fwd(params, *xs, seq_length=seq_length)
 
-    return CompiledModel(
+    cm = CompiledModel(
         config=config,
         mesh=mesh,
         ops=ops,
@@ -400,3 +404,12 @@ def compile_model(
         from_logits=from_logits,
         tensor_pshapes=pshapes,
     )
+
+    def _refresh_train_step():
+        # fresh jit wrapper → fresh trace → current optimizer hyperparams
+        if optimizer is not None and loss_type is not None:
+            cm.train_step = _wrap(
+                jax.jit(train_step, static_argnums=0, donate_argnums=(1, 2)))
+
+    cm.refresh_train_step = _refresh_train_step
+    return cm

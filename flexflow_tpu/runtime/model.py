@@ -1004,6 +1004,24 @@ class FFModel:
         )
         self._cur_grads = None
 
+    def set_learning_rate(self, lr: float) -> None:
+        """Change the optimizer learning rate mid-training (reference:
+        Optimizer::set_learning_rate used by the keras
+        LearningRateScheduler callback). The compiled step bakes
+        hyperparameters in at trace time, so this re-traces it (one XLA
+        compile per change)."""
+        opt = self.optimizer
+        if not hasattr(opt, "lr") and not hasattr(opt, "alpha"):
+            raise ValueError("optimizer has no learning-rate attribute")
+        if hasattr(opt, "lr"):
+            opt.lr = float(lr)
+        else:
+            opt.alpha = float(lr)
+        if self.compiled is not None and self.compiled.refresh_train_step:
+            self.compiled.refresh_train_step()
+        if self.pipelined is not None:
+            self.pipelined.refresh_updates()
+
     # ---- weight access --------------------------------------------------- #
     def get_layers(self) -> Dict[int, Layer]:
         return dict(enumerate(self.layers))

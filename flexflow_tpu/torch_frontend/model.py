@@ -83,11 +83,27 @@ def _module_record(name, mod, inputs):
     elif isinstance(mod, nn.Identity):
         op = "identity"
     elif isinstance(mod, nn.MultiheadAttention):
-        raise ValueError(
-            "nn.MultiheadAttention cannot be fx-traced generically; build it "
-            "with FFModel.multihead_attention (the reference's torch "
-            "frontend has the same restriction)"
-        )
+        # fx treats nn.MultiheadAttention as a leaf module, so it arrives
+        # as one call_module node mapping 1:1 onto
+        # FFModel.multihead_attention (reference: the torch frontend's
+        # attn handling, model.py:199-2400). Only the batch-first,
+        # self/cross Q-K-V form is representable.
+        if not mod.batch_first:
+            raise ValueError(
+                f"{name}: nn.MultiheadAttention(batch_first=False) uses the "
+                f"(seq, batch, embed) layout; construct it with "
+                f"batch_first=True to import"
+            )
+        if mod.bias_k is not None or mod.add_zero_attn:
+            raise ValueError(
+                f"{name}: add_bias_kv/add_zero_attn are unsupported")
+        if getattr(mod, "_qkv_same_embed_dim", True) is False:
+            raise ValueError(
+                f"{name}: kdim/vdim != embed_dim is unsupported")
+        op = "multihead_attention"
+        a = dict(embed_dim=mod.embed_dim, num_heads=mod.num_heads,
+                 dropout=float(mod.dropout),
+                 bias=mod.in_proj_bias is not None)
     else:
         raise ValueError(f"unsupported module at {name}: {type(mod).__name__}")
     return {"name": name, "kind": "module", "op": op, "inputs": inputs,
@@ -134,7 +150,20 @@ def _trace(module) -> List[Dict]:
         elif node.op == "call_module":
             mod = gm.get_submodule(node.target)
             ins = [a.name for a in node.args if isinstance(a, fx.Node)]
+            # never silently drop a tensor-valued kwarg (e.g. attn_mask /
+            # key_padding_mask on nn.MultiheadAttention)
+            bad_kwargs = [k for k, v in node.kwargs.items()
+                          if isinstance(v, fx.Node)]
+            if bad_kwargs:
+                raise ValueError(
+                    f"{node.name}: tensor kwargs {bad_kwargs} on "
+                    f"{type(mod).__name__} are not importable")
             rec = _module_record(node.name, mod, ins)
+            if rec["op"] == "multihead_attention" and len(ins) != 3:
+                raise ValueError(
+                    f"{node.name}: MultiheadAttention expects exactly "
+                    f"(query, key, value) tensor args, got {len(ins)} "
+                    f"(masks are not importable)")
             rec["module_path"] = node.target
             records.append(rec)
         elif node.op == "call_function" or node.op == "call_method":
@@ -274,6 +303,25 @@ def _function_record(node, torch, F) -> Dict:
     raise ValueError(f"unsupported function: {tgt}")
 
 
+class _UnexportedMarker:
+    """Poison value for traced-but-unexportable results (e.g. attention
+    weights): raises with an actionable message only when actually used."""
+
+    def __init__(self, message: str):
+        self._message = message
+
+    def _fail(self, *_a, **_k):
+        raise ValueError(self._message)
+
+    __getitem__ = __iter__ = __int__ = __index__ = __add__ = __radd__ = _fail
+    __mul__ = __rmul__ = __sub__ = __truediv__ = __call__ = _fail
+    # any attribute access (e.g. .dims during a consuming op) fails too
+    def __getattr__(self, item):
+        if item.startswith("_"):
+            raise AttributeError(item)
+        self._fail()
+
+
 class _SizeMarker:
     """Placeholder for a traced ``tensor.size()`` value. view/reshape
     consumers are rewritten at trace time and never read it; anything else
@@ -405,8 +453,24 @@ class PyTorchModel:
             return ff.split(x[0], a["sizes"], axis=a["axis"], name=name)
         if op == "batch_matmul":
             return ff.batch_matmul(x[0], x[1], name=name)
+        if op == "multihead_attention":
+            return ff.multihead_attention(
+                x[0], x[1], x[2], a["embed_dim"], a["num_heads"],
+                dropout=a.get("dropout", 0.0), bias=a.get("bias", True),
+                name=name)
         if op == "getitem":
-            return x[0][a["index"]]
+            if isinstance(x[0], (list, tuple)):
+                return x[0][a["index"]]
+            # tuple-returning torch modules (nn.MultiheadAttention returns
+            # (output, attn_weights)) lower to a single FF tensor: [0]
+            # passes through; [1] (the weights) is traced even when the
+            # caller discards it (`a, _ = attn(...)`), so poison it — the
+            # error fires only if something actually consumes it
+            if a["index"] == 0:
+                return x[0]
+            return _UnexportedMarker(
+                f"{name}: getitem[{a['index']}] on a single-output op "
+                f"(attention weights are not exported)")
         if op == "size":
             # live only because view/reshape consumed it; those consumers
             # were already rewritten to flat/reshape records, so the value
@@ -463,3 +527,34 @@ def copy_weights(ffmodel, torch_module, layer_names: Optional[Dict[str, str]] = 
                     wmap["scale"].set_weights(ffmodel, mod.weight.detach().numpy())
                 if "bias" in wmap and getattr(mod, "bias", None) is not None:
                     wmap["bias"].set_weights(ffmodel, mod.bias.detach().numpy())
+                if isinstance(mod, torch.nn.BatchNorm2d):
+                    # eval normalizes with running stats (ops/conv.py), so
+                    # a pretrained import MUST carry them over
+                    if "running_mean" in wmap and mod.running_mean is not None:
+                        wmap["running_mean"].set_weights(
+                            ffmodel, mod.running_mean.detach().numpy())
+                    if "running_var" in wmap and mod.running_var is not None:
+                        wmap["running_var"].set_weights(
+                            ffmodel, mod.running_var.detach().numpy())
+            elif isinstance(mod, torch.nn.MultiheadAttention):
+                # torch packs q/k/v projections row-wise into
+                # in_proj_weight (3E, E); FF stores per-head (E_in, H, D)
+                # with wo (H, D, E_out) (ops/attention.py weight_specs)
+                E = mod.embed_dim
+                H = mod.num_heads
+                D = E // H
+                inw = mod.in_proj_weight.detach().numpy()  # (3E, E)
+                for i, wn in enumerate(("wq", "wk", "wv")):
+                    blk = inw[i * E:(i + 1) * E]          # (E_out, E_in)
+                    wmap[wn].set_weights(
+                        ffmodel, blk.T.reshape(E, H, D))
+                ow = mod.out_proj.weight.detach().numpy()  # (E_out, E_in)
+                wmap["wo"].set_weights(
+                    ffmodel, ow.T.reshape(H, D, E))
+                if mod.in_proj_bias is not None and "bq" in wmap:
+                    inb = mod.in_proj_bias.detach().numpy()
+                    for i, bn in enumerate(("bq", "bk", "bv")):
+                        wmap[bn].set_weights(
+                            ffmodel, inb[i * E:(i + 1) * E].reshape(H, D))
+                    wmap["bo"].set_weights(
+                        ffmodel, mod.out_proj.bias.detach().numpy())

@@ -14,15 +14,21 @@ from flexflow_tpu import (
     SGDOptimizer,
 )
 from flexflow_tpu.models import (
+    CandleUnoConfig,
     DLRMConfig,
     MoeConfig,
     TransformerConfig,
+    XDLConfig,
     build_alexnet,
+    build_candle_uno,
     build_dlrm,
+    build_inception_v3,
     build_mlp,
     build_moe_mnist,
     build_resnet50,
+    build_resnext50,
     build_transformer,
+    build_xdl,
 )
 
 
@@ -104,6 +110,81 @@ def test_resnet50_builds():
     x, out = build_resnet50(ff, bs, image_size=229)
     assert out.dims == (bs, 1000)
     assert len([l for l in ff.layers if l.op_type.value == "conv2d"]) == 53
+
+
+def test_inception_v3_builds():
+    """Shape-inference check of the full module graph (reference:
+    inception.cc:152-175); compiling ~94 convs is too slow for CPU CI."""
+    bs = 2
+    ff = FFModel(FFConfig(batch_size=bs))
+    x, out = build_inception_v3(ff, bs)
+    assert out.dims == (bs, 10)
+    convs = [l for l in ff.layers if l.op_type.value == "conv2d"]
+    assert len(convs) == 94  # torchvision InceptionV3 conv count
+    concats = [l for l in ff.layers if l.op_type.value == "concat"]
+    assert len(concats) == 11  # 3xA + B + 4xC + D + 2xE
+
+
+def test_resnext50_builds_and_steps():
+    bs = 2
+    ff = FFModel(FFConfig(batch_size=bs))
+    x, out = build_resnext50(ff, bs, num_classes=10, image_size=64)
+    assert out.dims == (bs, 10)
+    grouped = [l for l in ff.layers
+               if l.op_type.value == "conv2d" and l.attrs.get("groups", 1) > 1]
+    assert len(grouped) == 16  # one grouped conv per block
+
+
+def test_xdl_trains():
+    bs = 16
+    cfg = XDLConfig(embedding_size=[500] * 4, sparse_feature_size=8,
+                    mlp_top=[16, 16, 1])
+    ff = FFModel(FFConfig(batch_size=bs))
+    inputs, out = build_xdl(ff, bs, cfg)
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type=LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+               metrics=[MetricsType.MEAN_SQUARED_ERROR])
+    shapes = [((bs, 1), np.int32)] * 4
+    y = np.zeros((bs, 1), np.float32)
+    _step_once(ff, shapes, y)
+
+
+def test_xdl_embedding_parameter_parallel():
+    """The XDL tables shard on the vocab dim (DLRM-style parameter
+    parallelism, SURVEY.md §2.3 TP)."""
+    bs = 16
+    cfg = XDLConfig(embedding_size=[512] * 2, sparse_feature_size=8,
+                    mlp_top=[16, 1])
+    ff = FFModel(FFConfig(batch_size=bs, mesh_shape={"data": 2, "model": 4}))
+    inputs, out = build_xdl(ff, bs, cfg,
+                            embedding_strategy={"vocab": "model"})
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type=LossType.MEAN_SQUARED_ERROR_AVG_REDUCE, metrics=[])
+    spec = ff.compiled.params["emb0"]["weight"].sharding.spec
+    assert "model" in tuple(spec), spec
+    shapes = [((bs, 1), np.int32)] * 2
+    _step_once(ff, shapes, np.zeros((bs, 1), np.float32))
+
+
+def test_candle_uno_trains():
+    bs = 8
+    cfg = CandleUnoConfig(
+        dense_layers=[32] * 2,
+        dense_feature_layers=[32] * 2,
+        feature_shapes={"dose": 1, "cell.rnaseq": 24,
+                        "drug.descriptors": 32, "drug.fingerprints": 16},
+    )
+    ff = FFModel(FFConfig(batch_size=bs))
+    inputs, out = build_candle_uno(ff, bs, cfg)
+    assert out.dims == (bs, 1)
+    assert len(inputs) == 7  # dose1, dose2, rnaseq, 2x(desc, fp)
+    ff.compile(optimizer=SGDOptimizer(lr=0.001),
+               loss_type=LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+               metrics=[MetricsType.MEAN_SQUARED_ERROR])
+    shapes = [((bs, d), np.float32)
+              for d in (1, 1, 24, 32, 16, 32, 16)]
+    y = np.zeros((bs, 1), np.float32)
+    _step_once(ff, shapes, y)
 
 
 def test_mlp_builder():
