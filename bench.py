@@ -57,13 +57,15 @@ def _peak_flops(device) -> float:
 # orchestrator owns the timeout)
 # --------------------------------------------------------------------------
 
-def _build(batch_size, num_layers, seq, hidden, heads, mesh=None, tp_axis=None):
+def _build(batch_size, num_layers, seq, hidden, heads, mesh=None, tp_axis=None,
+           compute_dtype=None):
     from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
     from flexflow_tpu.models.transformer import TransformerConfig, build_transformer
 
     cfg = TransformerConfig(hidden_size=hidden, num_heads=heads,
                             num_layers=num_layers, sequence_length=seq)
-    ff = FFModel(FFConfig(batch_size=batch_size, seed=0))
+    ff = FFModel(FFConfig(batch_size=batch_size, seed=0,
+                          compute_dtype=compute_dtype))
     build_transformer(ff, batch_size, cfg, tp_axis=tp_axis)
     ff.compile(
         optimizer=SGDOptimizer(lr=0.01),
@@ -145,10 +147,18 @@ def _measure(force_cpu: bool) -> dict:
         layers, seq, hidden, heads, per_dev_batch, iters = 12, 512, 1024, 16, 8, 30
     batch = per_dev_batch * max(1, n_dev)
 
+    # bf16 compute is the TPU-native headline (the MXU's matmul input type);
+    # FLEXFLOW_BENCH_DTYPE=float32 forces full precision for comparison
+    compute_dtype = os.environ.get(
+        "FLEXFLOW_BENCH_DTYPE", "float32" if on_cpu else "bfloat16")
+    if compute_dtype in ("float32", "fp32", "f32"):
+        compute_dtype = None
+
     _progress(f"building model: layers={layers} seq={seq} hidden={hidden} "
-              f"heads={heads} batch={batch}")
+              f"heads={heads} batch={batch} compute={compute_dtype or 'float32'}")
     t_build = time.perf_counter()
-    ff, cfg = _build(batch, num_layers=layers, seq=seq, hidden=hidden, heads=heads)
+    ff, cfg = _build(batch, num_layers=layers, seq=seq, hidden=hidden,
+                     heads=heads, compute_dtype=compute_dtype)
     _progress(f"model built in {time.perf_counter() - t_build:.1f}s; "
               f"timing ({iters} iters)...")
     step_s = _time_steps(ff, cfg, batch, iters=iters)
@@ -173,9 +183,24 @@ def _measure(force_cpu: bool) -> dict:
             "config": f"seq{seq}_hidden{hidden}_heads{heads}_layers{layers}",
             "fwd_flops_per_step": fwd_flops,
             "mfu": round(mfu, 4),
-            "dtype": "float32",
+            "dtype": compute_dtype or "float32",
         },
     }
+
+    # ---- fp32 comparison point (the reference's precision) ----------------
+    if compute_dtype is not None:
+        try:
+            _progress("re-building in float32 for comparison...")
+            ff32, _ = _build(batch, num_layers=layers, seq=seq, hidden=hidden,
+                             heads=heads)
+            step32 = _time_steps(ff32, cfg, batch, iters=iters)
+            result["detail"]["step_time_ms_fp32"] = round(step32 * 1e3, 2)
+            result["detail"]["bf16_speedup"] = round(step32 / step_s, 3)
+            _progress(f"fp32 step={step32 * 1e3:.2f} ms "
+                      f"(bf16 speedup {step32 / step_s:.2f}x)")
+            del ff32
+        except Exception as e:
+            result["detail"]["fp32_compare_error"] = str(e)[:300]
 
     # ---- Pallas kernels off: quantify the custom-kernel delta -------------
     # Only meaningful where the kernels actually engage (use_pallas gates on
@@ -190,7 +215,8 @@ def _measure(force_cpu: bool) -> dict:
             _progress("re-building with Pallas kernels off...")
             os.environ["FLEXFLOW_TPU_PALLAS"] = "off"
             ff_off, _ = _build(batch, num_layers=layers, seq=seq,
-                               hidden=hidden, heads=heads)
+                               hidden=hidden, heads=heads,
+                               compute_dtype=compute_dtype)
             step_off = _time_steps(ff_off, cfg, batch, iters=iters)
             result["detail"]["step_time_ms_no_pallas"] = round(step_off * 1e3, 2)
             result["detail"]["pallas_speedup"] = round(step_off / step_s, 3)
@@ -208,7 +234,8 @@ def _measure(force_cpu: bool) -> dict:
             _progress("timing pure data-parallel baseline...")
             mesh_dp = make_mesh({"data": n_dev})
             ff_dp, _ = _build(batch, num_layers=layers, seq=seq, hidden=hidden,
-                              heads=heads, mesh=mesh_dp)
+                              heads=heads, mesh=mesh_dp,
+                              compute_dtype=compute_dtype)
             step_dp = _time_steps(ff_dp, cfg, batch, iters=iters)
             result["vs_baseline"] = round(step_dp / step_s, 3)
             result["detail"]["dp_step_time_ms"] = round(step_dp * 1e3, 2)

@@ -80,6 +80,12 @@ class FFConfig:
     print_freq: int = 10
     # numerics
     computation_mode: CompMode = CompMode.TRAINING
+    # mixed precision: "bfloat16" runs activations/matmuls in bf16 on the
+    # MXU while master weights, the optimizer state, loss, and BatchNorm
+    # statistics stay float32 (the reference is fp32-only — model.cc has no
+    # dtype flag; bf16 compute is the TPU-native upgrade, the MXU's native
+    # matmul input type). None/"float32" = full precision.
+    compute_dtype: Optional[str] = None
     seed: int = 0
     # mesh description: axis names and sizes; None => 1-D data mesh over all
     # visible devices (reference analog: register_all_machine_views'
@@ -162,6 +168,8 @@ class FFConfig:
                 cfg.workers_per_node = int(_next())
             elif a == "--seed":
                 cfg.seed = int(_next())
+            elif a == "--compute-dtype":
+                cfg.compute_dtype = _next()
             # unknown flags are ignored, matching the reference's tolerance
             i += 1
         return cfg

@@ -179,6 +179,27 @@ def init_params(
     return params, shardings, wd_mask
 
 
+# mixed precision: ops whose weights must stay full-precision in the
+# forward pass — normalization statistics accumulate badly in bf16 (the
+# Keras mixed_bfloat16 policy makes the same exception for BatchNorm)
+_FULL_PRECISION_PARAM_OPS = frozenset({OpType.BATCHNORM})
+
+
+def _resolve_compute_dtype(name: Optional[str]):
+    if name in (None, "float32", "fp32", "f32"):
+        return None
+    if name in ("bfloat16", "bf16"):
+        return jnp.bfloat16
+    if name in ("float16", "fp16", "f16"):
+        # fp16's narrow exponent range needs loss scaling, which this path
+        # does not implement (bf16 shares fp32's exponent range and needs
+        # none); reject rather than silently fail to converge
+        raise ValueError(
+            "compute_dtype float16 is unsupported (no loss scaling); "
+            "use bfloat16 — the TPU-native mixed-precision dtype")
+    raise ValueError(f"unknown compute_dtype {name!r}")
+
+
 def _forward_graph(
     ops: List[Op],
     mesh: Mesh,
@@ -187,20 +208,40 @@ def _forward_graph(
     training: bool,
     rng: Optional[jax.Array],
     seq_length: int = -1,
+    compute_dtype=None,
 ):
     """Run the op graph; returns (acts dict, aux_losses, state_updates).
 
     Sharding constraints on op outputs realize the PCG's parallel-op
     transitions (SURVEY.md §7: Partition/Combine/Replicate/Reduction map to
-    resharding)."""
+    resharding).
+
+    ``compute_dtype`` (e.g. bf16): activations and op weights are cast on
+    entry to each op and outputs cast back to the compute dtype, while the
+    ``params`` argument itself (the fp32 master copy) is untouched —
+    ``jax.grad`` through the casts yields fp32 gradients against the
+    masters (loss-scale-free bf16 mixed precision, the TPU-native recipe)."""
     ctx = LowerCtx(mesh=mesh, training=training, seq_length=seq_length,
-                   aux_losses=[], state_updates={} if training else None)
-    acts: Dict[int, jnp.ndarray] = dict(inputs)
+                   aux_losses=[], state_updates={} if training else None,
+                   compute_dtype=compute_dtype)
+
+    def cast(x):
+        if compute_dtype is None:
+            return x
+        if jnp.issubdtype(jnp.result_type(x), jnp.floating):
+            return x.astype(compute_dtype)
+        return x
+
+    acts: Dict[int, jnp.ndarray] = {k: cast(v) for k, v in inputs.items()}
     for oi, op in enumerate(ops):
         ins = [acts[t.tensor_id] for t in op.layer.inputs]
         ctx.rng = jax.random.fold_in(rng, oi) if rng is not None else None
-        outs = op.forward(ctx, ins, params.get(op.name, {}))
+        p = params.get(op.name, {})
+        if compute_dtype is not None and op.op_type not in _FULL_PRECISION_PARAM_OPS:
+            p = {k: cast(v) for k, v in p.items()}
+        outs = op.forward(ctx, ins, p)
         for out, t, ps in zip(outs, op.layer.outputs, op.output_shapes):
+            out = cast(out)
             if mesh is not None and (
                 any(d.is_partitioned for d in ps.dims)
                 or getattr(op, "force_constraint", False)
@@ -295,6 +336,12 @@ def compile_model(
         _logits_op = _producer.get(_tid)
     from_logits = _logits_op is None or _logits_op.op_type is not OpType.SOFTMAX
 
+    cdt = _resolve_compute_dtype(config.compute_dtype)
+
+    def _f32(x):
+        # loss/metrics always in float32, whatever the compute dtype
+        return x.astype(jnp.float32) if cdt is not None else x
+
     # ---- train step --------------------------------------------------------
     # ``seq_length`` is a leading STATIC argument on every step function:
     # each distinct value compiles its own executable (bucketed compile) —
@@ -310,12 +357,12 @@ def compile_model(
         def loss_fn(params):
             acts, aux, updates = _forward_graph(
                 ops, mesh, params, dict(zip(input_ids, xs)), True, rng,
-                seq_length,
+                seq_length, cdt,
             )
-            logits = acts[logits_id]
+            logits = _f32(acts[logits_id])
             loss = compute_loss(loss_type, logits, y, from_logits)
             for a in aux:
-                loss = loss + a
+                loss = loss + _f32(a)
             return loss, (logits, updates)
 
         (loss, (logits, updates)), grads = jax.value_and_grad(
@@ -327,7 +374,8 @@ def compile_model(
         # the running averages in the same pass (batch_norm.cu)
         for (opn, wn), v in updates.items():
             new_params[opn] = {**new_params[opn],
-                               wn: jax.lax.stop_gradient(v)}
+                               wn: jax.lax.stop_gradient(v).astype(
+                                   new_params[opn][wn].dtype)}
         return new_params, new_opt_state, loss, batch_metrics
 
     # ---- standalone grad step (for the manual backward() verb) ------------
@@ -338,11 +386,11 @@ def compile_model(
         def loss_fn(params):
             acts, aux, _updates = _forward_graph(
                 ops, mesh, params, dict(zip(input_ids, xs)), True, rng,
-                seq_length,
+                seq_length, cdt,
             )
-            loss = compute_loss(loss_type, acts[logits_id], y, from_logits)
+            loss = compute_loss(loss_type, _f32(acts[logits_id]), y, from_logits)
             for a in aux:
-                loss = loss + a
+                loss = loss + _f32(a)
             return loss
 
         return jax.grad(loss_fn)(params)
@@ -352,15 +400,15 @@ def compile_model(
         xs = batch[:n_inputs]
         y = batch[n_inputs]
         acts, _, _ = _forward_graph(ops, mesh, params, dict(zip(input_ids, xs)),
-                                    False, None, seq_length)
-        logits = acts[logits_id]
+                                    False, None, seq_length, cdt)
+        logits = _f32(acts[logits_id])
         loss = compute_loss(loss_type, logits, y, from_logits) if loss_type else jnp.zeros(())
         return loss, logits, compute_batch_metrics(metrics, loss_type, logits, y, from_logits)
 
     def forward_fn(params, *xs, seq_length: int = -1):
         acts, _, _ = _forward_graph(ops, mesh, params, dict(zip(input_ids, xs)),
-                                    False, None, seq_length)
-        return acts[logits_id]
+                                    False, None, seq_length, cdt)
+        return _f32(acts[logits_id])
 
     def _wrap(jitted):
         """seq_length keyword -> leading static positional."""

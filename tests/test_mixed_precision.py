@@ -1,0 +1,101 @@
+"""Mixed-precision (bf16 compute / fp32 master) training.
+
+The reference is fp32-only; bf16 compute is the TPU-native upgrade
+(FFConfig.compute_dtype). These tests pin the contract: master weights,
+optimizer state, loss, and BatchNorm running statistics stay float32 while
+the forward/backward math runs in bfloat16 — and training still converges.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from flexflow_tpu import (
+    ActiMode,
+    DataType,
+    FFConfig,
+    FFModel,
+    LossType,
+    MetricsType,
+    SGDOptimizer,
+)
+
+from test_e2e_mlp import _toy_classification, build_mlp
+
+
+def test_bf16_mlp_converges_and_masters_stay_fp32():
+    config = FFConfig(batch_size=64, epochs=20, seed=0,
+                      compute_dtype="bfloat16")
+    ff = build_mlp(config)
+    ff.compile(
+        optimizer=SGDOptimizer(lr=0.1, momentum=0.9),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.ACCURACY],
+    )
+    x, y = _toy_classification()
+    history = ff.fit(x, y, verbose=False)
+    assert history[-1].accuracy > 0.9, history[-1].accuracy
+    # masters and optimizer state remain fp32
+    for leaf in jax.tree_util.tree_leaves(ff.compiled.params):
+        assert leaf.dtype == jnp.float32
+    for leaf in jax.tree_util.tree_leaves(ff.compiled.opt_state):
+        assert leaf.dtype == jnp.float32
+
+
+def test_bf16_forward_matches_fp32_coarsely():
+    """bf16 forward tracks the fp32 forward within bf16 tolerance."""
+    x = np.random.default_rng(0).normal(size=(8, 16)).astype(np.float32)
+
+    outs = {}
+    ref_params = None
+    for dt in (None, "bfloat16"):
+        config = FFConfig(batch_size=8, seed=0, compute_dtype=dt)
+        ff = build_mlp(config)
+        ff.compile(optimizer=SGDOptimizer(lr=0.1),
+                   loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                   metrics=[])
+        cm = ff.compiled
+        if ref_params is None:
+            ref_params = cm.params
+        else:
+            # layer-name counters are global, so the second build draws a
+            # different init stream — transplant the first model's weights
+            # (op order is identical) for an apples-to-apples forward
+            cm.params = {n2: dict(zip(w2, ref_params[n1].values()))
+                         for (n1, _), (n2, w2) in
+                         zip(ref_params.items(), cm.params.items())}
+        outs[dt] = np.asarray(cm.forward_fn(cm.params, x))
+    assert outs["bfloat16"].dtype == np.float32  # logits come back fp32
+    # bf16's 8-bit mantissa gives ~0.4% per-element rounding that softmax
+    # amplifies; the meaningful invariant is that predictions agree and the
+    # distributions are close in the mean
+    assert (outs[None].argmax(-1) == outs["bfloat16"].argmax(-1)).mean() >= 0.85
+    assert np.abs(outs[None] - outs["bfloat16"]).mean() < 0.05
+
+
+def test_bf16_batchnorm_stats_stay_fp32():
+    """BatchNorm is a full-precision island: running stats are fp32 and
+    still update under bf16 compute."""
+    config = FFConfig(batch_size=8, epochs=1, seed=0,
+                      compute_dtype="bfloat16")
+    ff = FFModel(config)
+    x = ff.create_tensor((8, 3, 8, 8), DataType.FLOAT, name="x")
+    t = ff.conv2d(x, 4, 3, 3, 1, 1, 1, 1)
+    t = ff.batch_norm(t)
+    t = ff.flat(t)
+    t = ff.dense(t, 2)
+    t = ff.softmax(t)
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=[])
+    cm = ff.compiled
+    bn_name = next(n for n in cm.params if "batch_norm" in n)
+    before = np.asarray(cm.params[bn_name]["running_mean"])
+    xs = np.random.default_rng(0).normal(size=(8, 3, 8, 8)).astype(np.float32)
+    ys = np.zeros((8, 1), dtype=np.int32)
+    ff.fit(xs, ys, verbose=False)
+    after = cm.params[bn_name]["running_mean"]
+    assert after.dtype == jnp.float32
+    assert not np.allclose(before, np.asarray(after))
