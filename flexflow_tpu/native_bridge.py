@@ -34,6 +34,22 @@ _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
 
+def _stale() -> bool:
+    """True when the .so is missing or older than any native source."""
+    if not os.path.exists(_LIB_PATH):
+        return True
+    lib_mtime = os.path.getmtime(_LIB_PATH)
+    native = os.path.join(_REPO, "native")
+    for sub in ("src", "include"):
+        d = os.path.join(native, sub)
+        if not os.path.isdir(d):
+            continue
+        for fn in os.listdir(d):
+            if os.path.getmtime(os.path.join(d, fn)) > lib_mtime:
+                return True
+    return False
+
+
 def _build() -> bool:
     makefile_dir = os.path.join(_REPO, "native")
     if not os.path.isdir(makefile_dir):
@@ -54,7 +70,9 @@ def _load() -> Optional[ctypes.CDLL]:
         _tried = True
         if os.environ.get("FLEXFLOW_TPU_NATIVE", "auto") == "off":
             return None
-        if not os.path.exists(_LIB_PATH) and not _build():
+        # rebuild only when a native source is newer than the .so
+        # (stale-symbol safety without forking make in every process)
+        if _stale() and not _build() and not os.path.exists(_LIB_PATH):
             return None
         try:
             lib = ctypes.CDLL(_LIB_PATH)
@@ -76,6 +94,12 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.fftpu_transitive_reduction.argtypes = [
             ctypes.c_int32, ctypes.c_int32, i32p, i32p,
             ctypes.POINTER(ctypes.c_uint8)]
+        if hasattr(lib, "fftpu_route_transfers"):  # absent in a stale .so
+            lib.fftpu_route_transfers.restype = ctypes.c_double
+            lib.fftpu_route_transfers.argtypes = [
+                ctypes.c_int32, i32p, ctypes.POINTER(ctypes.c_uint8),
+                ctypes.c_int32, i32p, i32p, f64p,
+                ctypes.c_double, ctypes.c_double, f64p, i32p]
         lib.fftpu_loader_create.restype = ctypes.c_void_p
         lib.fftpu_loader_create.argtypes = [
             ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
@@ -90,6 +114,17 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.fftpu_loader_next.restype = ctypes.c_int64
         lib.fftpu_loader_next.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p)]
+        if hasattr(lib, "fftpu_batcher_create"):  # absent in a stale .so
+            i64p = ctypes.POINTER(ctypes.c_int64)
+            lib.fftpu_batcher_create.restype = ctypes.c_void_p
+            lib.fftpu_batcher_create.argtypes = [ctypes.c_int32, ctypes.c_int64]
+            lib.fftpu_batcher_destroy.argtypes = [ctypes.c_void_p]
+            lib.fftpu_batcher_submit.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+            lib.fftpu_batcher_close.argtypes = [ctypes.c_void_p]
+            lib.fftpu_batcher_pending.restype = ctypes.c_int64
+            lib.fftpu_batcher_pending.argtypes = [ctypes.c_void_p]
+            lib.fftpu_batcher_next.restype = ctypes.c_int64
+            lib.fftpu_batcher_next.argtypes = [ctypes.c_void_p, i64p]
         _lib = lib
         return _lib
 
@@ -126,6 +161,35 @@ def sim_taskgraph(durations: Sequence[float], devices: Sequence[int],
     if res < 0:
         raise ValueError("task graph has a cycle or invalid edges")
     return (res, starts) if want_starts else res
+
+
+def route_transfers(dims: Sequence[int], wrap: Sequence[bool],
+                    src: Sequence[int], dst: Sequence[int],
+                    bytes_: Sequence[float], link_bandwidth: float,
+                    hop_latency: float) -> Tuple[float, float, int]:
+    """Torus routing + contention (native). Returns
+    (completion_seconds, max_link_bytes, max_hops).
+
+    reference: the routing/congestion estimation of NetworkedMachineModel
+    (simulator.h:421-606, network.cc)."""
+    lib = _load()
+    assert lib is not None
+    d = _i32(dims)
+    w = np.ascontiguousarray([1 if x else 0 for x in wrap], dtype=np.uint8)
+    s = _i32(src)
+    t = _i32(dst)
+    b = np.ascontiguousarray(bytes_, dtype=np.float64)
+    max_link = ctypes.c_double(0.0)
+    max_hops = ctypes.c_int32(0)
+    res = lib.fftpu_route_transfers(
+        len(d), _as_i32p(d), w.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        len(s), _as_i32p(s), _as_i32p(t),
+        b.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        float(link_bandwidth), float(hop_latency),
+        ctypes.byref(max_link), ctypes.byref(max_hops))
+    if res < 0:
+        raise ValueError("invalid torus routing input")
+    return float(res), float(max_link.value), int(max_hops.value)
 
 
 def toposort(n: int, edges: Sequence[Tuple[int, int]]) -> List[int]:
@@ -239,5 +303,52 @@ class NativeLoader:
     def __del__(self):
         try:
             self.close()
+        except Exception:
+            pass
+
+
+class NativeBatcher:
+    """Dynamic micro-batch scheduler (native; reference: the Triton
+    backend's request batching, triton/src/backend.cc). Requests are int64
+    ids; ``next_batch`` blocks until ``max_batch`` ids are pending or the
+    oldest has waited ``timeout_s``."""
+
+    def __init__(self, max_batch: int, timeout_s: float):
+        lib = _load()
+        if lib is None or not hasattr(lib, "fftpu_batcher_create"):
+            raise RuntimeError("native batcher unavailable")
+        self._lib = lib
+        self.max_batch = int(max_batch)
+        self._h = lib.fftpu_batcher_create(self.max_batch,
+                                           int(timeout_s * 1e6))
+        if not self._h:
+            raise RuntimeError("fftpu_batcher_create failed")
+        self._ids = (ctypes.c_int64 * self.max_batch)()
+
+    def submit(self, request_id: int) -> None:
+        self._lib.fftpu_batcher_submit(self._h, int(request_id))
+
+    def pending(self) -> int:
+        return int(self._lib.fftpu_batcher_pending(self._h))
+
+    def next_batch(self) -> Optional[List[int]]:
+        """Blocks; returns ids, or None once closed and drained."""
+        n = self._lib.fftpu_batcher_next(self._h, self._ids)
+        if n < 0:
+            return None
+        return list(self._ids[:n])
+
+    def close(self) -> None:
+        if getattr(self, "_h", None):
+            self._lib.fftpu_batcher_close(self._h)
+
+    def destroy(self) -> None:
+        if getattr(self, "_h", None):
+            self._lib.fftpu_batcher_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.destroy()
         except Exception:
             pass

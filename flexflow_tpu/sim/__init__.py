@@ -18,6 +18,12 @@ from .machine_model import (
     load_machine_model,
 )
 from .cost_model import CostMetrics, OpCostModel, ProfilingCostModel
+from .network import (
+    NetworkedMachineModel,
+    TorusTopology,
+    default_topology_for,
+    route_transfers,
+)
 from .simulator import MemoryUsage, SimTask, Simulator
 
 __all__ = [
@@ -32,6 +38,10 @@ __all__ = [
     "CostMetrics",
     "OpCostModel",
     "ProfilingCostModel",
+    "NetworkedMachineModel",
+    "TorusTopology",
+    "default_topology_for",
+    "route_transfers",
     "MemoryUsage",
     "SimTask",
     "Simulator",

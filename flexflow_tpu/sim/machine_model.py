@@ -236,13 +236,16 @@ def load_machine_model(path: str) -> MachineModel:
     Schema::
 
         {
-          "version": "simple" | "torus" | "multislice",
+          "version": "simple" | "torus" | "multislice" | "networked",
           "chip": "v5e" | {"name": ..., "peak_bf16_flops": ..., ...},
           "num_devices": 8,                  # simple only
-          "axis_degrees": {"data": 4, "model": 2},   # torus/multislice
+          "axis_degrees": {"data": 4, "model": 2},   # torus/multislice/networked
           "axis_links": {"data": 2},         # optional, torus/multislice
           "wraparound": true,                # optional
-          "dcn_axes": ["data_dcn"]           # multislice only
+          "dcn_axes": ["data_dcn"],          # multislice/networked
+          "topology": [4, 2],                # networked: torus chip grid
+          "topology_wrap": [true, true],     # optional
+          "device_order": [0, 1, ...]        # optional mesh->chip permutation
         }
     """
     import json
@@ -267,6 +270,25 @@ def load_machine_model(path: str) -> MachineModel:
             dcn_axes=tuple(cfg.get("dcn_axes", ["data_dcn"])),
             axis_links=cfg.get("axis_links"),
             wraparound=bool(cfg.get("wraparound", True)))
+    if version == "networked":
+        from .network import (NetworkedMachineModel, TorusTopology,
+                              default_topology_for)
+
+        axis_degrees = cfg["axis_degrees"]
+        dcn_axes = tuple(cfg.get("dcn_axes", []))
+        if "topology" in cfg:
+            topo = TorusTopology(
+                tuple(cfg["topology"]),
+                tuple(cfg["topology_wrap"]) if "topology_wrap" in cfg else ())
+        else:
+            n = 1
+            for a, d in axis_degrees.items():
+                if a not in dcn_axes:
+                    n *= d
+            topo = default_topology_for(n)
+        return NetworkedMachineModel(
+            chip, topo, axis_degrees,
+            device_order=cfg.get("device_order"), dcn_axes=dcn_axes)
     raise ValueError(f"unknown machine model version {version!r} in {path}")
 
 

@@ -66,6 +66,23 @@ int32_t fftpu_transitive_reduction(int32_t n_nodes, int32_t n_edges,
                                    const int32_t *edge_dst,
                                    uint8_t *kept);
 
+/* ------------------------------------------------------ network simulation
+ * Route a set of point-to-point transfers over an ndims-dimensional torus
+ * (dims[d] chips per dimension; wrap[d] != 0 => wrap-around ring) using
+ * dimension-ordered routing (shorter way around wrapped rings), accumulate
+ * bytes per directed link, and return the bandwidth-bound completion time:
+ *   max_link_bytes / link_bandwidth + max_hops * hop_latency.
+ * Nodes are row-major linearized coordinates (last dim fastest). Optional
+ * outputs: busiest-link byte count and the longest route's hop count.
+ * Returns -1.0 on invalid input. */
+double fftpu_route_transfers(int32_t ndims, const int32_t *dims,
+                             const uint8_t *wrap,
+                             int32_t n_transfers, const int32_t *src,
+                             const int32_t *dst, const double *bytes,
+                             double link_bandwidth, double hop_latency,
+                             double *max_link_bytes_out,
+                             int32_t *max_hops_out);
+
 /* ---------------------------------------------------------------- dataloader
  * A loader owns references to one or more host datasets (row-major, row
  * stride in bytes) and serves shuffled batches by gathering rows into
@@ -99,6 +116,23 @@ void fftpu_loader_reset_with_perm(fftpu_loader *, const int64_t *perm);
  * Blocks until the prefetched batch is ready. Returns the batch index, or
  * -1 at epoch end. */
 int64_t fftpu_loader_next(fftpu_loader *, void *const *outs);
+
+/* ------------------------------------------------------- inference batcher
+ * Dynamic micro-batch scheduler for the serving engine (reference: the
+ * Triton backend's request batching, triton/src/backend.cc). Requests are
+ * opaque int64 ids; payloads stay with the caller. fftpu_batcher_next
+ * blocks until max_batch requests are pending OR the oldest has waited
+ * timeout_us, then drains up to max_batch ids; returns the count, or -1
+ * after close() drains the queue. */
+
+typedef struct fftpu_batcher fftpu_batcher;
+
+fftpu_batcher *fftpu_batcher_create(int32_t max_batch, int64_t timeout_us);
+void fftpu_batcher_destroy(fftpu_batcher *);
+void fftpu_batcher_submit(fftpu_batcher *, int64_t id);
+void fftpu_batcher_close(fftpu_batcher *);
+int64_t fftpu_batcher_pending(fftpu_batcher *);
+int64_t fftpu_batcher_next(fftpu_batcher *, int64_t *out_ids);
 
 #ifdef __cplusplus
 } /* extern "C" */

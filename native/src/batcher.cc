@@ -1,0 +1,96 @@
+// Dynamic micro-batch scheduler for the inference serving engine.
+//
+// Native core behind flexflow_tpu.serving.InferenceEngine (reference: the
+// Triton backend prototype's request batching/instance scheduling,
+// /root/reference/triton/src/backend.cc, instance.cc — Legion-based
+// multi-node inference). The TPU re-design keeps payloads in Python (numpy
+// views) and moves the latency-critical queue discipline native: requests
+// are opaque int64 ids; a worker blocks until either `max_batch` requests
+// are pending or the oldest pending request has waited `timeout_us`.
+
+#include "flexflow_tpu_c.h"
+
+#include <chrono>
+#include <condition_variable>
+#include <deque>
+#include <mutex>
+
+namespace {
+
+using clock_t_ = std::chrono::steady_clock;
+
+struct Pending {
+  int64_t id;
+  clock_t_::time_point enqueued;
+};
+
+}  // namespace
+
+struct fftpu_batcher {
+  std::mutex mu;
+  std::condition_variable cv;
+  std::deque<Pending> q;
+  int32_t max_batch;
+  int64_t timeout_us;
+  bool closed = false;
+};
+
+extern "C" fftpu_batcher *fftpu_batcher_create(int32_t max_batch,
+                                               int64_t timeout_us) {
+  if (max_batch <= 0) return nullptr;
+  auto *b = new fftpu_batcher();
+  b->max_batch = max_batch;
+  b->timeout_us = timeout_us < 0 ? 0 : timeout_us;
+  return b;
+}
+
+extern "C" void fftpu_batcher_destroy(fftpu_batcher *b) { delete b; }
+
+extern "C" void fftpu_batcher_submit(fftpu_batcher *b, int64_t id) {
+  {
+    std::lock_guard<std::mutex> lk(b->mu);
+    b->q.push_back({id, clock_t_::now()});
+  }
+  b->cv.notify_all();
+}
+
+extern "C" void fftpu_batcher_close(fftpu_batcher *b) {
+  {
+    std::lock_guard<std::mutex> lk(b->mu);
+    b->closed = true;
+  }
+  b->cv.notify_all();
+}
+
+extern "C" int64_t fftpu_batcher_pending(fftpu_batcher *b) {
+  std::lock_guard<std::mutex> lk(b->mu);
+  return static_cast<int64_t>(b->q.size());
+}
+
+// Blocks until a batch is ready: max_batch pending, or the oldest pending
+// request aged past timeout_us, or close() with requests draining, or
+// close() on an empty queue (returns -1 = shut down). Fills out_ids (cap
+// max_batch) and returns the count.
+extern "C" int64_t fftpu_batcher_next(fftpu_batcher *b, int64_t *out_ids) {
+  std::unique_lock<std::mutex> lk(b->mu);
+  for (;;) {
+    if (!b->q.empty()) {
+      auto now = clock_t_::now();
+      bool full = static_cast<int32_t>(b->q.size()) >= b->max_batch;
+      auto deadline = b->q.front().enqueued +
+                      std::chrono::microseconds(b->timeout_us);
+      if (full || b->closed || now >= deadline) {
+        int64_t n = 0;
+        while (!b->q.empty() && n < b->max_batch) {
+          out_ids[n++] = b->q.front().id;
+          b->q.pop_front();
+        }
+        return n;
+      }
+      b->cv.wait_until(lk, deadline);
+    } else {
+      if (b->closed) return -1;
+      b->cv.wait(lk);
+    }
+  }
+}

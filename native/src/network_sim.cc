@@ -1,0 +1,92 @@
+// Torus network routing + link-contention simulation.
+//
+// Native core behind flexflow_tpu.sim.network.NetworkedMachineModel
+// (reference: NetworkedMachineModel + routing/congestion simulation,
+// include/flexflow/simulator.h:421-606, src/runtime/network.cc — topology
+// matrices, routing strategies, per-link congestion). The TPU re-design
+// routes transfers over an N-dimensional torus (the ICI fabric's real
+// shape) with dimension-ordered routing, accumulates bytes per directed
+// link, and reports the bandwidth-bound completion time of the transfer
+// set. The search calls this per candidate strategy, so the inner loop is
+// native.
+
+#include "flexflow_tpu_c.h"
+
+#include <algorithm>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+// directed link id: ((node * ndims) + dim) * 2 + (positive ? 0 : 1)
+inline int64_t link_id(int64_t node, int32_t dim, int32_t positive,
+                       int32_t ndims) {
+  return (node * ndims + dim) * 2 + (positive ? 0 : 1);
+}
+
+}  // namespace
+
+extern "C" double fftpu_route_transfers(
+    int32_t ndims, const int32_t *dims, const uint8_t *wrap,
+    int32_t n_transfers, const int32_t *src, const int32_t *dst,
+    const double *bytes, double link_bandwidth, double hop_latency,
+    double *max_link_bytes_out, int32_t *max_hops_out) {
+  if (ndims <= 0 || n_transfers < 0 || link_bandwidth <= 0.0) return -1.0;
+  int64_t n_nodes = 1;
+  for (int32_t d = 0; d < ndims; ++d) {
+    if (dims[d] <= 0) return -1.0;
+    n_nodes *= dims[d];
+  }
+  // row-major strides: last dim fastest (matches jax mesh device order)
+  std::vector<int64_t> stride(ndims, 1);
+  for (int32_t d = ndims - 2; d >= 0; --d) stride[d] = stride[d + 1] * dims[d + 1];
+
+  std::vector<double> link_bytes(static_cast<size_t>(n_nodes) * ndims * 2, 0.0);
+  int32_t max_hops = 0;
+
+  std::vector<int32_t> coord(ndims);
+  for (int32_t t = 0; t < n_transfers; ++t) {
+    int64_t s = src[t], e = dst[t];
+    if (s < 0 || s >= n_nodes || e < 0 || e >= n_nodes) return -1.0;
+    if (s == e || bytes[t] <= 0.0) continue;
+    // unpack source coordinate
+    int64_t rem = s;
+    for (int32_t d = 0; d < ndims; ++d) {
+      coord[d] = static_cast<int32_t>(rem / stride[d]);
+      rem %= stride[d];
+    }
+    int32_t hops = 0;
+    // dimension-ordered routing; on a wrapped ring take the shorter way
+    for (int32_t d = 0; d < ndims; ++d) {
+      int32_t want = static_cast<int32_t>((e / stride[d]) % dims[d]);
+      int32_t have = coord[d];
+      if (want == have) continue;
+      int32_t n = dims[d];
+      int32_t fwd = (want - have + n) % n;   // steps in + direction
+      int32_t bwd = (have - want + n) % n;   // steps in - direction
+      bool use_fwd;
+      if (wrap && wrap[d])
+        use_fwd = fwd <= bwd;                // shorter way (ties: +)
+      else
+        use_fwd = want > have;               // open mesh: only one way
+      int32_t steps = (wrap && wrap[d]) ? std::min(fwd, bwd)
+                                        : (use_fwd ? fwd : bwd);
+      for (int32_t k = 0; k < steps; ++k) {
+        int64_t node = 0;
+        for (int32_t dd = 0; dd < ndims; ++dd) node += int64_t(coord[dd]) * stride[dd];
+        link_bytes[link_id(node, d, use_fwd ? 1 : 0, ndims)] += bytes[t];
+        coord[d] = use_fwd ? (coord[d] + 1) % n : (coord[d] - 1 + n) % n;
+        ++hops;
+      }
+    }
+    max_hops = std::max(max_hops, hops);
+  }
+
+  double max_link = 0.0;
+  for (double b : link_bytes) max_link = std::max(max_link, b);
+  if (max_link_bytes_out) *max_link_bytes_out = max_link;
+  if (max_hops_out) *max_hops_out = max_hops;
+  // transfers stream concurrently; the busiest link bounds completion, plus
+  // the pipeline-fill latency of the longest route
+  return max_link / link_bandwidth + max_hops * hop_latency;
+}
