@@ -47,6 +47,7 @@ from .ops import (  # noqa: F401
     moe_ops,
     norm,
     parallel_ops,
+    recurrent,
     reduce,
     softmax,
     structural,

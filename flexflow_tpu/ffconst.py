@@ -167,6 +167,12 @@ class OpType(enum.Enum):
     CAST = "cast"
     TOPK = "topk"
     MULTIHEAD_ATTENTION = "multihead_attention"
+    # recurrent ops (reference: the legacy NMT engine's LSTM/RNN cells,
+    # /root/reference/nmt/{rnn.h,lstm.cu} — predating FFModel; first-class
+    # ops here)
+    LSTM = "lstm"
+    RNN = "rnn"
+    GRU = "gru"
     FUSED = "fused"
     # parallel ops (reference: src/parallel_ops)
     REPARTITION = "repartition"

@@ -11,3 +11,4 @@ from .dlrm import build_dlrm, DLRMConfig
 from .moe import build_moe_mnist, MoeConfig
 from .xdl import build_xdl, XDLConfig
 from .candle_uno import build_candle_uno, CandleUnoConfig
+from .nmt import build_nmt, NMTConfig

@@ -1,0 +1,184 @@
+"""Recurrent operators: LSTM / GRU / vanilla RNN.
+
+TPU-native re-design of the reference's legacy NMT engine cells
+(reference: /root/reference/nmt/rnn.h, nmt/lstm.cu — hand-written cuDNN
+LSTM kernels with their own mapper, predating FFModel; SURVEY.md §2.8 aux
+products). Here recurrence is a first-class op in the main framework:
+
+* the input projection for ALL timesteps is one big MXU matmul
+  (``x @ Wx``: (B·S, D) × (D, gates·H)) hoisted out of the recurrence;
+* the sequential part is a ``lax.scan`` over timesteps carrying (h, c) —
+  compiler-friendly control flow, one compiled step body;
+* gate order and weight layout follow torch's nn.LSTM/nn.GRU convention
+  (i,f,g,o / r,z,n) so the torch frontend imports weights verbatim.
+
+Sharding: the batch dim rides the data axis like any other op; hidden and
+gate dims stay replicated (recurrent TP needs per-step collectives —
+a poor trade on ICI; sequence parallelism does not apply to a serial
+recurrence).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ffconst import ActiMode, DataType, OpType
+from ..core.op import LowerCtx, Op, WeightSpec, register_op
+from ..core.parallel_tensor import ParallelDim, ParallelTensorShape
+from ..runtime.initializer import DefaultBiasInitializer, DefaultWeightInitializer
+
+
+class _RecurrentBase(Op):
+    """Shared shape/weight logic. attrs: hidden_size, return_sequences,
+    return_state; inputs [x] or [x, h0(, c0)]."""
+
+    num_gates = 1
+    has_cell_state = False
+
+    def __init__(self, layer, input_shapes):
+        super().__init__(layer, input_shapes)
+        self.hidden: int = layer.attrs["hidden_size"]
+        self.return_sequences: bool = layer.attrs.get("return_sequences", True)
+        self.return_state: bool = layer.attrs.get("return_state", False)
+        self.in_dim: int = input_shapes[0].sizes[-1]
+        self.seq: int = input_shapes[0].sizes[1]
+        self.batch: int = input_shapes[0].sizes[0]
+
+    def infer_output_shapes(self):
+        dt = self.input_shapes[0].dtype
+        outs = []
+        if self.return_sequences:
+            outs.append(((self.batch, self.seq, self.hidden), dt))
+        else:
+            outs.append(((self.batch, self.hidden), dt))
+        if self.return_state:
+            outs.append(((self.batch, self.hidden), dt))
+            if self.has_cell_state:
+                outs.append(((self.batch, self.hidden), dt))
+        return outs
+
+    def weight_specs(self) -> List[WeightSpec]:
+        g = self.num_gates
+        dt = self.input_shapes[0].dtype
+        mk = lambda n, s, init, wd: WeightSpec(n, s, dt, init, weight_decay=wd)
+        return [
+            mk("kernel", (self.in_dim, g * self.hidden),
+               self.attrs.get("kernel_initializer") or DefaultWeightInitializer(), True),
+            mk("recurrent_kernel", (self.hidden, g * self.hidden),
+               self.attrs.get("recurrent_initializer") or DefaultWeightInitializer(), True),
+            mk("bias", (g * self.hidden,), DefaultBiasInitializer(), False),
+            mk("recurrent_bias", (g * self.hidden,), DefaultBiasInitializer(), False),
+        ]
+
+    def _initial_state(self, inputs, dtype):
+        b = inputs[0].shape[0]
+        if len(inputs) >= 2:
+            h0 = inputs[1]
+        else:
+            h0 = jnp.zeros((b, self.hidden), dtype)
+        if not self.has_cell_state:
+            return h0
+        c0 = inputs[2] if len(inputs) >= 3 else jnp.zeros((b, self.hidden), dtype)
+        return (h0, c0)
+
+    def flops(self) -> float:
+        g = self.num_gates
+        return (2.0 * self.batch * self.seq *
+                (self.in_dim + self.hidden) * g * self.hidden)
+
+    def _pack_outputs(self, ys, h, c=None):
+        outs = [ys if self.return_sequences else h]
+        if self.return_state:
+            outs.append(h)
+            if self.has_cell_state:
+                outs.append(c)
+        return outs
+
+
+@register_op
+class LSTM(_RecurrentBase):
+    """reference: nmt/lstm.cu LSTM cell (gate order i,f,g,o = torch)."""
+
+    op_type = OpType.LSTM
+    num_gates = 4
+    has_cell_state = True
+
+    def forward(self, ctx: LowerCtx, inputs: Sequence[jnp.ndarray], weights):
+        x = inputs[0]
+        H = self.hidden
+        # hoisted input projection: one (B*S, D)x(D, 4H) MXU matmul
+        xw = (jnp.einsum("bsd,dg->bsg", x, weights["kernel"])
+              + weights["bias"] + weights["recurrent_bias"])
+        Wh = weights["recurrent_kernel"]
+        h0, c0 = self._initial_state(inputs, x.dtype)
+
+        def step(carry, xt):
+            h, c = carry
+            z = xt + h @ Wh
+            i = jax.nn.sigmoid(z[:, :H])
+            f = jax.nn.sigmoid(z[:, H:2 * H])
+            g = jnp.tanh(z[:, 2 * H:3 * H])
+            o = jax.nn.sigmoid(z[:, 3 * H:])
+            c = f * c + i * g
+            h = o * jnp.tanh(c)
+            return (h, c), h
+
+        (hT, cT), ys = jax.lax.scan(step, (h0, c0), xw.swapaxes(0, 1))
+        return self._pack_outputs(ys.swapaxes(0, 1), hT, cT)
+
+
+@register_op
+class GRU(_RecurrentBase):
+    """GRU with torch's gate layout (r,z,n) and separate recurrent bias
+    (needed to match nn.GRU's ``r * (W_hn h + b_hn)`` exactly)."""
+
+    op_type = OpType.GRU
+    num_gates = 3
+    has_cell_state = False
+
+    def forward(self, ctx: LowerCtx, inputs: Sequence[jnp.ndarray], weights):
+        x = inputs[0]
+        H = self.hidden
+        xw = jnp.einsum("bsd,dg->bsg", x, weights["kernel"]) + weights["bias"]
+        Wh = weights["recurrent_kernel"]
+        bh = weights["recurrent_bias"]
+        h0 = self._initial_state(inputs, x.dtype)
+
+        def step(h, xt):
+            hw = h @ Wh + bh
+            r = jax.nn.sigmoid(xt[:, :H] + hw[:, :H])
+            z = jax.nn.sigmoid(xt[:, H:2 * H] + hw[:, H:2 * H])
+            n = jnp.tanh(xt[:, 2 * H:] + r * hw[:, 2 * H:])
+            h = (1.0 - z) * n + z * h
+            return h, h
+
+        hT, ys = jax.lax.scan(step, h0, xw.swapaxes(0, 1))
+        return self._pack_outputs(ys.swapaxes(0, 1), hT)
+
+
+@register_op
+class RNN(_RecurrentBase):
+    """Vanilla (Elman) RNN: h' = act(x Wx + h Wh + b); act ∈ {tanh, relu}."""
+
+    op_type = OpType.RNN
+    num_gates = 1
+    has_cell_state = False
+
+    def forward(self, ctx: LowerCtx, inputs: Sequence[jnp.ndarray], weights):
+        x = inputs[0]
+        act = self.attrs.get("activation", ActiMode.TANH)
+        fn = jnp.tanh if act is ActiMode.TANH else (lambda v: jnp.maximum(v, 0))
+        xw = (jnp.einsum("bsd,dg->bsg", x, weights["kernel"])
+              + weights["bias"] + weights["recurrent_bias"])
+        Wh = weights["recurrent_kernel"]
+        h0 = self._initial_state(inputs, x.dtype)
+
+        def step(h, xt):
+            h = fn(xt + h @ Wh)
+            return h, h
+
+        hT, ys = jax.lax.scan(step, h0, xw.swapaxes(0, 1))
+        return self._pack_outputs(ys.swapaxes(0, 1), hT)

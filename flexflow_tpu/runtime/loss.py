@@ -34,7 +34,13 @@ def compute_loss(
     gradient destroyed.
     """
     if loss_type is LossType.SPARSE_CATEGORICAL_CROSSENTROPY:
-        labels = labels.reshape(labels.shape[0], -1)[:, 0].astype(jnp.int32)
+        if logits.ndim >= 3:
+            # token-level CE (seq2seq / NMT): logits (B, ..., V) with one
+            # label per position — flatten positions into the batch
+            logits = logits.reshape(-1, logits.shape[-1])
+            labels = labels.reshape(-1).astype(jnp.int32)
+        else:
+            labels = labels.reshape(labels.shape[0], -1)[:, 0].astype(jnp.int32)
         logp = (jax.nn.log_softmax(logits, axis=-1) if from_logits
                 else jnp.log(jnp.clip(logits, 1e-10, 1.0)))
         ll = jnp.take_along_axis(logp, labels[:, None], axis=-1)

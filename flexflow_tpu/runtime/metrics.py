@@ -90,8 +90,13 @@ def compute_batch_metrics(
     src/metrics_functions/metrics_functions.cu). Runs inside jit.
     ``from_logits`` mirrors compute_loss: True when the graph does not end
     in a softmax."""
-    out: Dict[str, jnp.ndarray] = {"count": jnp.asarray(logits.shape[0])}
     sparse = loss_type is LossType.SPARSE_CATEGORICAL_CROSSENTROPY
+    if sparse and logits.ndim >= 3:
+        # token-level metrics (seq2seq/NMT): positions flatten into the
+        # batch, matching compute_loss's rank-3 path (runtime/loss.py)
+        logits = logits.reshape(-1, logits.shape[-1])
+        labels = labels.reshape(-1, 1)
+    out: Dict[str, jnp.ndarray] = {"count": jnp.asarray(logits.shape[0])}
 
     def _logp():
         if from_logits:

@@ -401,6 +401,74 @@ class FFModel:
             OpType.MULTIHEAD_ATTENTION, [query, key, value], attrs, name
         )
 
+    # ---- recurrent family ------------------------------------------------ #
+    def _recurrent(self, op_type, input, initial_state, attrs, name):
+        inputs = [input]
+        if initial_state is not None:
+            states = (initial_state if isinstance(initial_state, (list, tuple))
+                      else [initial_state])
+            inputs.extend(states)
+        out = self._infer_and_add(op_type, inputs, attrs, name)
+        return out
+
+    def lstm(
+        self,
+        input: Tensor,
+        hidden_size: int,
+        return_sequences: bool = True,
+        return_state: bool = False,
+        initial_state=None,
+        kernel_initializer=None,
+        recurrent_initializer=None,
+        name=None,
+    ):
+        """LSTM over (batch, seq, features) (reference: the legacy NMT
+        engine's LSTM, nmt/lstm.cu — here a first-class op lowered to
+        lax.scan; ops/recurrent.py). ``initial_state``: (h0, c0) tensors.
+        Returns the sequence (or last hidden), plus (h, c) when
+        ``return_state``."""
+        attrs = dict(hidden_size=hidden_size,
+                     return_sequences=return_sequences,
+                     return_state=return_state,
+                     kernel_initializer=kernel_initializer,
+                     recurrent_initializer=recurrent_initializer)
+        return self._recurrent(OpType.LSTM, input, initial_state, attrs, name)
+
+    def gru(
+        self,
+        input: Tensor,
+        hidden_size: int,
+        return_sequences: bool = True,
+        return_state: bool = False,
+        initial_state=None,
+        kernel_initializer=None,
+        recurrent_initializer=None,
+        name=None,
+    ):
+        """GRU (torch nn.GRU gate/weight conventions; ops/recurrent.py)."""
+        attrs = dict(hidden_size=hidden_size,
+                     return_sequences=return_sequences,
+                     return_state=return_state,
+                     kernel_initializer=kernel_initializer,
+                     recurrent_initializer=recurrent_initializer)
+        return self._recurrent(OpType.GRU, input, initial_state, attrs, name)
+
+    def rnn(
+        self,
+        input: Tensor,
+        hidden_size: int,
+        activation: ActiMode = ActiMode.TANH,
+        return_sequences: bool = True,
+        return_state: bool = False,
+        initial_state=None,
+        name=None,
+    ):
+        """Vanilla RNN (reference: nmt/rnn.h; ops/recurrent.py)."""
+        attrs = dict(hidden_size=hidden_size, activation=activation,
+                     return_sequences=return_sequences,
+                     return_state=return_state)
+        return self._recurrent(OpType.RNN, input, initial_state, attrs, name)
+
     # ---- MoE family ------------------------------------------------------ #
     def top_k(self, input: Tensor, k: int, sorted: bool = True, name=None) -> List[Tensor]:
         """reference: FFModel::top_k (model.h:537, src/ops/topk.cc)."""
@@ -964,6 +1032,8 @@ class FFModel:
     # ---- manual-loop verbs (reference: model.cc:2415-2495) --------------- #
     def set_batch(self, xs: List[np.ndarray], y: Optional[np.ndarray] = None) -> None:
         cm = self.compiled
+        if not isinstance(xs, (list, tuple)):  # single-input convenience
+            xs = [xs]
         batch = [jax.device_put(np.asarray(a), sh) for a, sh in zip(xs, cm.input_shardings)]
         if y is not None:
             batch.append(jax.device_put(np.asarray(y), cm.label_sharding))
