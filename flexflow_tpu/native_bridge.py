@@ -55,8 +55,20 @@ def _build() -> bool:
     if not os.path.isdir(makefile_dir):
         return False
     try:
-        subprocess.run(["make", "-C", makefile_dir, "-s"], check=True,
-                       capture_output=True, timeout=120)
+        # serialize concurrent builders (pytest-xdist, multi-process
+        # launches): without the lock a sibling can dlopen a half-linked .so
+        import fcntl
+
+        lock_path = os.path.join(makefile_dir, ".build.lock")
+        with open(lock_path, "w") as lock:
+            fcntl.flock(lock, fcntl.LOCK_EX)
+            try:
+                if not _stale():  # a peer finished the build while we waited
+                    return True
+                subprocess.run(["make", "-C", makefile_dir, "-s"], check=True,
+                               capture_output=True, timeout=120)
+            finally:
+                fcntl.flock(lock, fcntl.LOCK_UN)
         return os.path.exists(_LIB_PATH)
     except Exception:
         return False
@@ -174,6 +186,11 @@ def route_transfers(dims: Sequence[int], wrap: Sequence[bool],
     (simulator.h:421-606, network.cc)."""
     lib = _load()
     assert lib is not None
+    if not (len(src) == len(dst) == len(bytes_)):
+        raise ValueError(
+            f"src/dst/bytes length mismatch: {len(src)}/{len(dst)}/{len(bytes_)}")
+    if len(dims) != len(wrap):
+        raise ValueError("dims/wrap length mismatch")
     d = _i32(dims)
     w = np.ascontiguousarray([1 if x else 0 for x in wrap], dtype=np.uint8)
     s = _i32(src)

@@ -1,0 +1,22 @@
+"""Inference serving subsystem.
+
+TPU-native re-design of the reference's Triton inference backend prototype
+(reference: /root/reference/triton/ — an ~18k LoC Legion-based multi-node
+inference server with its own operator set, ONNX parser, instance
+management, and strategy files; triton/src/backend.cc, instance.cc,
+onnx_parser.cc). Here the operator set and the ONNX importer are the
+framework's own (no duplicated op stack — the single biggest structural
+simplification), and the pieces that remain are the serving-specific ones:
+
+* :class:`ModelInstance` — a compiled, sharded, inference-only executable
+  with shape-bucketed batch padding (XLA static shapes ↔ dynamic request
+  counts);
+* :class:`InferenceEngine` — a multi-model registry with per-model dynamic
+  micro-batching (native C++ queue discipline, native/src/batcher.cc) and
+  worker threads;
+* ONNX / FFModel loading through the existing frontends.
+"""
+
+from .engine import InferenceEngine, InferenceRequest, ModelInstance
+
+__all__ = ["InferenceEngine", "InferenceRequest", "ModelInstance"]

@@ -1,0 +1,290 @@
+"""Inference engine: model instances + dynamic micro-batching.
+
+reference: the Triton backend prototype's model lifecycle + request
+scheduling (/root/reference/triton/src/backend.cc — TRITONBACKEND_Model*
+lifecycle hooks; instance.cc — per-instance execution; strategies loaded
+per model). TPU re-design decisions:
+
+* an *instance* is one compiled inference executable over one device mesh
+  (the jit cache plays Triton's model-warmup role; the GSPMD partitioner
+  plays its instance-group placement);
+* *dynamic batching* pads the gathered requests to the instance's compiled
+  batch size — XLA needs static shapes, so the batcher trades a bounded
+  wait (`batch_timeout_s`) for MXU-efficient full batches;
+* the queue discipline is native C++ (native/src/batcher.cc) with a pure
+  Python fallback, mirroring the framework's native-with-fallback pattern.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import threading
+import time
+from concurrent.futures import Future
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+class _PyBatcher:
+    """Pure-Python fallback with NativeBatcher's exact semantics."""
+
+    def __init__(self, max_batch: int, timeout_s: float):
+        self.max_batch = int(max_batch)
+        self._timeout = float(timeout_s)
+        self._q: collections.deque = collections.deque()  # (id, t_enqueued)
+        self._mu = threading.Condition()
+        self._closed = False
+
+    def submit(self, request_id: int) -> None:
+        with self._mu:
+            self._q.append((request_id, time.monotonic()))
+            self._mu.notify_all()
+
+    def pending(self) -> int:
+        with self._mu:
+            return len(self._q)
+
+    def next_batch(self) -> Optional[List[int]]:
+        with self._mu:
+            while True:
+                if self._q:
+                    deadline = self._q[0][1] + self._timeout
+                    now = time.monotonic()
+                    if (len(self._q) >= self.max_batch or self._closed
+                            or now >= deadline):
+                        ids = []
+                        while self._q and len(ids) < self.max_batch:
+                            ids.append(self._q.popleft()[0])
+                        return ids
+                    self._mu.wait(deadline - now)
+                else:
+                    if self._closed:
+                        return None
+                    self._mu.wait()
+
+    def close(self) -> None:
+        with self._mu:
+            self._closed = True
+            self._mu.notify_all()
+
+    def destroy(self) -> None:
+        pass
+
+
+def _make_batcher(max_batch: int, timeout_s: float):
+    from .. import native_bridge
+
+    try:
+        return native_bridge.NativeBatcher(max_batch, timeout_s)
+    except Exception:
+        return _PyBatcher(max_batch, timeout_s)
+
+
+class ModelInstance:
+    """One compiled inference executable (reference: triton/src/instance.cc
+    ModelInstance — per-device execution state for a loaded model).
+
+    Wraps a compiled :class:`flexflow_tpu.FFModel`: requests of any count
+    ≤ the compiled batch size are padded up and run through the jitted
+    forward; rows beyond the request count are discarded.
+    """
+
+    def __init__(self, ff, name: str = "model"):
+        if ff.compiled is None:
+            raise ValueError("compile() the FFModel before serving it")
+        self.name = name
+        self._ff = ff
+        cm = ff.compiled
+        self._cm = cm
+        self.batch_size = cm.input_tensors[0].dims[0]
+        self.n_inputs = len(cm.input_tensors)
+
+    @classmethod
+    def from_onnx(cls, onnx_path: str, config=None, name: str = "model",
+                  mesh=None):
+        """Load + compile an ONNX graph for inference (reference: the
+        Triton backend's own ONNX parser, triton/src/onnx_parser.cc — here
+        the framework's single ONNX frontend serves both paths)."""
+        from ..config import FFConfig
+        from ..ffconst import CompMode
+        from ..onnx_frontend import ONNXModel
+        from ..runtime.model import FFModel
+
+        config = config or FFConfig(computation_mode=CompMode.INFERENCE)
+        ff = FFModel(config)
+        onnx_model = ONNXModel(onnx_path)
+        # bind graph inputs: dynamic/zero batch dims become config.batch_size
+        inputs = []
+        graph = onnx_model.model.graph
+        for gi in graph.input:
+            if gi.name in onnx_model.inits:
+                continue
+            dims = [d.dim_value
+                    for d in gi.type.tensor_type.shape.dim]
+            dims[0] = dims[0] if dims[0] > 0 else config.batch_size
+            inputs.append(ff.create_tensor(tuple(dims), name=gi.name))
+        onnx_model.apply(ff, inputs)
+        ff.compile(optimizer=None, loss_type=None, metrics=[], mesh=mesh)
+        return cls(ff, name=name)
+
+    def infer(self, inputs: Sequence[np.ndarray]) -> List[np.ndarray]:
+        """Run one padded batch. ``inputs``: one array per model input,
+        leading dim = request count ≤ batch_size. Returns per-request
+        outputs (padding rows stripped)."""
+        n = int(inputs[0].shape[0])
+        if n > self.batch_size:
+            raise ValueError(f"{n} requests > compiled batch {self.batch_size}")
+        padded = []
+        for a in inputs:
+            a = np.asarray(a)
+            if a.shape[0] < self.batch_size:
+                pad = np.zeros((self.batch_size - a.shape[0],) + a.shape[1:],
+                               a.dtype)
+                a = np.concatenate([a, pad], axis=0)
+            padded.append(a)
+        logits = self._cm.forward_fn(self._cm.params, *padded)
+        return [np.asarray(logits)[:n]]
+
+
+class InferenceRequest:
+    """A queued request: per-input rows + a Future for the result."""
+
+    __slots__ = ("inputs", "future", "request_id")
+
+    def __init__(self, request_id: int, inputs: Sequence[np.ndarray]):
+        self.request_id = request_id
+        self.inputs = [np.asarray(a) for a in inputs]
+        self.future: Future = Future()
+
+
+class InferenceEngine:
+    """Multi-model serving engine (reference: triton/src/backend.cc model
+    repository + scheduler). One dynamic batcher + worker thread per
+    registered model; requests are single samples (leading dim added here)
+    or micro-batches of rows.
+    """
+
+    def __init__(self, batch_timeout_s: float = 0.005):
+        self.batch_timeout_s = batch_timeout_s
+        self._models: Dict[str, ModelInstance] = {}
+        self._batchers: Dict[str, object] = {}
+        self._requests: Dict[str, Dict[int, InferenceRequest]] = {}
+        self._workers: Dict[str, threading.Thread] = {}
+        self._ids = itertools.count()
+        self._mu = threading.Lock()
+        self._started = False
+
+    # ---- model repository --------------------------------------------------
+    def register(self, instance: ModelInstance) -> None:
+        if instance.name in self._models:
+            raise ValueError(f"model {instance.name!r} already registered")
+        self._models[instance.name] = instance
+        self._batchers[instance.name] = _make_batcher(
+            instance.batch_size, self.batch_timeout_s)
+        self._requests[instance.name] = {}
+        if self._started:
+            self._spawn(instance.name)
+
+    def register_ffmodel(self, ff, name: str = "model") -> ModelInstance:
+        inst = ModelInstance(ff, name=name)
+        self.register(inst)
+        return inst
+
+    def register_onnx(self, onnx_path: str, name: str = "model",
+                      config=None, mesh=None) -> ModelInstance:
+        inst = ModelInstance.from_onnx(onnx_path, config=config, name=name,
+                                       mesh=mesh)
+        self.register(inst)
+        return inst
+
+    def models(self) -> List[str]:
+        return list(self._models)
+
+    # ---- lifecycle ---------------------------------------------------------
+    def _spawn(self, name: str) -> None:
+        t = threading.Thread(target=self._worker, args=(name,), daemon=True,
+                             name=f"ffserve-{name}")
+        self._workers[name] = t
+        t.start()
+
+    def start(self) -> None:
+        if self._started:
+            return
+        self._started = True
+        for name in self._models:
+            self._spawn(name)
+
+    def stop(self) -> None:
+        for b in self._batchers.values():
+            b.close()
+        for t in self._workers.values():
+            t.join(timeout=10)
+        self._workers.clear()
+        self._started = False
+        # closed batchers can't be reopened: re-arm each model with a fresh
+        # queue so a later start()/infer() serves again instead of hanging
+        for name, b in list(self._batchers.items()):
+            b.destroy()
+            self._batchers[name] = _make_batcher(
+                self._models[name].batch_size, self.batch_timeout_s)
+
+    # ---- request path ------------------------------------------------------
+    def infer_async(self, model: str, inputs: Sequence[np.ndarray]) -> Future:
+        """Submit one request (arrays WITHOUT the batch dim). The future
+        resolves to the model's per-request output array."""
+        if not self._started:
+            self.start()
+        inst = self._models[model]
+        # validate per-request shapes HERE so one malformed request fails
+        # alone instead of poisoning every co-batched request
+        if len(inputs) != inst.n_inputs:
+            raise ValueError(
+                f"{model!r} takes {inst.n_inputs} inputs, got {len(inputs)}")
+        for a, t in zip(inputs, inst._cm.input_tensors):
+            want = tuple(t.dims[1:])
+            if tuple(np.shape(a)) != want:
+                raise ValueError(
+                    f"{model!r} input {t.name!r}: expected per-request shape "
+                    f"{want}, got {np.shape(a)}")
+        req = InferenceRequest(next(self._ids),
+                               [np.asarray(a)[None, ...] for a in inputs])
+        with self._mu:
+            self._requests[model][req.request_id] = req
+        self._batchers[model].submit(req.request_id)
+        return req.future
+
+    def infer(self, model: str, inputs: Sequence[np.ndarray],
+              timeout: Optional[float] = 60.0) -> np.ndarray:
+        return self.infer_async(model, inputs).result(timeout)
+
+    # ---- worker ------------------------------------------------------------
+    def _worker(self, name: str) -> None:
+        inst = self._models[name]
+        batcher = self._batchers[name]
+        while True:
+            ids = batcher.next_batch()
+            if ids is None:
+                return
+            with self._mu:
+                reqs = [self._requests[name].pop(i) for i in ids
+                        if i in self._requests[name]]
+            if not reqs:
+                continue
+            try:
+                stacked = [
+                    np.concatenate([r.inputs[k] for r in reqs], axis=0)
+                    for k in range(inst.n_inputs)
+                ]
+                outs = inst.infer(stacked)[0]
+                row = 0
+                for r in reqs:
+                    cnt = r.inputs[0].shape[0]
+                    r.future.set_result(outs[row:row + cnt][0]
+                                        if cnt == 1 else outs[row:row + cnt])
+                    row += cnt
+            except Exception as e:  # surface per-request, keep serving
+                for r in reqs:
+                    if not r.future.done():
+                        r.future.set_exception(e)

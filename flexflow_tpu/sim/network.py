@@ -73,21 +73,31 @@ def route_transfers_py(
     hop_latency: float,
 ) -> Tuple[float, float, int]:
     """Pure-Python mirror of native fftpu_route_transfers (same semantics:
-    dimension-ordered routing, per-directed-link byte accumulation)."""
+    dimension-ordered routing, per-directed-link byte accumulation).
+    Integer stride arithmetic throughout — this is the search's inner loop
+    when no C++ toolchain is present."""
+    if not (len(src) == len(dst) == len(bytes_)):
+        raise ValueError(
+            f"src/dst/bytes length mismatch: {len(src)}/{len(dst)}/{len(bytes_)}")
     ndims = len(topo.dims)
+    dims = topo.dims
+    # row-major strides, last dim fastest (matches the native router)
+    strides = [1] * ndims
+    for dd in range(ndims - 2, -1, -1):
+        strides[dd] = strides[dd + 1] * dims[dd + 1]
     link_bytes: Dict[Tuple[int, int, int], float] = {}
     max_hops = 0
     for s, d, b in zip(src, dst, bytes_):
         if s == d or b <= 0:
             continue
-        coord = list(topo.coords(s))
+        coord = [(s // strides[dd]) % dims[dd] for dd in range(ndims)]
         hops = 0
         for dim in range(ndims):
-            want = topo.coords(d)[dim]
+            want = (d // strides[dim]) % dims[dim]
             have = coord[dim]
             if want == have:
                 continue
-            n = topo.dims[dim]
+            n = dims[dim]
             fwd = (want - have) % n
             bwd = (have - want) % n
             if topo.wrap[dim]:
@@ -97,7 +107,9 @@ def route_transfers_py(
                 use_fwd = want > have
                 steps = fwd if use_fwd else bwd
             for _ in range(steps):
-                node = topo.node(coord)
+                node = 0
+                for dd in range(ndims):
+                    node += coord[dd] * strides[dd]
                 key = (node, dim, 1 if use_fwd else 0)
                 link_bytes[key] = link_bytes.get(key, 0.0) + b
                 coord[dim] = (coord[dim] + (1 if use_fwd else -1)) % n

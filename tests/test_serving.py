@@ -1,0 +1,162 @@
+"""Inference serving engine (reference: the Triton backend prototype,
+/root/reference/triton/src/{backend,instance,onnx_parser}.cc — model
+lifecycle, per-instance execution, dynamic batching)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import (
+    ActiMode,
+    DataType,
+    FFConfig,
+    FFModel,
+    LossType,
+    SGDOptimizer,
+)
+from flexflow_tpu.serving import InferenceEngine, ModelInstance
+from flexflow_tpu.serving.engine import _PyBatcher, _make_batcher
+
+
+def _build_classifier(batch=8, d=12, classes=3, seed=0):
+    ff = FFModel(FFConfig(batch_size=batch, seed=seed))
+    x = ff.create_tensor((batch, d), DataType.FLOAT, name="x")
+    t = ff.dense(x, 32, ActiMode.RELU)
+    t = ff.dense(t, classes)
+    t = ff.softmax(t)
+    ff.compile(optimizer=SGDOptimizer(lr=0.1),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=[])
+    return ff
+
+
+# --------------------------------------------------------------- batchers
+@pytest.mark.parametrize("factory", [
+    pytest.param(lambda mb, to: _PyBatcher(mb, to), id="python"),
+    pytest.param(lambda mb, to: _make_batcher(mb, to), id="default"),
+])
+def test_batcher_full_batch_then_remainder(factory):
+    b = factory(2, 10.0)  # long timeout: only fullness releases
+    for i in range(3):
+        b.submit(i)
+    assert b.next_batch() == [0, 1]
+    b.close()  # drains the remainder immediately
+    assert b.next_batch() == [2]
+    assert b.next_batch() is None
+    b.destroy()
+
+
+@pytest.mark.parametrize("factory", [
+    pytest.param(lambda mb, to: _PyBatcher(mb, to), id="python"),
+    pytest.param(lambda mb, to: _make_batcher(mb, to), id="default"),
+])
+def test_batcher_timeout_releases_partial(factory):
+    b = factory(64, 0.05)
+    t0 = time.monotonic()
+    b.submit(7)
+    got = b.next_batch()
+    waited = time.monotonic() - t0
+    assert got == [7]
+    assert waited >= 0.04  # held for ~timeout waiting for more work
+    b.close()
+    assert b.next_batch() is None
+    b.destroy()
+
+
+def test_native_batcher_is_used_when_available():
+    from flexflow_tpu import native_bridge
+
+    if not native_bridge.available():
+        pytest.skip("native library unavailable")
+    b = _make_batcher(4, 0.01)
+    assert isinstance(b, native_bridge.NativeBatcher)
+    b.close()
+    b.destroy()
+
+
+# ---------------------------------------------------------- model instance
+def test_model_instance_pads_and_strips():
+    ff = _build_classifier(batch=8)
+    inst = ModelInstance(ff, name="clf")
+    x = np.random.default_rng(0).normal(size=(3, 12)).astype(np.float32)
+    (out,) = inst.infer([x])
+    assert out.shape == (3, 3)
+    # padding must not change the real rows: full-batch forward agrees
+    xfull = np.concatenate([x, np.zeros((5, 12), np.float32)])
+    ref = np.asarray(ff.compiled.forward_fn(ff.compiled.params, xfull))[:3]
+    np.testing.assert_allclose(out, ref, rtol=1e-6)
+    with pytest.raises(ValueError):
+        inst.infer([np.zeros((9, 12), np.float32)])
+
+
+# ----------------------------------------------------------------- engine
+def test_engine_end_to_end_concurrent_requests():
+    ff = _build_classifier(batch=8)
+    eng = InferenceEngine(batch_timeout_s=0.01)
+    eng.register_ffmodel(ff, name="clf")
+    eng.start()
+    try:
+        rng = np.random.default_rng(1)
+        xs = rng.normal(size=(20, 12)).astype(np.float32)
+        futs = [eng.infer_async("clf", [xs[i]]) for i in range(20)]
+        outs = np.stack([f.result(timeout=30) for f in futs])
+        ref = []
+        for i in range(0, 24, 8):
+            chunk = xs[i:i + 8]
+            pad = np.zeros((8 - len(chunk), 12), np.float32)
+            full = np.concatenate([chunk, pad])
+            ref.append(np.asarray(
+                ff.compiled.forward_fn(ff.compiled.params, full))[:len(chunk)])
+            if i + 8 >= 20:
+                break
+        ref = np.concatenate(ref)[:20]
+        np.testing.assert_allclose(outs, ref, rtol=1e-5, atol=1e-6)
+    finally:
+        eng.stop()
+
+
+def test_engine_multiple_models_and_errors():
+    ff_a = _build_classifier(batch=4, d=6, classes=2, seed=0)
+    ff_b = _build_classifier(batch=4, d=10, classes=5, seed=1)
+    eng = InferenceEngine(batch_timeout_s=0.005)
+    eng.register_ffmodel(ff_a, name="a")
+    eng.register_ffmodel(ff_b, name="b")
+    eng.start()
+    try:
+        assert sorted(eng.models()) == ["a", "b"]
+        oa = eng.infer("a", [np.zeros(6, np.float32)])
+        ob = eng.infer("b", [np.zeros(10, np.float32)])
+        assert oa.shape == (2,)
+        assert ob.shape == (5,)
+        # a wrong-shaped request is rejected at submit time so it can
+        # never poison co-batched innocent requests
+        with pytest.raises(ValueError, match="per-request shape"):
+            eng.infer_async("a", [np.zeros(7, np.float32)])
+        with pytest.raises(ValueError, match="takes 1 inputs"):
+            eng.infer_async("a", [np.zeros(6, np.float32)] * 2)
+        ok = eng.infer("a", [np.zeros(6, np.float32)])
+        assert ok.shape == (2,)
+    finally:
+        eng.stop()
+
+
+def test_engine_restarts_after_stop():
+    ff = _build_classifier(batch=4, d=6, classes=2)
+    eng = InferenceEngine(batch_timeout_s=0.005)
+    eng.register_ffmodel(ff, name="m")
+    out1 = eng.infer("m", [np.zeros(6, np.float32)], timeout=30)
+    eng.stop()
+    # a stopped engine serves again (fresh batcher + worker)
+    out2 = eng.infer("m", [np.zeros(6, np.float32)], timeout=30)
+    np.testing.assert_allclose(out1, out2)
+    eng.stop()
+
+
+def test_engine_duplicate_name_rejected():
+    ff = _build_classifier(batch=4, d=6, classes=2)
+    eng = InferenceEngine()
+    eng.register_ffmodel(ff, name="m")
+    with pytest.raises(ValueError):
+        eng.register(ModelInstance(ff, name="m"))
