@@ -116,6 +116,9 @@ class OpType(enum.Enum):
     INPUT = "input"
     WEIGHT = "weight"
     NOOP = "noop"
+    # baked-in constant tensor (no reference analog: HF imports fold
+    # position-id buffers / masks into graph constants; XLA embeds them)
+    CONSTANT = "constant"
     CONV2D = "conv2d"
     DROPOUT = "dropout"
     LINEAR = "linear"
@@ -154,6 +157,7 @@ class OpType(enum.Enum):
     EXPERT_LINEAR = "expert_linear"
     AGGREGATE_STACKED = "aggregate_stacked"
     RESHAPE = "reshape"
+    SLICE = "slice"
     REVERSE = "reverse"
     TRANSPOSE = "transpose"
     EW_ADD = "add"

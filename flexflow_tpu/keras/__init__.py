@@ -41,6 +41,8 @@ from .callbacks import (
 )
 from .models import Model, Sequential
 from .optimizers import SGD, Adam
+from . import datasets
+from .regularizers import L1, L1L2, L2, Regularizer
 
 __all__ = [
     "Activation", "Add", "AveragePooling2D", "BatchNormalization",
@@ -49,4 +51,5 @@ __all__ = [
     "Subtract", "Model", "Sequential", "SGD", "Adam",
     "Callback", "EarlyStopping", "EpochVerifyMetrics", "History",
     "LearningRateScheduler", "ModelAccuracy", "VerifyMetrics",
+    "datasets", "Regularizer", "L1", "L2", "L1L2",
 ]

@@ -59,6 +59,7 @@ class Dense(KerasLayer):
     def __init__(self, units: int, activation=None, use_bias: bool = True,
                  input_shape: Optional[Sequence[int]] = None,
                  kernel_initializer=None, bias_initializer=None,
+                 kernel_regularizer=None,
                  name: Optional[str] = None):
         super().__init__(name)
         self.units = units
@@ -66,6 +67,7 @@ class Dense(KerasLayer):
         self.use_bias = use_bias
         self.kernel_initializer = kernel_initializer
         self.bias_initializer = bias_initializer
+        self.kernel_regularizer = kernel_regularizer
         if input_shape is not None:
             self.input_shape = tuple(input_shape)
 
@@ -76,12 +78,14 @@ class Dense(KerasLayer):
                            use_bias=self.use_bias,
                            kernel_initializer=self.kernel_initializer,
                            bias_initializer=self.bias_initializer,
+                           kernel_regularizer=self.kernel_regularizer,
                            name=self.name)
             return ff.softmax(out, name=self.name + "_softmax")
         return ff.dense(x[0], self.units, activation=act,
                         use_bias=self.use_bias,
                         kernel_initializer=self.kernel_initializer,
                         bias_initializer=self.bias_initializer,
+                        kernel_regularizer=self.kernel_regularizer,
                         name=self.name)
 
 

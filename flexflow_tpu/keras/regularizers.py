@@ -1,0 +1,42 @@
+"""Keras-style weight regularizers.
+
+reference: python/flexflow/keras/regularizers.py (L1/L2 carrying a
+RegularizerMode consumed by the C++ ops). Here a regularizer is a pure
+function of the weight; the compiler adds the penalty as a differentiable
+term in the training loss (runtime/compiler.py), so the gradient comes
+from jax.grad instead of hand-written kernel epilogues.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class Regularizer:
+    def penalty(self, w: jnp.ndarray) -> jnp.ndarray:
+        raise NotImplementedError
+
+
+class L1(Regularizer):
+    def __init__(self, l1: float = 0.01):
+        self.l1 = float(l1)
+
+    def penalty(self, w):
+        return self.l1 * jnp.sum(jnp.abs(w))
+
+
+class L2(Regularizer):
+    def __init__(self, l2: float = 0.01):
+        self.l2 = float(l2)
+
+    def penalty(self, w):
+        return self.l2 * jnp.sum(jnp.square(w))
+
+
+class L1L2(Regularizer):
+    def __init__(self, l1: float = 0.0, l2: float = 0.0):
+        self.l1 = float(l1)
+        self.l2 = float(l2)
+
+    def penalty(self, w):
+        return self.l1 * jnp.sum(jnp.abs(w)) + self.l2 * jnp.sum(jnp.square(w))

@@ -150,3 +150,62 @@ class NoOp(Op):
 
     def forward(self, ctx, inputs, weights):
         return list(inputs)
+
+
+@register_op
+class Constant(Op):
+    """A baked-in constant tensor (no inputs). Used by the torch/HF
+    importer for folded buffers (position ids, token-type ids, additive
+    masks) — XLA embeds the literal in the executable, so there is no
+    per-step host transfer. Not trainable; for trainable state use a
+    weight-bearing op."""
+
+    op_type = OpType.CONSTANT
+
+    def infer_output_shapes(self):
+        v = self.attrs["value"]
+        return [(tuple(v.shape), self.attrs["dtype"])]
+
+    def forward(self, ctx, inputs, weights):
+        v = jnp.asarray(self.attrs["value"],
+                        dtype=self.attrs["dtype"].to_jnp())
+        return [v]
+
+
+@register_op
+class Slice(Op):
+    """Static strided slicing / integer indexing (torch ``x[:, 0]``, ONNX
+    Slice). attrs["items"]: one spec per leading dim — {"kind": "slice",
+    "start": s, "stop": e, "step": st} keeps the dim, {"kind": "int",
+    "i": k} drops it; trailing dims pass through. Lowers to
+    ``jax.lax.slice``-style indexing, which XLA folds into the consumer."""
+
+    op_type = OpType.SLICE
+
+    def _index(self):
+        """[(python index or slice, drop)] per input dim, raw — numpy/jax
+        slice semantics (incl. negative steps) apply verbatim."""
+        sizes = self.input_shapes[0].sizes
+        out = []
+        for d, size in enumerate(sizes):
+            if d < len(self.attrs["items"]):
+                it = self.attrs["items"][d]
+                if it["kind"] == "int":
+                    out.append((it["i"] % size, True))
+                else:
+                    out.append((slice(it.get("start"), it.get("stop"),
+                                      it.get("step")), False))
+            else:
+                out.append((slice(None), False))
+        return out
+
+    def infer_output_shapes(self):
+        sizes = []
+        for (ix, drop), size in zip(self._index(), self.input_shapes[0].sizes):
+            if not drop:
+                sizes.append(len(range(*ix.indices(size))))
+        return [(tuple(sizes), self.input_shapes[0].dtype)]
+
+    def forward(self, ctx, inputs, weights):
+        idx = tuple(ix for ix, _ in self._index())
+        return [inputs[0][idx]]

@@ -363,6 +363,14 @@ def compile_model(
             loss = compute_loss(loss_type, logits, y, from_logits)
             for a in aux:
                 loss = loss + _f32(a)
+            # weight regularizers (keras frontend: kernel_regularizer attr;
+            # reference keras/regularizers.py) — differentiable penalties on
+            # the fp32 master weights
+            for op in ops:
+                reg = op.attrs.get("kernel_regularizer")
+                if reg is not None and hasattr(reg, "penalty") \
+                        and op.name in params and "kernel" in params[op.name]:
+                    loss = loss + reg.penalty(params[op.name]["kernel"])
             return loss, (logits, updates)
 
         (loss, (logits, updates)), grads = jax.value_and_grad(

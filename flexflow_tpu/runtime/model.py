@@ -125,16 +125,20 @@ class FFModel:
         datatype: DataType = DataType.NONE,
         kernel_initializer=None,
         bias_initializer=None,
+        kernel_regularizer=None,
         name: Optional[str] = None,
         strategy: Optional[Dict[str, str]] = None,
     ) -> Tensor:
-        """reference: FFModel::dense (model.h:487, src/ops/linear.cc)."""
+        """reference: FFModel::dense (model.h:487, src/ops/linear.cc).
+        ``kernel_regularizer`` (keras/regularizers.py) adds a
+        differentiable penalty on the kernel to the training loss."""
         attrs = dict(
             out_dim=out_dim,
             activation=activation,
             use_bias=use_bias,
             kernel_initializer=kernel_initializer,
             bias_initializer=bias_initializer,
+            kernel_regularizer=kernel_regularizer,
         )
         if strategy:
             attrs["strategy"] = strategy
@@ -400,6 +404,31 @@ class FFModel:
         return self._infer_and_add(
             OpType.MULTIHEAD_ATTENTION, [query, key, value], attrs, name
         )
+
+    def slice_tensor(self, input: Tensor, items, name=None) -> Tensor:
+        """Static strided slice / integer indexing (ops/structural.py
+        Slice; torch ``x[:, 0]`` and ONNX Slice import through this)."""
+        return self._infer_and_add(OpType.SLICE, [input],
+                                   dict(items=list(items)), name)
+
+    def constant(self, value, name=None) -> Tensor:
+        """A baked-in constant tensor (no reference analog — used by the
+        HF importer for folded buffers; ops/structural.py Constant)."""
+        v = np.asarray(value)
+        if np.issubdtype(v.dtype, np.integer):
+            # int64 buffers (torch ids) downcast: jax runs 32-bit by default
+            dt = DataType.INT32
+            v = v.astype(np.int32)
+        elif v.dtype == np.float64:
+            dt = DataType.FLOAT
+            v = v.astype(np.float32)
+        elif v.dtype == np.bool_:
+            dt = DataType.BOOL
+        else:
+            dt = DataType.FLOAT
+            v = v.astype(np.float32)
+        return self._infer_and_add(OpType.CONSTANT, [],
+                                   dict(value=v, dtype=dt), name)
 
     # ---- recurrent family ------------------------------------------------ #
     def _recurrent(self, op_type, input, initial_state, attrs, name):

@@ -124,6 +124,10 @@ class ModelInstance:
             dims = [d.dim_value
                     for d in gi.type.tensor_type.shape.dim]
             dims[0] = dims[0] if dims[0] > 0 else config.batch_size
+            if any(d <= 0 for d in dims[1:]):
+                raise ValueError(
+                    f"ONNX input {gi.name!r} has dynamic non-batch dims "
+                    f"{dims}: export with static shapes (XLA needs them)")
             inputs.append(ff.create_tensor(tuple(dims), name=gi.name))
         onnx_model.apply(ff, inputs)
         ff.compile(optimizer=None, loss_type=None, metrics=[], mesh=mesh)

@@ -8,6 +8,6 @@ model.py:2444, 60+ per-node IR classes serialized to a ``.ff`` IR file via
 JSON-lines instead of the reference's positional strings.
 """
 
-from .model import PyTorchModel, torch_to_flexflow
+from .model import PyTorchModel, copy_weights, torch_to_flexflow
 
-__all__ = ["PyTorchModel", "torch_to_flexflow"]
+__all__ = ["PyTorchModel", "copy_weights", "torch_to_flexflow"]

@@ -186,6 +186,14 @@ def _trace(module) -> List[Dict]:
     return records
 
 
+class NodeRef:
+    """Duck-typed stand-in for an fx.Node whose producer was already
+    resolved to an IR name (used by the HF importer, hf.py)."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+
 def _function_record(node, torch, F) -> Dict:
     import torch.fx as fx
 
@@ -198,7 +206,7 @@ def _function_record(node, torch, F) -> Dict:
                 "inputs": inputs, "attrs": attrs or {}}
 
     def is_node(a):
-        return isinstance(a, fx.Node)
+        return isinstance(a, (fx.Node, NodeRef))
 
     # method calls arrive as strings
     if node.op == "call_method":
@@ -341,6 +349,15 @@ class _SizeMarker:
     __mul__ = __rmul__ = __sub__ = __truediv__ = __call__ = _fail
 
 
+def _is_hf_model(module) -> bool:
+    try:
+        from transformers import PreTrainedModel
+
+        return isinstance(module, PreTrainedModel)
+    except ImportError:
+        return False
+
+
 # -------------------------------------------------------------------- replay
 class PyTorchModel:
     """reference: PyTorchModel (python/flexflow/torch/model.py:2408).
@@ -350,13 +367,24 @@ class PyTorchModel:
     builder and returns the output Tensors.
     """
 
-    def __init__(self, model_or_path: Union[str, "object"]):
+    def __init__(self, model_or_path: Union[str, "object"],
+                 input_names: Optional[Sequence[str]] = None,
+                 batch_size: int = 2, seq_length: int = 16):
         if isinstance(model_or_path, str):
             with open(model_or_path) as f:
                 self.ir = [json.loads(line) for line in f if line.strip()]
             self.module = None
+            return
+        self.module = model_or_path
+        if _is_hf_model(model_or_path):
+            # HF-aware tracing (reference: model.py:2430 swaps the tracer
+            # for transformers models); see hf.py for the TPU additions
+            from .hf import trace_hf
+
+            self.ir = trace_hf(model_or_path,
+                               input_names=input_names or ("input_ids",),
+                               batch_size=batch_size, seq_length=seq_length)
         else:
-            self.module = model_or_path
             self.ir = _trace(model_or_path)
 
     def torch_to_file(self, path: str) -> None:
@@ -371,11 +399,21 @@ class PyTorchModel:
         outputs: List = []
         it = iter(input_tensors)
         self.layer_names: Dict[str, str] = {}  # fx node -> FF layer name
+        # FF layer name -> torch module path (for exact weight binding in
+        # copy_weights; records carry it for call_module nodes)
+        self.module_paths: Dict[str, str] = {}
         for r in self.ir:
+            if "module_path" in r:
+                self.module_paths[r["name"]] = r["module_path"]
             op, name, ins = r["op"], r["name"], r["inputs"]
             a = dict(r["attrs"])
             if r["kind"] == "input":
                 env[name] = next(it)
+                continue
+            if r["kind"] == "constant":
+                env[name] = ffmodel.constant(
+                    np.array(a["value"], dtype=np.dtype(a["vdtype"])),
+                    name=name)
                 continue
             if r["kind"] == "output":
                 outputs = [env[i] for i in ins]
@@ -458,6 +496,8 @@ class PyTorchModel:
                 x[0], x[1], x[2], a["embed_dim"], a["num_heads"],
                 dropout=a.get("dropout", 0.0), bias=a.get("bias", True),
                 name=name)
+        if op == "slice":
+            return ff.slice_tensor(x[0], a["items"], name=name)
         if op == "getitem":
             if isinstance(x[0], (list, tuple)):
                 return x[0][a["index"]]
@@ -488,17 +528,26 @@ def torch_to_flexflow(module, path: str) -> PyTorchModel:
     return m
 
 
-def copy_weights(ffmodel, torch_module, layer_names: Optional[Dict[str, str]] = None):
+def copy_weights(ffmodel, torch_module,
+                 module_paths: Optional[Dict[str, str]] = None):
     """Copy a traced module's parameters into the compiled FFModel
     (post-``compile``). Layout mapping: torch Linear stores (out, in) →
     FF kernel (in, out); Conv2d OIHW matches; Embedding matches.
+
+    ``module_paths``: FF layer name → torch module path
+    (``PyTorchModel.module_paths``, filled by ``apply``) — the exact
+    binding; without it a dot→underscore name heuristic is used, which can
+    be ambiguous for paths that flatten identically.
     """
     import torch
 
     name_of = {}  # FF layer name -> torch submodule
     gm_modules = dict(torch_module.named_modules())
     for layer in ffmodel.layers:
-        if layer.name in gm_modules:
+        path = (module_paths or {}).get(layer.name)
+        if path is not None and path in gm_modules:
+            name_of[layer.name] = gm_modules[path]
+        elif layer.name in gm_modules:
             name_of[layer.name] = gm_modules[layer.name]
         else:
             # fx node names flatten '.' to '_'

@@ -1,0 +1,49 @@
+"""Slice + Constant structural ops (added for the HF importer; the slice
+semantics must match numpy/torch exactly, including negative steps)."""
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import DataType, FFConfig, FFModel, SGDOptimizer
+
+
+def _run(x_np, items):
+    ff = FFModel(FFConfig(batch_size=x_np.shape[0], seed=0))
+    x = ff.create_tensor(x_np.shape, DataType.FLOAT, name="x")
+    out = ff.slice_tensor(x, items)
+    ff.compile(optimizer=SGDOptimizer(lr=0.1), loss_type=None, metrics=[])
+    got = np.asarray(ff.compiled.forward_fn(ff.compiled.params, x_np))
+    return out.dims, got
+
+
+@pytest.mark.parametrize("items,ref_ix", [
+    ([{"kind": "slice", "start": None, "stop": None, "step": None},
+      {"kind": "int", "i": 0}], np.s_[:, 0]),
+    ([{"kind": "slice", "start": 1, "stop": 3, "step": None}], np.s_[1:3]),
+    ([{"kind": "slice", "start": None, "stop": None, "step": None},
+      {"kind": "slice", "start": None, "stop": None, "step": -1}], np.s_[:, ::-1]),
+    ([{"kind": "slice", "start": None, "stop": None, "step": None},
+      {"kind": "slice", "start": 4, "stop": 0, "step": -2}], np.s_[:, 4:0:-2]),
+    ([{"kind": "slice", "start": None, "stop": None, "step": None},
+      {"kind": "int", "i": -1}], np.s_[:, -1]),
+])
+def test_slice_matches_numpy(items, ref_ix):
+    x = np.arange(4 * 5 * 3, dtype=np.float32).reshape(4, 5, 3)
+    dims, got = _run(x, items)
+    ref = x[ref_ix]
+    assert dims == ref.shape, (dims, ref.shape)
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_constant_feeds_graph():
+    ff = FFModel(FFConfig(batch_size=2, seed=0))
+    x = ff.create_tensor((2, 3), DataType.FLOAT, name="x")
+    c = ff.constant(np.full((2, 3), 2.0, np.float32))
+    out = ff.add(x, c)
+    ff.compile(optimizer=SGDOptimizer(lr=0.1), loss_type=None, metrics=[])
+    xs = np.ones((2, 3), np.float32)
+    got = np.asarray(ff.compiled.forward_fn(ff.compiled.params, xs))
+    np.testing.assert_allclose(got, np.full((2, 3), 3.0))
+    # int constants downcast to int32 (jax 32-bit default)
+    ci = ff.constant(np.arange(4, dtype=np.int64))
+    assert ci.dtype == DataType.INT32
