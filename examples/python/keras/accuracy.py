@@ -1,0 +1,16 @@
+"""Accuracy gate (reference: examples/python/keras/accuracy.py — shared
+ModelAccuracy thresholds asserted by the keras example scripts)."""
+
+GATES = {
+    "mnist_mlp": 0.85,
+    "cifar10_cnn": 0.60,
+}
+
+
+def check(name: str, accuracy: float) -> None:
+    gate = GATES.get(name)
+    if gate is None:
+        return
+    assert accuracy >= gate, (
+        f"{name}: accuracy {accuracy:.4f} below the {gate} gate")
+    print(f"[{name}] accuracy {accuracy:.4f} >= gate {gate}: PASS")

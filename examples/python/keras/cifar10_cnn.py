@@ -1,0 +1,28 @@
+"""Keras-frontend CIFAR-10 CNN with the accuracy gate
+(reference: examples/python/keras/cifar10_cnn.py)."""
+import numpy as np
+
+from flexflow_tpu.keras import (Adam, Conv2D, Dense, Flatten, MaxPooling2D,
+                                Sequential, datasets)
+
+import accuracy
+
+if __name__ == "__main__":
+    (xt, yt), _ = datasets.cifar10.load_data()
+    x = (xt[:1024] / 255.0).astype(np.float32)
+    y = yt[:1024].astype(np.int32).reshape(-1, 1)
+    model = Sequential([
+        Conv2D(32, 3, padding="same", activation="relu",
+               input_shape=(3, 32, 32)),
+        MaxPooling2D(2),
+        Conv2D(64, 3, padding="same", activation="relu"),
+        MaxPooling2D(2),
+        Flatten(),
+        Dense(128, activation="relu"),
+        Dense(10),
+    ])
+    model.compile(optimizer=Adam(learning_rate=0.002),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    hist = model.fit(x, y, epochs=8, batch_size=64)
+    accuracy.check("cifar10_cnn", hist[-1].accuracy)

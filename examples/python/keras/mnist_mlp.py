@@ -1,0 +1,22 @@
+"""Keras-frontend MNIST MLP with the accuracy gate
+(reference: examples/python/keras/mnist_mlp.py + accuracy.py)."""
+import numpy as np
+
+from flexflow_tpu.keras import Adam, Dense, Sequential, datasets
+
+import accuracy
+
+if __name__ == "__main__":
+    (xt, yt), _ = datasets.mnist.load_data()
+    x = (xt[:2048].reshape(-1, 784) / 255.0).astype(np.float32)
+    y = yt[:2048].astype(np.int32).reshape(-1, 1)
+    model = Sequential([
+        Dense(512, activation="relu", input_shape=(784,)),
+        Dense(512, activation="relu"),
+        Dense(10),
+    ])
+    model.compile(optimizer=Adam(learning_rate=0.003),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    hist = model.fit(x, y, epochs=10, batch_size=64)
+    accuracy.check("mnist_mlp", hist[-1].accuracy)

@@ -1,0 +1,45 @@
+"""Shared example driver.
+
+reference: every C++ example's top_level_task prints fenced
+ELAPSED TIME / THROUGHPUT around its epoch loop
+(examples/cpp/Transformer/transformer.cc:172-210); the Python examples
+build a model, compile, fit, and print per-epoch metrics. This helper
+keeps each example file to its model definition, like the reference's
+examples keep to graph construction.
+
+Every example accepts the framework CLI flags (FFConfig.parse_args:
+--epochs, --batch-size, --budget, --only-data-parallel, ...).
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+from flexflow_tpu import FFConfig, FFModel
+
+
+def run_example(name, build, make_data, loss_type, metrics,
+                optimizer=None, argv=None):
+    """build(ff, batch_size) -> None (constructs the graph);
+    make_data(n, config) -> (xs: list[np.ndarray] | np.ndarray, y)."""
+    config = FFConfig.parse_args(argv if argv is not None else sys.argv[1:])
+    ff = FFModel(config)
+    build(ff, config.batch_size)
+    ff.compile(optimizer=optimizer, loss_type=loss_type, metrics=metrics)
+    xs, y = make_data(max(256, config.batch_size * 4), config)
+    if not isinstance(xs, (list, tuple)):
+        xs = [xs]
+
+    print(f"[{name}] devices={config.num_devices} "
+          f"batch={config.batch_size} epochs={config.epochs}")
+    start = time.perf_counter()
+    history = ff.fit(xs if len(xs) > 1 else xs[0], y, verbose=True)
+    elapsed = time.perf_counter() - start
+    samples = len(y) * config.epochs
+    # the reference's fenced benchmark print (transformer.cc:205-210)
+    print(f"ELAPSED TIME = {elapsed:.4f}s, "
+          f"THROUGHPUT = {samples / elapsed:.2f} samples/s")
+    return ff, history

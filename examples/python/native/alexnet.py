@@ -1,0 +1,27 @@
+"""AlexNet on CIFAR-10 (reference: examples/cpp/AlexNet/alexnet.cc,
+examples/python/native/alexnet.py)."""
+import numpy as np
+
+from flexflow_tpu import LossType, MetricsType, SGDOptimizer
+from flexflow_tpu.keras import datasets
+from flexflow_tpu.models import build_alexnet
+
+import _common
+
+
+def build(ff, bs):
+    build_alexnet(ff, bs, num_classes=10, image_size=224)
+
+
+def data(n, config):
+    (xt, yt), _ = datasets.cifar10.load_data()
+    x = (xt[:n] / 255.0).astype(np.float32)
+    x = np.repeat(np.repeat(x, 7, axis=2), 7, axis=3)  # 32->224
+    return x, yt[:n].astype(np.int32).reshape(-1, 1)
+
+
+if __name__ == "__main__":
+    _common.run_example(
+        "alexnet", build, data,
+        LossType.SPARSE_CATEGORICAL_CROSSENTROPY, [MetricsType.ACCURACY],
+        optimizer=SGDOptimizer(lr=0.01, momentum=0.9))

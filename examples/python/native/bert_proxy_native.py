@@ -1,0 +1,29 @@
+"""BERT-proxy transformer (reference:
+examples/python/native/bert_proxy_native.py; the OSDI'22 bert.sh config)."""
+import numpy as np
+
+from flexflow_tpu import LossType, SGDOptimizer
+from flexflow_tpu.models import TransformerConfig, build_transformer
+
+import _common
+
+CFG = TransformerConfig(hidden_size=256, num_heads=8, num_layers=4,
+                        sequence_length=128)
+
+
+def build(ff, bs):
+    build_transformer(ff, bs, CFG)
+
+
+def data(n, config):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, CFG.sequence_length, CFG.hidden_size)).astype(np.float32)
+    y = rng.normal(size=(n, CFG.sequence_length, 1)).astype(np.float32)
+    return x, y
+
+
+if __name__ == "__main__":
+    _common.run_example(
+        "bert_proxy", build, data,
+        LossType.MEAN_SQUARED_ERROR_AVG_REDUCE, [],
+        optimizer=SGDOptimizer(lr=0.01))

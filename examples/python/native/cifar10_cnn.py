@@ -1,0 +1,33 @@
+"""CIFAR-10 CNN (reference: examples/python/native/cifar10_cnn.py)."""
+import numpy as np
+
+from flexflow_tpu import ActiMode, DataType, LossType, MetricsType, SGDOptimizer
+from flexflow_tpu.keras import datasets
+
+import _common
+
+
+def build(ff, bs):
+    x = ff.create_tensor((bs, 3, 32, 32), DataType.FLOAT, name="image")
+    t = ff.conv2d(x, 32, 3, 3, 1, 1, 1, 1, activation=ActiMode.RELU)
+    t = ff.conv2d(t, 32, 3, 3, 1, 1, 1, 1, activation=ActiMode.RELU)
+    t = ff.pool2d(t, 2, 2, 2, 2, 0, 0)
+    t = ff.conv2d(t, 64, 3, 3, 1, 1, 1, 1, activation=ActiMode.RELU)
+    t = ff.pool2d(t, 2, 2, 2, 2, 0, 0)
+    t = ff.flat(t)
+    t = ff.dense(t, 128, ActiMode.RELU)
+    t = ff.dense(t, 10)
+    ff.softmax(t)
+
+
+def data(n, config):
+    (xt, yt), _ = datasets.cifar10.load_data()
+    x = (xt[:n] / 255.0).astype(np.float32)
+    return x, yt[:n].astype(np.int32).reshape(-1, 1)
+
+
+if __name__ == "__main__":
+    _common.run_example(
+        "cifar10_cnn", build, data,
+        LossType.SPARSE_CATEGORICAL_CROSSENTROPY, [MetricsType.ACCURACY],
+        optimizer=SGDOptimizer(lr=0.05, momentum=0.9))

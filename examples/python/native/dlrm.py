@@ -1,0 +1,31 @@
+"""DLRM recommendation model (reference: examples/cpp/DLRM/dlrm.cc;
+parameter-parallel embeddings via --enable-parameter-parallel)."""
+import numpy as np
+
+from flexflow_tpu import LossType, MetricsType, SGDOptimizer
+from flexflow_tpu.models import DLRMConfig, build_dlrm
+
+import _common
+
+CFG = DLRMConfig(embedding_size=[10000, 10000, 10000, 10000])
+
+
+def build(ff, bs):
+    axis = "model" if ff.config.enable_parameter_parallel else None
+    build_dlrm(ff, bs, CFG, param_axis=axis)
+
+
+def data(n, config):
+    rng = np.random.default_rng(0)
+    xs = [rng.integers(0, 10000, (n, CFG.embedding_bag_size)).astype(np.int32)
+          for _ in CFG.embedding_size]
+    xs.append(rng.normal(size=(n, CFG.mlp_bot[0])).astype(np.float32))
+    y = rng.integers(0, 2, (n, 1)).astype(np.int32)
+    return xs, y
+
+
+if __name__ == "__main__":
+    _common.run_example(
+        "dlrm", build, data,
+        LossType.SPARSE_CATEGORICAL_CROSSENTROPY, [MetricsType.ACCURACY],
+        optimizer=SGDOptimizer(lr=0.01))

@@ -1,0 +1,26 @@
+"""InceptionV3 (reference: examples/cpp/InceptionV3/inception.cc)."""
+import numpy as np
+
+from flexflow_tpu import LossType, MetricsType, SGDOptimizer
+from flexflow_tpu.models import build_inception_v3
+
+import _common
+
+
+def build(ff, bs):
+    build_inception_v3(ff, bs, num_classes=10, image_size=299)
+
+
+def data(n, config):
+    n = min(n, 64)  # 299x299 inputs are big; keep the host batch modest
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, 3, 299, 299)).astype(np.float32)
+    y = rng.integers(0, 10, (n, 1)).astype(np.int32)
+    return x, y
+
+
+if __name__ == "__main__":
+    _common.run_example(
+        "inception_v3", build, data,
+        LossType.SPARSE_CATEGORICAL_CROSSENTROPY, [MetricsType.ACCURACY],
+        optimizer=SGDOptimizer(lr=0.01, momentum=0.9))

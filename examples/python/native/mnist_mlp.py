@@ -1,0 +1,26 @@
+"""MNIST MLP (reference: examples/python/native/mnist_mlp.py)."""
+import numpy as np
+
+from flexflow_tpu import LossType, MetricsType, SGDOptimizer
+from flexflow_tpu.keras import datasets
+from flexflow_tpu.models import build_mlp
+
+import _common
+
+
+def build(ff, bs):
+    build_mlp(ff, bs, in_dim=784, hidden_dims=(512, 512), num_classes=10)
+
+
+def data(n, config):
+    (xt, yt), _ = datasets.mnist.load_data()
+    x = (xt[:n].reshape(-1, 784) / 255.0).astype(np.float32)
+    return x, yt[:n].astype(np.int32).reshape(-1, 1)
+
+
+if __name__ == "__main__":
+    _common.run_example(
+        "mnist_mlp", build, data,
+        LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        [MetricsType.ACCURACY, MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY],
+        optimizer=SGDOptimizer(lr=0.1, momentum=0.9))

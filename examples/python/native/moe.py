@@ -1,0 +1,28 @@
+"""Mixture-of-experts classifier (reference:
+examples/cpp/mixture_of_experts/moe.cc)."""
+import numpy as np
+
+from flexflow_tpu import AdamOptimizer, LossType, MetricsType
+from flexflow_tpu.keras import datasets
+from flexflow_tpu.models import MoeConfig, build_moe_mnist
+
+import _common
+
+CFG = MoeConfig()
+
+
+def build(ff, bs):
+    build_moe_mnist(ff, bs, CFG)
+
+
+def data(n, config):
+    (xt, yt), _ = datasets.mnist.load_data()
+    x = (xt[:n].reshape(-1, 784) / 255.0).astype(np.float32)
+    return x, yt[:n].astype(np.int32).reshape(-1, 1)
+
+
+if __name__ == "__main__":
+    _common.run_example(
+        "moe", build, data,
+        LossType.SPARSE_CATEGORICAL_CROSSENTROPY, [MetricsType.ACCURACY],
+        optimizer=AdamOptimizer(alpha=0.003))

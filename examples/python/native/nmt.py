@@ -1,0 +1,31 @@
+"""LSTM seq2seq NMT (reference: the nmt/ legacy engine; here
+flexflow_tpu.models.nmt on the main framework)."""
+import numpy as np
+
+from flexflow_tpu import AdamOptimizer, LossType, MetricsType
+from flexflow_tpu.models import NMTConfig, build_nmt
+
+import _common
+
+CFG = NMTConfig(src_vocab_size=4000, tgt_vocab_size=4000, embed_dim=128,
+                hidden_size=256, num_layers=2, src_length=24, tgt_length=24)
+
+
+def build(ff, bs):
+    build_nmt(ff, bs, CFG)
+
+
+def data(n, config):
+    rng = np.random.default_rng(0)
+    src = rng.integers(1, CFG.src_vocab_size, (n, CFG.src_length)).astype(np.int32)
+    tgt_in = np.concatenate(
+        [np.zeros((n, 1), np.int32), src[:, :-1] % CFG.tgt_vocab_size], axis=1)
+    return [src, tgt_in], (src % CFG.tgt_vocab_size)
+
+
+if __name__ == "__main__":
+    _common.run_example(
+        "nmt", build, data,
+        LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        [MetricsType.ACCURACY, MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY],
+        optimizer=AdamOptimizer(alpha=0.005))

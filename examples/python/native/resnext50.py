@@ -1,0 +1,26 @@
+"""ResNeXt-50 32x4d (reference: examples/cpp/resnext50/resnext.cc)."""
+import numpy as np
+
+from flexflow_tpu import LossType, MetricsType, SGDOptimizer
+from flexflow_tpu.models import build_resnext50
+
+import _common
+
+
+def build(ff, bs):
+    build_resnext50(ff, bs, num_classes=10, image_size=224)
+
+
+def data(n, config):
+    n = min(n, 64)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, 3, 224, 224)).astype(np.float32)
+    y = rng.integers(0, 10, (n, 1)).astype(np.int32)
+    return x, y
+
+
+if __name__ == "__main__":
+    _common.run_example(
+        "resnext50", build, data,
+        LossType.SPARSE_CATEGORICAL_CROSSENTROPY, [MetricsType.ACCURACY],
+        optimizer=SGDOptimizer(lr=0.01, momentum=0.9))

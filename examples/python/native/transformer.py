@@ -1,0 +1,31 @@
+"""The flagship benchmark transformer (reference:
+examples/cpp/Transformer/transformer.cc — seq 512 / hidden 1024 /
+16 heads / 12 layers; bench.py runs this exact config)."""
+import numpy as np
+
+from flexflow_tpu import LossType, SGDOptimizer
+from flexflow_tpu.models import TransformerConfig, build_transformer
+
+import _common
+
+CFG = TransformerConfig(hidden_size=1024, num_heads=16, num_layers=12,
+                        sequence_length=512)
+
+
+def build(ff, bs):
+    build_transformer(ff, bs, CFG)
+
+
+def data(n, config):
+    n = min(n, 64)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, CFG.sequence_length, CFG.hidden_size)).astype(np.float32)
+    y = rng.normal(size=(n, CFG.sequence_length, 1)).astype(np.float32)
+    return x, y
+
+
+if __name__ == "__main__":
+    _common.run_example(
+        "transformer", build, data,
+        LossType.MEAN_SQUARED_ERROR_AVG_REDUCE, [],
+        optimizer=SGDOptimizer(lr=0.01))

@@ -1,0 +1,30 @@
+"""XDL click-through model (reference: examples/cpp/XDL/xdl.cc)."""
+import numpy as np
+
+from flexflow_tpu import LossType, MetricsType, SGDOptimizer
+from flexflow_tpu.models import XDLConfig, build_xdl
+
+import _common
+
+CFG = XDLConfig(embedding_size=[10000] * 4)
+
+
+def build(ff, bs):
+    strat = {"vocab": "model"} if ff.config.enable_parameter_parallel else None
+    build_xdl(ff, bs, CFG, embedding_strategy=strat)
+
+
+def data(n, config):
+    rng = np.random.default_rng(0)
+    xs = [rng.integers(0, 10000, (n, 1)).astype(np.int32)
+          for _ in CFG.embedding_size]
+    y = rng.integers(0, 2, (n, 1)).astype(np.float32)
+    return xs, y
+
+
+if __name__ == "__main__":
+    _common.run_example(
+        "xdl", build, data,
+        LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+        [MetricsType.MEAN_SQUARED_ERROR],
+        optimizer=SGDOptimizer(lr=0.01))

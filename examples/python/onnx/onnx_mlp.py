@@ -1,0 +1,23 @@
+"""ONNX import example (reference: examples/python/onnx/). Requires the
+`onnx` package (not bundled); exports a torch MLP to ONNX and serves it
+through the serving engine's from_onnx path."""
+import numpy as np
+import torch
+import torch.nn as nn
+
+from flexflow_tpu import FFConfig
+from flexflow_tpu.serving import InferenceEngine
+
+if __name__ == "__main__":
+    try:
+        import onnx  # noqa: F401
+    except ImportError:
+        raise SystemExit("onnx not installed; this example is gated")
+    mod = nn.Sequential(nn.Linear(10, 32), nn.ReLU(), nn.Linear(32, 4))
+    torch.onnx.export(mod, torch.zeros(4, 10), "/tmp/mlp.onnx")
+    eng = InferenceEngine()
+    eng.register_onnx("/tmp/mlp.onnx", name="mlp",
+                      config=FFConfig(batch_size=4))
+    out = eng.infer("mlp", [np.zeros(10, np.float32)])
+    print("served ONNX model output:", out.shape)
+    eng.stop()

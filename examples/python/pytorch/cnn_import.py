@@ -1,0 +1,37 @@
+"""torch.fx import of a CNN (reference: examples/python/pytorch/ —
+torch_to_flexflow + PyTorchModel replay)."""
+import numpy as np
+import torch
+import torch.nn as nn
+
+from flexflow_tpu import DataType, FFConfig, FFModel, LossType, SGDOptimizer
+from flexflow_tpu.torch_frontend import PyTorchModel, copy_weights
+
+
+class SmallCNN(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.conv1 = nn.Conv2d(3, 16, 3, padding=1)
+        self.pool = nn.MaxPool2d(2)
+        self.fc = nn.Linear(16 * 16 * 16, 10)
+
+    def forward(self, x):
+        x = self.pool(torch.relu(self.conv1(x)))
+        x = torch.flatten(x, 1)
+        return self.fc(x)
+
+
+if __name__ == "__main__":
+    module = SmallCNN().eval()
+    pm = PyTorchModel(module)
+    ff = FFModel(FFConfig(batch_size=8))
+    x = ff.create_tensor((8, 3, 32, 32), DataType.FLOAT, name="image")
+    (out,) = pm.apply(ff, [x])
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY, metrics=[])
+    copy_weights(ff, module, pm.module_paths)
+    xs = np.random.default_rng(0).normal(size=(8, 3, 32, 32)).astype(np.float32)
+    got = np.asarray(ff.compiled.forward_fn(ff.compiled.params, xs))
+    with torch.no_grad():
+        ref = module(torch.tensor(xs)).numpy()
+    print("imported CNN max|diff| vs torch:", float(np.abs(got - ref).max()))

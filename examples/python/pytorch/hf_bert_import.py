@@ -1,0 +1,29 @@
+"""HF-aware import of a transformers BERT (reference:
+python/flexflow/torch/model.py:2430 HF tracing; here with shape
+propagation + constant folding + SDPA decomposition, hf.py)."""
+import numpy as np
+import torch
+from transformers import BertConfig, BertModel
+
+from flexflow_tpu import DataType, FFConfig, FFModel, SGDOptimizer
+from flexflow_tpu.torch_frontend import PyTorchModel, copy_weights
+
+if __name__ == "__main__":
+    B, S = 4, 32
+    cfg = BertConfig(hidden_size=128, num_hidden_layers=4,
+                     num_attention_heads=4, intermediate_size=512,
+                     vocab_size=1000, max_position_embeddings=S * 2,
+                     hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+    m = BertModel(cfg).eval()
+    pm = PyTorchModel(m, input_names=["input_ids"], batch_size=B, seq_length=S)
+    ff = FFModel(FFConfig(batch_size=B))
+    x = ff.create_tensor((B, S), DataType.INT32, name="input_ids")
+    outs = pm.apply(ff, [x])
+    ff.compile(optimizer=SGDOptimizer(lr=0.01), loss_type=None, metrics=[])
+    copy_weights(ff, m, pm.module_paths)
+    ids = np.random.default_rng(0).integers(0, 1000, (B, S)).astype(np.int32)
+    got = np.asarray(ff.compiled.forward_fn(ff.compiled.params, ids))
+    with torch.no_grad():
+        ref = m(input_ids=torch.tensor(ids, dtype=torch.long)).pooler_output.numpy()
+    print("imported BERT pooler max|diff| vs torch:",
+          float(np.abs(got - ref).max()))

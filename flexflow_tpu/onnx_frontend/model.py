@@ -222,3 +222,179 @@ class ONNXModel:
 
     def handleIdentity(self, ff, node, env):
         return ff.identity(env[node.input[0]], name=node.name or None)
+
+    def handleDiv(self, ff, node, env):
+        return ff.divide(env[node.input[0]], env[node.input[1]],
+                         name=node.name or None)
+
+    def handleMax(self, ff, node, env):
+        return ff.max(env[node.input[0]], env[node.input[1]],
+                      name=node.name or None)
+
+    def handleMin(self, ff, node, env):
+        return ff.min(env[node.input[0]], env[node.input[1]],
+                      name=node.name or None)
+
+    def handleExp(self, ff, node, env):
+        return ff.exp(env[node.input[0]], name=node.name or None)
+
+    def handleSin(self, ff, node, env):
+        return ff.sin(env[node.input[0]], name=node.name or None)
+
+    def handleCos(self, ff, node, env):
+        return ff.cos(env[node.input[0]], name=node.name or None)
+
+    def handleElu(self, ff, node, env):
+        a = _attrs(node)
+        if float(a.get("alpha", 1.0)) != 1.0:
+            raise ValueError(f"Elu {node.name!r}: alpha != 1 unsupported")
+        return ff.elu(env[node.input[0]], name=node.name or None)
+
+    def handlePow(self, ff, node, env):
+        if node.input[1] not in self.inits:
+            raise ValueError(f"Pow {node.name!r}: dynamic exponent unsupported")
+        e = float(np.asarray(self.inits[node.input[1]]).reshape(-1)[0])
+        return ff.pow(env[node.input[0]], e, name=node.name or None)
+
+    def handleSqrt(self, ff, node, env):
+        return ff.pow(env[node.input[0]], 0.5, name=node.name or None)
+
+    def handleNeg(self, ff, node, env):
+        return ff.scalar_multiply(env[node.input[0]], -1.0,
+                                  name=node.name or None)
+
+    def _reduce_axes(self, node, env):
+        a = _attrs(node)
+        axes = list(a.get("axes", []))
+        if not axes and len(node.input) > 1 and node.input[1]:
+            if node.input[1] not in self.inits:
+                raise ValueError(
+                    f"{node.op_type} {node.name!r}: dynamic axes unsupported")
+            axes = self.inits[node.input[1]].tolist()
+        if not axes:
+            # ONNX default: no axes means reduce ALL dims
+            axes = list(range(len(env[node.input[0]].dims)))
+        return axes, bool(a.get("keepdims", 1))
+
+    def handleReduceMean(self, ff, node, env):
+        axes, keep = self._reduce_axes(node, env)
+        return ff.mean(env[node.input[0]], axes, keepdims=keep,
+                       name=node.name or None)
+
+    def handleReduceSum(self, ff, node, env):
+        axes, keep = self._reduce_axes(node, env)
+        return ff.reduce_sum(env[node.input[0]], axes, keepdims=keep,
+                             name=node.name or None)
+
+    def handleGlobalAveragePool(self, ff, node, env):
+        # NCHW: mean over H, W keeping dims (reference examples use this
+        # before the classifier head)
+        return ff.mean(env[node.input[0]], [2, 3], keepdims=True,
+                       name=node.name or None)
+
+    def handleCast(self, ff, node, env):
+        from ..ffconst import DataType as DT
+
+        a = _attrs(node)
+        # onnx TensorProto dtype codes → framework dtypes
+        m = {1: DT.FLOAT, 6: DT.INT32, 7: DT.INT32, 9: DT.BOOL,
+             10: DT.HALF, 11: DT.FLOAT, 16: DT.BFLOAT16}
+        to = m.get(int(a.get("to", 1)))
+        if to is None:
+            raise ValueError(f"Cast {node.name!r}: dtype {a.get('to')} unsupported")
+        return ff.cast(env[node.input[0]], to, name=node.name or None)
+
+    def handleSqueeze(self, ff, node, env):
+        x = env[node.input[0]]
+        axes = _attrs(node).get("axes")
+        if axes is None and len(node.input) > 1 and node.input[1] in self.inits:
+            axes = self.inits[node.input[1]].tolist()
+        nd = len(x.dims)
+        axes = ([a % nd for a in axes] if axes is not None
+                else [i for i, s in enumerate(x.dims) if s == 1])
+        shape = [s for i, s in enumerate(x.dims) if i not in axes]
+        return ff.reshape(x, shape, name=node.name or None)
+
+    def handleUnsqueeze(self, ff, node, env):
+        x = env[node.input[0]]
+        axes = _attrs(node).get("axes")
+        if axes is None and len(node.input) > 1 and node.input[1] in self.inits:
+            axes = self.inits[node.input[1]].tolist()
+        if axes is None:
+            raise ValueError(
+                f"Unsqueeze {node.name!r}: dynamic axes unsupported")
+        out_nd = len(x.dims) + len(axes)
+        axes = sorted(a % out_nd for a in axes)
+        shape = list(x.dims)
+        for a in axes:
+            shape.insert(a, 1)
+        return ff.reshape(x, shape, name=node.name or None)
+
+    def handleSlice(self, ff, node, env):
+        """Opset ≥10: starts/ends/axes/steps as initializer inputs."""
+        x = env[node.input[0]]
+
+        def init(i, default):
+            if len(node.input) > i and node.input[i] and node.input[i] in self.inits:
+                return self.inits[node.input[i]].tolist()
+            return default
+        starts = init(1, None)
+        ends = init(2, None)
+        if starts is None or ends is None:
+            a = _attrs(node)  # opset 1 fallback: attributes
+            starts = list(a.get("starts", []))
+            ends = list(a.get("ends", []))
+            if not starts and not ends:
+                raise ValueError(
+                    f"Slice {node.name!r}: dynamic starts/ends unsupported "
+                    f"(export with constant slice bounds)")
+            axes = list(a.get("axes", range(len(starts))))
+            steps = [1] * len(starts)
+        else:
+            axes = init(3, list(range(len(starts))))
+            steps = init(4, [1] * len(starts))
+        nd = len(x.dims)
+        items = [{"kind": "slice", "start": None, "stop": None, "step": None}
+                 for _ in range(nd)]
+        for s, e, ax, st in zip(starts, ends, axes, steps):
+            # onnx uses INT_MAX/MIN sentinels for open ends
+            big = 1 << 30
+            items[ax % nd] = {
+                "kind": "slice",
+                "start": None if abs(int(s)) >= big else int(s),
+                "stop": None if abs(int(e)) >= big else int(e),
+                "step": int(st)}
+        return ff.slice_tensor(x, items, name=node.name or None)
+
+    def handleGather(self, ff, node, env):
+        """Embedding lookup when the data input is an initializer matrix
+        (the standard exported-embedding pattern); tensor gather otherwise."""
+        a = _attrs(node)
+        axis = int(a.get("axis", 0))
+        if node.input[0] in self.inits:
+            if axis != 0:
+                raise ValueError(
+                    f"Gather {node.name!r}: initializer data with "
+                    f"axis={axis} unsupported (only axis=0 embedding lookup)")
+            w = self.inits[node.input[0]]
+            return ff.embedding(env[node.input[1]], int(w.shape[0]),
+                                int(w.shape[1]), name=node.name or None)
+        return ff.gather(env[node.input[0]], env[node.input[1]], axis,
+                         name=node.name or None)
+
+    def handleLayerNormalization(self, ff, node, env):
+        a = _attrs(node)
+        x = env[node.input[0]]
+        axis = int(a.get("axis", -1)) % len(x.dims)
+        # onnx normalizes over ALL dims in [axis, rank)
+        axes = list(range(axis, len(x.dims)))
+        return ff.layer_norm(x, axes=axes,
+                             elementwise_affine=len(node.input) > 1,
+                             eps=float(a.get("epsilon", 1e-5)),
+                             name=node.name or None)
+
+    def handleLSTM(self, ff, node, env):
+        raise ValueError(
+            f"LSTM {node.name!r}: import the torch module directly "
+            f"(ff.lstm / torch frontend) — onnx LSTM's packed layout is "
+            f"not supported")

@@ -1,0 +1,35 @@
+#!/usr/bin/env python
+"""Render an exported strategy file (--export-strategy) as graphviz dot.
+
+reference: the --compgraph / strategy dot exports (model.cc:3666-3674);
+this standalone tool renders a saved strategy JSON without rebuilding the
+model.
+
+Usage: python tools/strategy_to_dot.py strategy.json [out.dot]
+"""
+
+import json
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from flexflow_tpu.utils.dot import DotFile  # noqa: E402
+
+
+def main():
+    if len(sys.argv) < 2:
+        raise SystemExit(__doc__)
+    with open(sys.argv[1]) as f:
+        data = json.load(f)
+    strategies = data.get("strategies", data)
+    d = DotFile("strategy")
+    for layer, strat in strategies.items():
+        body = ", ".join(f"{k}={v}" for k, v in sorted(strat.items())
+                         if not k.startswith("_")) or "data-parallel"
+        d.add_node(layer, f"{layer}: {body}", extra={"shape": "box"})
+    out = sys.argv[2] if len(sys.argv) > 2 else "/dev/stdout"
+    d.write(out)
+
+
+if __name__ == "__main__":
+    main()

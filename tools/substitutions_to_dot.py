@@ -1,0 +1,37 @@
+#!/usr/bin/env python
+"""Render a substitution-rule JSON file as graphviz dot.
+
+reference: tools/substitutions_to_dot (C++ tool rendering the
+graph_subst_*.json rule library). Here the rule format is the framework's
+own (search/substitution.py load_substitution_rules): per-op strategy
+templates; each rule renders as op -> strategy-binding node.
+
+Usage: python tools/substitutions_to_dot.py rules.json [out.dot]
+"""
+
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from flexflow_tpu.search.substitution import load_substitution_rules  # noqa: E402
+from flexflow_tpu.utils.dot import DotFile  # noqa: E402
+
+
+def main():
+    if len(sys.argv) < 2:
+        raise SystemExit(__doc__)
+    rules = load_substitution_rules(sys.argv[1])
+    d = DotFile("substitutions")
+    for op_name, cands in rules.items():
+        d.add_node(op_name, f"{op_name}", extra={"shape": "box"})
+        for i, c in enumerate(cands):
+            label = ", ".join(f"{k}={v}" for k, v in sorted(c.items())) or "dp"
+            nid = f"{op_name}__r{i}"
+            d.add_node(nid, label)
+            d.add_edge(op_name, nid)
+    out = sys.argv[2] if len(sys.argv) > 2 else "/dev/stdout"
+    d.write(out)
+
+
+if __name__ == "__main__":
+    main()
