@@ -23,13 +23,15 @@ from flexflow_tpu import FFConfig, FFModel
 
 def run_example(name, build, make_data, loss_type, metrics,
                 optimizer=None, argv=None):
-    """build(ff, batch_size) -> None (constructs the graph);
-    make_data(n, config) -> (xs: list[np.ndarray] | np.ndarray, y)."""
+    """build(ff, batch_size) -> anything (constructs the graph; its return
+    value — e.g. the created input tensors — is passed through to
+    make_data); make_data(n, config, built) ->
+    (xs: list[np.ndarray] | np.ndarray, y)."""
     config = FFConfig.parse_args(argv if argv is not None else sys.argv[1:])
     ff = FFModel(config)
-    build(ff, config.batch_size)
+    built = build(ff, config.batch_size)
     ff.compile(optimizer=optimizer, loss_type=loss_type, metrics=metrics)
-    xs, y = make_data(max(256, config.batch_size * 4), config)
+    xs, y = make_data(max(256, config.batch_size * 4), config, built)
     if not isinstance(xs, (list, tuple)):
         xs = [xs]
 

@@ -13,7 +13,7 @@ def build(ff, bs):
     build_alexnet(ff, bs, num_classes=10, image_size=224)
 
 
-def data(n, config):
+def data(n, config, built=None):
     (xt, yt), _ = datasets.cifar10.load_data()
     x = (xt[:n] / 255.0).astype(np.float32)
     x = np.repeat(np.repeat(x, 7, axis=2), 7, axis=3)  # 32->224

@@ -15,7 +15,7 @@ def build(ff, bs):
     build_transformer(ff, bs, CFG)
 
 
-def data(n, config):
+def data(n, config, built=None):
     rng = np.random.default_rng(0)
     x = rng.normal(size=(n, CFG.sequence_length, CFG.hidden_size)).astype(np.float32)
     y = rng.normal(size=(n, CFG.sequence_length, 1)).astype(np.float32)

@@ -1,29 +1,29 @@
 """CANDLE-Uno drug-response model (reference:
 examples/cpp/candle_uno/candle_uno.cc)."""
-import sys
-import time
-
 import numpy as np
 
-from flexflow_tpu import FFConfig, FFModel, LossType, MetricsType, SGDOptimizer
+from flexflow_tpu import LossType, MetricsType, SGDOptimizer
 from flexflow_tpu.models import CandleUnoConfig, build_candle_uno
 
-if __name__ == "__main__":
-    config = FFConfig.parse_args(sys.argv[1:])
-    ff = FFModel(config)
-    inputs, out = build_candle_uno(ff, config.batch_size, CandleUnoConfig())
-    ff.compile(optimizer=SGDOptimizer(lr=0.001),
-               loss_type=LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
-               metrics=[MetricsType.MEAN_SQUARED_ERROR])
+import _common
+
+
+def build(ff, bs):
+    inputs, out = build_candle_uno(ff, bs, CandleUnoConfig())
+    return inputs
+
+
+def data(n, config, built=None):
     rng = np.random.default_rng(0)
-    n = max(256, config.batch_size * 4)
     xs = [rng.normal(size=(n,) + t.dims[1:]).astype(np.float32)
-          for t in inputs]
+          for t in built]
     y = rng.normal(size=(n, 1)).astype(np.float32)
-    print(f"[candle_uno] devices={config.num_devices} "
-          f"batch={config.batch_size} epochs={config.epochs}")
-    start = time.perf_counter()
-    ff.fit(xs, y, verbose=True)
-    elapsed = time.perf_counter() - start
-    print(f"ELAPSED TIME = {elapsed:.4f}s, "
-          f"THROUGHPUT = {n * config.epochs / elapsed:.2f} samples/s")
+    return xs, y
+
+
+if __name__ == "__main__":
+    _common.run_example(
+        "candle_uno", build, data,
+        LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+        [MetricsType.MEAN_SQUARED_ERROR],
+        optimizer=SGDOptimizer(lr=0.001))

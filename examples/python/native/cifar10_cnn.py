@@ -20,7 +20,7 @@ def build(ff, bs):
     ff.softmax(t)
 
 
-def data(n, config):
+def data(n, config, built=None):
     (xt, yt), _ = datasets.cifar10.load_data()
     x = (xt[:n] / 255.0).astype(np.float32)
     return x, yt[:n].astype(np.int32).reshape(-1, 1)

@@ -15,7 +15,7 @@ def build(ff, bs):
     build_dlrm(ff, bs, CFG, param_axis=axis)
 
 
-def data(n, config):
+def data(n, config, built=None):
     rng = np.random.default_rng(0)
     xs = [rng.integers(0, 10000, (n, CFG.embedding_bag_size)).astype(np.int32)
           for _ in CFG.embedding_size]

@@ -11,7 +11,7 @@ def build(ff, bs):
     build_inception_v3(ff, bs, num_classes=10, image_size=299)
 
 
-def data(n, config):
+def data(n, config, built=None):
     n = min(n, 64)  # 299x299 inputs are big; keep the host batch modest
     rng = np.random.default_rng(0)
     x = rng.normal(size=(n, 3, 299, 299)).astype(np.float32)

@@ -15,7 +15,7 @@ def build(ff, bs):
     build_moe_mnist(ff, bs, CFG)
 
 
-def data(n, config):
+def data(n, config, built=None):
     (xt, yt), _ = datasets.mnist.load_data()
     x = (xt[:n].reshape(-1, 784) / 255.0).astype(np.float32)
     return x, yt[:n].astype(np.int32).reshape(-1, 1)

@@ -15,7 +15,7 @@ def build(ff, bs):
     build_nmt(ff, bs, CFG)
 
 
-def data(n, config):
+def data(n, config, built=None):
     rng = np.random.default_rng(0)
     src = rng.integers(1, CFG.src_vocab_size, (n, CFG.src_length)).astype(np.int32)
     tgt_in = np.concatenate(

@@ -11,7 +11,7 @@ def build(ff, bs):
     build_resnext50(ff, bs, num_classes=10, image_size=224)
 
 
-def data(n, config):
+def data(n, config, built=None):
     n = min(n, 64)
     rng = np.random.default_rng(0)
     x = rng.normal(size=(n, 3, 224, 224)).astype(np.float32)

@@ -16,7 +16,7 @@ def build(ff, bs):
     build_transformer(ff, bs, CFG)
 
 
-def data(n, config):
+def data(n, config, built=None):
     n = min(n, 64)
     rng = np.random.default_rng(0)
     x = rng.normal(size=(n, CFG.sequence_length, CFG.hidden_size)).astype(np.float32)

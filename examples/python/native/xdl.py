@@ -14,7 +14,7 @@ def build(ff, bs):
     build_xdl(ff, bs, CFG, embedding_strategy=strat)
 
 
-def data(n, config):
+def data(n, config, built=None):
     rng = np.random.default_rng(0)
     xs = [rng.integers(0, 10000, (n, 1)).astype(np.int32)
           for _ in CFG.embedding_size]

@@ -12,3 +12,4 @@ from .moe import build_moe_mnist, MoeConfig
 from .xdl import build_xdl, XDLConfig
 from .candle_uno import build_candle_uno, CandleUnoConfig
 from .nmt import build_nmt, NMTConfig
+from .gpt import build_gpt, GPTConfig

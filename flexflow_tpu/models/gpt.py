@@ -1,0 +1,63 @@
+"""GPT-style decoder-only causal language model.
+
+No direct reference analog — the reference's Transformer example is an
+encoder proxy (examples/cpp/Transformer) and its aux inference product
+(triton/) served CNNs. A complete modern framework needs a causal LM with
+incremental decoding (serving/generation.py), so the zoo includes one:
+token + learned position embeddings, pre-LN blocks (causal multi-head
+attention, GELU MLP) with residuals, final LN, tied-free vocab head.
+
+Built entirely on the builder API, so the same graph trains (teacher-
+forced CE over shifted tokens), imports into the search, and drives the
+KV-cache generator.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from ..ffconst import ActiMode, DataType
+
+
+@dataclasses.dataclass
+class GPTConfig:
+    vocab_size: int = 32000
+    max_positions: int = 1024
+    hidden_size: int = 512
+    num_heads: int = 8
+    num_layers: int = 6
+    mlp_ratio: int = 4
+
+
+def build_gpt(ff, batch_size: int, seq_length: int,
+              cfg: GPTConfig = GPTConfig(), tp_axis=None):
+    """Returns (tokens, positions, logits). ``logits``: (B, S, vocab) raw
+    (train with SPARSE_CATEGORICAL_CROSSENTROPY's rank-3 token path)."""
+    tokens = ff.create_tensor((batch_size, seq_length), DataType.INT32,
+                              name="tokens")
+    positions = ff.create_tensor((batch_size, seq_length), DataType.INT32,
+                                 name="positions")
+    h = ff.add(
+        ff.embedding(tokens, cfg.vocab_size, cfg.hidden_size,
+                     name="wte"),
+        ff.embedding(positions, cfg.max_positions, cfg.hidden_size,
+                     name="wpe"),
+        name="embed_sum")
+    heads_strategy = {"heads": tp_axis} if tp_axis else None
+    mlp_out_strategy = {"out": tp_axis} if tp_axis else None
+    mlp_in_strategy = {"in": tp_axis} if tp_axis else None
+    for i in range(cfg.num_layers):
+        ln1 = ff.layer_norm(h, axes=[-1], name=f"block{i}_ln1")
+        attn = ff.multihead_attention(
+            ln1, ln1, ln1, cfg.hidden_size, cfg.num_heads, causal=True,
+            name=f"block{i}_attn", strategy=heads_strategy)
+        h = ff.add(h, attn, name=f"block{i}_res1")
+        ln2 = ff.layer_norm(h, axes=[-1], name=f"block{i}_ln2")
+        m = ff.dense(ln2, cfg.mlp_ratio * cfg.hidden_size, ActiMode.GELU,
+                     name=f"block{i}_mlp_up", strategy=mlp_out_strategy)
+        m = ff.dense(m, cfg.hidden_size, name=f"block{i}_mlp_down",
+                     strategy=mlp_in_strategy)
+        h = ff.add(h, m, name=f"block{i}_res2")
+    h = ff.layer_norm(h, axes=[-1], name="ln_f")
+    logits = ff.dense(h, cfg.vocab_size, use_bias=False, name="lm_head")
+    return tokens, positions, logits

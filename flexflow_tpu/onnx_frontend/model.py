@@ -307,7 +307,10 @@ class ONNXModel:
     def handleSqueeze(self, ff, node, env):
         x = env[node.input[0]]
         axes = _attrs(node).get("axes")
-        if axes is None and len(node.input) > 1 and node.input[1] in self.inits:
+        if axes is None and len(node.input) > 1 and node.input[1]:
+            if node.input[1] not in self.inits:
+                raise ValueError(
+                    f"Squeeze {node.name!r}: dynamic axes unsupported")
             axes = self.inits[node.input[1]].tolist()
         nd = len(x.dims)
         axes = ([a % nd for a in axes] if axes is not None
@@ -335,7 +338,12 @@ class ONNXModel:
         x = env[node.input[0]]
 
         def init(i, default):
-            if len(node.input) > i and node.input[i] and node.input[i] in self.inits:
+            if len(node.input) > i and node.input[i]:
+                if node.input[i] not in self.inits:
+                    raise ValueError(
+                        f"Slice {node.name!r}: dynamic input "
+                        f"{node.input[i]!r} unsupported (export with "
+                        f"constant slice parameters)")
                 return self.inits[node.input[i]].tolist()
             return default
         starts = init(1, None)

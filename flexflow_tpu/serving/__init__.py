@@ -18,5 +18,7 @@ simplification), and the pieces that remain are the serving-specific ones:
 """
 
 from .engine import InferenceEngine, InferenceRequest, ModelInstance
+from .generation import Generator
 
-__all__ = ["InferenceEngine", "InferenceRequest", "ModelInstance"]
+__all__ = ["InferenceEngine", "InferenceRequest", "ModelInstance",
+           "Generator"]

@@ -1,0 +1,210 @@
+"""Autoregressive generation with KV caches over a compiled model graph.
+
+No reference analog (the reference predates LLM serving; its triton/
+prototype served batch CNN inference) — this is the modern-completeness
+piece on top of the serving engine. TPU-native design:
+
+* the decode step is ONE jitted function per block length (prefill length
+  and 1), produced by walking the compiled model's op graph — every op
+  runs its ordinary shape-polymorphic ``forward`` on the (B, S_blk, ·)
+  activations EXCEPT self-attention, which reads/writes a static-shape
+  KV cache via ``lax.dynamic_update_slice`` (XLA-friendly: no growing
+  shapes, position masking instead of shape change);
+* the cache is a pytree {attention op name: (k, v)} of
+  (B, max_length, H, D) arrays, donated through the decode step so XLA
+  updates it in place;
+* sampling (greedy / temperature) happens on host between steps, like
+  every production TPU decode loop.
+
+Works for any builder graph whose attention ops are causal
+self-attention (models/gpt.py; an imported HF decoder fits the same
+contract).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..ffconst import OpType
+from ..core.op import LowerCtx
+
+
+def _attn_with_cache(op, weights, x, kcache, vcache, offset):
+    """Causal self-attention over [cache ∪ current block].
+
+    ``offset``: traced scalar — absolute position of the block's first
+    token. Scores span the FULL static cache length; future/unwritten
+    positions are masked by position comparison (static shapes, jit-safe).
+    """
+    qh = jnp.einsum("bse,ehd->bshd", x, weights["wq"])
+    kh = jnp.einsum("bse,ehd->bshd", x, weights["wk"])
+    vh = jnp.einsum("bse,ehd->bshd", x, weights["wv"])
+    if op.use_bias:
+        qh = qh + weights["bq"]
+        kh = kh + weights["bk"]
+        vh = vh + weights["bv"]
+    kcache = jax.lax.dynamic_update_slice(kcache, kh, (0, offset, 0, 0))
+    vcache = jax.lax.dynamic_update_slice(vcache, vh, (0, offset, 0, 0))
+    scale = 1.0 / math.sqrt(op.head_dim)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", qh, kcache) * scale
+    s_blk = x.shape[1]
+    qpos = offset + jax.lax.iota(jnp.int32, s_blk)             # (S_blk,)
+    kpos = jax.lax.iota(jnp.int32, kcache.shape[1])            # (max_len,)
+    mask = kpos[None, :] <= qpos[:, None]                      # causal+written
+    scores = jnp.where(mask[None, None, :, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    ctxv = jnp.einsum("bhqk,bkhd->bqhd", probs, vcache)
+    out = jnp.einsum("bqhd,hde->bqe", ctxv, weights["wo"])
+    if op.use_bias:
+        out = out + weights["bo"]
+    return out, kcache, vcache
+
+
+class Generator:
+    """KV-cache incremental decoding for a compiled causal LM.
+
+    ``cm``: a CompiledModel whose graph takes (tokens, positions) int32
+    inputs and produces (B, S, vocab) logits, with causal self-attention
+    ops (models/gpt.py's contract).
+    """
+
+    def __init__(self, ff, max_length: int, batch_size: Optional[int] = None):
+        cm = ff.compiled
+        if cm is None:
+            raise ValueError("compile() the model before generating")
+        self._cm = cm
+        self.max_length = int(max_length)
+        self.batch_size = batch_size or cm.input_tensors[0].dims[0]
+        self._attn_ops = [op for op in cm.ops
+                          if op.op_type is OpType.MULTIHEAD_ATTENTION]
+        for op in self._attn_ops:
+            ids = {t.tensor_id for t in op.layer.inputs}
+            if len(ids) != 1 or not op.causal:
+                raise ValueError(
+                    f"{op.name}: generation needs causal SELF-attention")
+        self._token_id = cm.input_tensors[0]
+        self._pos_id = cm.input_tensors[1]
+        # the position-embedding table bounds how far the MODEL can decode;
+        # jnp.take clamps out-of-range ids silently, so enforce it here
+        pos_tid = self._pos_id.tensor_id
+        for op in cm.ops:
+            if (op.op_type is OpType.EMBEDDING
+                    and op.layer.inputs[0].tensor_id == pos_tid):
+                cap = op.attrs["num_entries"]
+                if self.max_length > cap:
+                    raise ValueError(
+                        f"max_length {self.max_length} exceeds the position "
+                        f"embedding capacity {cap} ({op.name})")
+        self._step = jax.jit(self._block_step, donate_argnums=(2,))
+        self._exec_params_cache = None  # (id(params), cast copy)
+
+    def _exec_params(self):
+        """Params in the decode compute dtype. bf16: cast ONCE per params
+        version (not per token inside the jitted step)."""
+        params = self._cm.params
+        if self._cm.config.compute_dtype not in ("bf16", "bfloat16"):
+            return params
+        cached = self._exec_params_cache
+        if cached is not None and cached[0] is params:
+            return cached[1]
+        cast = jax.tree_util.tree_map(
+            lambda v: v.astype(jnp.bfloat16)
+            if jnp.issubdtype(v.dtype, jnp.floating) else v, params)
+        self._exec_params_cache = (params, cast)
+        return cast
+
+    # ---- cache ------------------------------------------------------------
+    def init_cache(self) -> Dict[str, Tuple[jnp.ndarray, jnp.ndarray]]:
+        cache = {}
+        dt = (jnp.bfloat16 if self._cm.config.compute_dtype in
+              ("bf16", "bfloat16") else jnp.float32)
+        for op in self._attn_ops:
+            shape = (self.batch_size, self.max_length, op.num_heads,
+                     op.head_dim)
+            cache[op.name] = (jnp.zeros(shape, dt), jnp.zeros(shape, dt))
+        return cache
+
+    # ---- one block step (prefill: S=prompt, decode: S=1) -----------------
+    def _block_step(self, params, tokens, cache, offset):
+        b, s_blk = tokens.shape
+        positions = offset + jax.lax.iota(jnp.int32, s_blk)[None, :]
+        positions = jnp.broadcast_to(positions, (b, s_blk))
+        ctx = LowerCtx(mesh=None, training=False, aux_losses=[],
+                       compute_dtype=None)
+        acts = {self._token_id.tensor_id: tokens,
+                self._pos_id.tensor_id: positions}
+        new_cache = dict(cache)
+        for op in self._cm.ops:
+            ins = [acts[t.tensor_id] for t in op.layer.inputs]
+            p = params.get(op.name, {})
+            if op.op_type is OpType.MULTIHEAD_ATTENTION:
+                k, v = new_cache[op.name]
+                out, k, v = _attn_with_cache(op, p, ins[0], k, v, offset)
+                new_cache[op.name] = (k, v)
+                outs = [out]
+            else:
+                outs = op.forward(ctx, ins, p)
+            for out, t in zip(outs, op.layer.outputs):
+                acts[t.tensor_id] = out
+        logits = acts[self._cm.logits_tensor.tensor_id]
+        return logits.astype(jnp.float32), new_cache
+
+    # ---- public API --------------------------------------------------------
+    def prefill(self, prompt_ids: np.ndarray, cache=None, offset: int = 0):
+        """Run a prompt block starting at absolute position ``offset``
+        (pass the previous round's end position + its cache to continue a
+        conversation). Returns (last-token logits, cache, end position)."""
+        prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
+        if cache is None:
+            cache = self.init_cache()
+        elif offset == 0:
+            raise ValueError(
+                "continuing with an existing cache requires the offset the "
+                "previous round ended at (offset=0 would overwrite it)")
+        logits, cache = self._step(self._exec_params(), prompt_ids, cache,
+                                   jnp.int32(offset))
+        return logits[:, -1, :], cache, offset + prompt_ids.shape[1]
+
+    def generate(self, prompt_ids: np.ndarray, max_new_tokens: int,
+                 temperature: float = 0.0, seed: int = 0,
+                 eos_id: Optional[int] = None) -> np.ndarray:
+        """Greedy (temperature=0) or sampled decoding. ``prompt_ids``:
+        (B, S_prompt) int32. Returns (B, S_prompt + new) token ids."""
+        prompt_ids = np.asarray(prompt_ids, np.int32)
+        b, s0 = prompt_ids.shape
+        if s0 + max_new_tokens > self.max_length:
+            raise ValueError(
+                f"{s0} prompt + {max_new_tokens} new > max_length "
+                f"{self.max_length}")
+        logits, cache, pos = self.prefill(prompt_ids)
+        exec_params = self._exec_params()
+        rng = np.random.default_rng(seed)
+        out = [prompt_ids]
+        done = np.zeros(b, bool)
+        for _ in range(max_new_tokens):
+            lg = np.asarray(logits)
+            if temperature > 0:
+                p = np.exp((lg - lg.max(-1, keepdims=True)) / temperature)
+                p /= p.sum(-1, keepdims=True)
+                nxt = np.array([rng.choice(lg.shape[-1], p=p[i])
+                                for i in range(b)], np.int32)
+            else:
+                nxt = lg.argmax(-1).astype(np.int32)
+            if eos_id is not None:
+                nxt = np.where(done, eos_id, nxt)
+                done |= nxt == eos_id
+            out.append(nxt[:, None])
+            if eos_id is not None and done.all():
+                break
+            step_logits, cache = self._step(
+                exec_params, jnp.asarray(nxt[:, None]), cache,
+                jnp.int32(pos))
+            logits = step_logits[:, -1, :]
+            pos += 1
+        return np.concatenate(out, axis=1)
