@@ -86,6 +86,11 @@ class FFConfig:
     # dtype flag; bf16 compute is the TPU-native upgrade, the MXU's native
     # matmul input type). None/"float32" = full precision.
     compute_dtype: Optional[str] = None
+    # ZeRO-1: shard optimizer-state arrays over the data axis (the
+    # reference replicates optimizer state per data-parallel rank; sharding
+    # it is the TPU-native upgrade — XLA reduce-scatters the gradient into
+    # the state update and all-gathers the weight delta)
+    zero_optimizer: bool = False
     seed: int = 0
     # mesh description: axis names and sizes; None => 1-D data mesh over all
     # visible devices (reference analog: register_all_machine_views'
@@ -170,6 +175,8 @@ class FFConfig:
                 cfg.seed = int(_next())
             elif a == "--compute-dtype":
                 cfg.compute_dtype = _next()
+            elif a == "--zero-optimizer":
+                cfg.zero_optimizer = True
             # unknown flags are ignored, matching the reference's tolerance
             i += 1
         return cfg

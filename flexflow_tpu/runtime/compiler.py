@@ -312,6 +312,36 @@ def compile_model(
     params, param_shardings, wd_mask = init_params(ops, mesh, config.seed)
     opt_state = optimizer.init_state(params) if optimizer is not None else None
 
+    # ---- ZeRO-1: shard optimizer state over the data axis -----------------
+    # Each state array inherits its weight's TP sharding (zeros_like keeps
+    # shardings); ZeRO additionally partitions the first data-axis-divisible
+    # unsharded dim over DATA, so momentum/variance live 1/dp-th per chip.
+    # The same constraint inside the step keeps them sharded across updates
+    # (SURVEY.md §7 step 10: ZeRO-sharded optimizer states).
+    opt_state_shardings = None
+    if (config.zero_optimizer and opt_state is not None
+            and axis_sizes.get(DATA_AXIS, 1) > 1):
+        dp = axis_sizes[DATA_AXIS]
+
+        def _zero_sharding(leaf):
+            if not hasattr(leaf, "shape") or leaf.ndim == 0:
+                return None
+            spec = list(getattr(leaf.sharding, "spec", ())) or [None] * leaf.ndim
+            spec += [None] * (leaf.ndim - len(spec))
+            for d in range(leaf.ndim):
+                if spec[d] is None and leaf.shape[d] % dp == 0 \
+                        and leaf.shape[d] >= dp:
+                    spec[d] = DATA_AXIS
+                    return NamedSharding(mesh, PartitionSpec(*spec))
+            return None
+
+        _leaves, _treedef = jax.tree_util.tree_flatten(opt_state)
+        _shards = [_zero_sharding(l) for l in _leaves]
+        opt_state = _treedef.unflatten([
+            jax.device_put(l, s) if s is not None else l
+            for l, s in zip(_leaves, _shards)])
+        opt_state_shardings = (_treedef, _shards)
+
     input_shardings = [
         _named_sharding(mesh, input_pshapes[t.tensor_id]) for t in input_tensors
     ]
@@ -377,6 +407,15 @@ def compile_model(
             loss_fn, has_aux=True)(params)
         batch_metrics = compute_batch_metrics(metrics, loss_type, logits, y, from_logits)
         new_params, new_opt_state = optimizer.update(params, grads, opt_state, wd_mask)
+        if opt_state_shardings is not None:
+            # keep ZeRO state sharded across updates: GSPMD reduce-scatters
+            # the grad into the sharded moment update and all-gathers only
+            # the weight delta
+            td, shards = opt_state_shardings
+            ls = td.flatten_up_to(new_opt_state)
+            new_opt_state = td.unflatten([
+                jax.lax.with_sharding_constraint(l, s) if s is not None else l
+                for l, s in zip(ls, shards)])
         # non-trainable state (BatchNorm running stats) written after the
         # optimizer update — reference: cuDNN BN forward-training updates
         # the running averages in the same pass (batch_norm.cu)

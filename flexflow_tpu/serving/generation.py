@@ -108,22 +108,27 @@ class Generator:
         """Params in the decode compute dtype. bf16: cast ONCE per params
         version (not per token inside the jitted step)."""
         params = self._cm.params
-        if self._cm.config.compute_dtype not in ("bf16", "bfloat16"):
+        cdt = self._compute_dtype()
+        if cdt is None:
             return params
         cached = self._exec_params_cache
         if cached is not None and cached[0] is params:
             return cached[1]
         cast = jax.tree_util.tree_map(
-            lambda v: v.astype(jnp.bfloat16)
+            lambda v: v.astype(cdt)
             if jnp.issubdtype(v.dtype, jnp.floating) else v, params)
         self._exec_params_cache = (params, cast)
         return cast
 
     # ---- cache ------------------------------------------------------------
+    def _compute_dtype(self):
+        from ..runtime.compiler import _resolve_compute_dtype
+
+        return _resolve_compute_dtype(self._cm.config.compute_dtype)
+
     def init_cache(self) -> Dict[str, Tuple[jnp.ndarray, jnp.ndarray]]:
         cache = {}
-        dt = (jnp.bfloat16 if self._cm.config.compute_dtype in
-              ("bf16", "bfloat16") else jnp.float32)
+        dt = self._compute_dtype() or jnp.float32
         for op in self._attn_ops:
             shape = (self.batch_size, self.max_length, op.num_heads,
                      op.head_dim)
@@ -161,7 +166,18 @@ class Generator:
         (pass the previous round's end position + its cache to continue a
         conversation). Returns (last-token logits, cache, end position)."""
         prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
+        end = offset + prompt_ids.shape[1]
+        if end > self.max_length:
+            # dynamic_update_slice CLAMPS out-of-bounds starts, which would
+            # silently misplace the written K/V — reject instead
+            raise ValueError(
+                f"offset {offset} + prompt {prompt_ids.shape[1]} exceeds "
+                f"max_length {self.max_length}")
         if cache is None:
+            if offset != 0:
+                raise ValueError(
+                    "offset > 0 needs the cache from the previous round "
+                    "(a fresh cache has no K/V for positions < offset)")
             cache = self.init_cache()
         elif offset == 0:
             raise ValueError(
@@ -169,7 +185,7 @@ class Generator:
                 "previous round ended at (offset=0 would overwrite it)")
         logits, cache = self._step(self._exec_params(), prompt_ids, cache,
                                    jnp.int32(offset))
-        return logits[:, -1, :], cache, offset + prompt_ids.shape[1]
+        return logits[:, -1, :], cache, end
 
     def generate(self, prompt_ids: np.ndarray, max_new_tokens: int,
                  temperature: float = 0.0, seed: int = 0,
@@ -187,21 +203,21 @@ class Generator:
         rng = np.random.default_rng(seed)
         out = [prompt_ids]
         done = np.zeros(b, bool)
-        for _ in range(max_new_tokens):
+        for i in range(max_new_tokens):
             lg = np.asarray(logits)
             if temperature > 0:
                 p = np.exp((lg - lg.max(-1, keepdims=True)) / temperature)
                 p /= p.sum(-1, keepdims=True)
-                nxt = np.array([rng.choice(lg.shape[-1], p=p[i])
-                                for i in range(b)], np.int32)
+                nxt = np.array([rng.choice(lg.shape[-1], p=p[j])
+                                for j in range(b)], np.int32)
             else:
                 nxt = lg.argmax(-1).astype(np.int32)
             if eos_id is not None:
                 nxt = np.where(done, eos_id, nxt)
                 done |= nxt == eos_id
             out.append(nxt[:, None])
-            if eos_id is not None and done.all():
-                break
+            if i == max_new_tokens - 1 or (eos_id is not None and done.all()):
+                break  # last token already sampled: skip the unused step
             step_logits, cache = self._step(
                 exec_params, jnp.asarray(nxt[:, None]), cache,
                 jnp.int32(pos))
