@@ -35,10 +35,15 @@ class TransformerConfig:
 
 
 def _encoder_layer(ff: FFModel, t, cfg: TransformerConfig, i: int,
-                   tp_axis: Optional[str]):
+                   tp_axis: Optional[str], seq_axis: Optional[str] = None,
+                   seq_mode: str = "ring"):
     """reference: create_attention_encoder (transformer.cc:33-45): MHA then
     two dense layers, no residual/norm."""
     attn_strategy = {"heads": tp_axis} if tp_axis else None
+    if seq_axis:
+        attn_strategy = dict(attn_strategy or {})
+        attn_strategy["seq"] = seq_axis
+        attn_strategy["seq_mode"] = seq_mode
     mlp_strategy1 = {"out": tp_axis} if tp_axis else None
     mlp_strategy2 = {"in": tp_axis} if tp_axis else None
     t = ff.multihead_attention(
@@ -53,7 +58,9 @@ def _encoder_layer(ff: FFModel, t, cfg: TransformerConfig, i: int,
 
 def build_transformer(ff: FFModel, batch_size: int,
                       cfg: Optional[TransformerConfig] = None,
-                      tp_axis: Optional[str] = None):
+                      tp_axis: Optional[str] = None,
+                      seq_axis: Optional[str] = None,
+                      seq_mode: str = "ring"):
     cfg = cfg or TransformerConfig()
     x = ff.create_tensor(
         (batch_size, cfg.sequence_length, cfg.hidden_size),
@@ -61,7 +68,7 @@ def build_transformer(ff: FFModel, batch_size: int,
     )
     t = x
     for i in range(cfg.num_layers):
-        t = _encoder_layer(ff, t, cfg, i, tp_axis)
+        t = _encoder_layer(ff, t, cfg, i, tp_axis, seq_axis, seq_mode)
     t = ff.dense(t, 1, use_bias=False, name="head")
     return x, t
 

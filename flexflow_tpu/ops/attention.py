@@ -89,6 +89,7 @@ class MultiHeadAttention(Op):
         self.causal = bool(a.get("causal", False))
         # set by propagate when the strategy sequence-shards this op
         self.seq_axis: str | None = None
+        self.seq_mode: str = "ring"  # "ring" | "a2a" (Ulysses)
 
     def infer_output_shapes(self):
         q = self.input_shapes[0].sizes
@@ -128,10 +129,14 @@ class MultiHeadAttention(Op):
         from ..parallel.ring_attention import ring_attention, single_device_attention
 
         if self.seq_axis is not None and ctx.mesh is not None:
-            # sequence parallelism: exact attention over seq-sharded q/k/v
-            # with a collective-permute ring over ICI (no reference
-            # equivalent — SURVEY.md §5 names this the TPU-native plan)
-            ctxv = ring_attention(
+            # sequence parallelism: exact attention over seq-sharded q/k/v.
+            # "ring": collective-permute ring over ICI; "a2a": Ulysses
+            # all-to-all head resharding (no reference equivalent —
+            # SURVEY.md §5 names these the TPU-native plan)
+            from ..parallel.ring_attention import ulysses_attention
+
+            sp = ulysses_attention if self.seq_mode == "a2a" else ring_attention
+            ctxv = sp(
                 qh, kh, vh, ctx.mesh, self.seq_axis,
                 causal=self.causal, scale=scale,
                 dropout_rate=drop, rng=ctx.rng,
@@ -193,6 +198,10 @@ class MultiHeadAttention(Op):
             # self-attention-shaped only: q/k/v seq equal and divisible
             if deg > 1 and len(seqs) == 1 and seq % deg == 0:
                 self.seq_axis = sax
+                mode = strategy.get("seq_mode", "ring")
+                # Ulysses needs heads divisible by the axis degree
+                self.seq_mode = ("a2a" if mode == "a2a"
+                                 and self.num_heads % deg == 0 else "ring")
                 out_shapes[0] = out_shapes[0].partitioned(1, deg, sax)
         return out_shapes, weight_shapes
 

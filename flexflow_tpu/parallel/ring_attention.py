@@ -163,3 +163,66 @@ def ring_attention(
         out_specs=P(None, axis, None, None),
     )
     return fn(q, k, v)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    axis: str,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    dropout_rate: float = 0.0,
+    rng: Optional[jax.Array] = None,
+) -> jax.Array:
+    """All-to-all (DeepSpeed-Ulysses-style) sequence parallelism.
+
+    The other SP schedule the scaling literature uses (no reference
+    analog — SURVEY.md §5 names "ring attention or all-to-all
+    sequence/context parallelism" as the TPU-native plan): q/k/v arrive
+    sequence-sharded over ``axis``; one all-to-all re-shards them to
+    head-sharded with the FULL sequence per device, attention runs locally
+    and exactly, and a second all-to-all restores sequence sharding.
+
+    Trade-off vs :func:`ring_attention`: 2 all-to-alls of the activations
+    instead of n-1 k/v permutes — cheaper when heads are plentiful and the
+    axis degree divides them (required: heads % degree == 0); ring wins
+    when n is large or heads are few. Both are exposed to the strategy
+    search as ``seq_mode`` alternatives.
+    """
+    n = mesh.shape[axis]
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    if n == 1:
+        return single_device_attention(q, k, v, causal, scale, dropout_rate, rng)
+    if q.shape[2] % n != 0:
+        raise ValueError(
+            f"ulysses attention needs heads % degree == 0, got "
+            f"{q.shape[2]} % {n}")
+    if q.shape[1] != k.shape[1] or k.shape[1] != v.shape[1]:
+        raise ValueError("ulysses attention requires equal q/k/v seq lengths")
+
+    def body(ql, kl, vl):
+        # (B, S/n, H, D) --all_to_all--> (B, S, H/n, D)
+        def seq_to_heads(x):
+            return jax.lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                                      tiled=True)
+
+        step_rng = (
+            jax.random.fold_in(rng, jax.lax.axis_index(axis))
+            if (rng is not None and dropout_rate > 0.0) else None
+        )
+        o = single_device_attention(
+            seq_to_heads(ql), seq_to_heads(kl), seq_to_heads(vl),
+            causal, scale, dropout_rate, step_rng)
+        # (B, S, H/n, D) --all_to_all--> (B, S/n, H, D)
+        return jax.lax.all_to_all(o, axis, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    fn = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(None, axis, None, None),) * 3,
+        out_specs=P(None, axis, None, None),
+    )
+    return fn(q, k, v)

@@ -328,6 +328,12 @@ def compile_model(
                 return None
             spec = list(getattr(leaf.sharding, "spec", ())) or [None] * leaf.ndim
             spec += [None] * (leaf.ndim - len(spec))
+            # a weight explicitly sharded over the data axis already
+            # distributes its state; adding it again would duplicate the
+            # mesh axis in the spec (invalid)
+            if any(DATA_AXIS == s or (isinstance(s, tuple) and DATA_AXIS in s)
+                   for s in spec):
+                return None
             for d in range(leaf.ndim):
                 if spec[d] is None and leaf.shape[d] % dp == 0 \
                         and leaf.shape[d] >= dp:

@@ -341,10 +341,15 @@ def full_search(
     # simulator.h:750) — the memo key includes the full sharding signature
     cost_model = OpCostModel(machine)
     best: Optional[GraphSearchResult] = None
+    zero = config is not None and config.zero_optimizer
     for shape in mesh_shapes:
         pipe = shape.get("pipe", 1)
         axis_sizes = {a: s for a, s in shape.items() if a != "pipe"}
-        sim = Simulator(machine, cost_model, overlap_grad_sync=overlap)
+        # ZeRO-1 shards optimizer state over the data axis: the per-device
+        # footprint the memory prune charges shrinks by the data degree
+        opt_mult = 2.0 / shape.get("data", 1) if zero else 2.0
+        sim = Simulator(machine, cost_model, overlap_grad_sync=overlap,
+                        optimizer_state_mult=opt_mult)
         input_pshapes = data_parallel_input_pshapes(
             input_tensors, axis_sizes, sample_parallel)
         # each pipe stage holds only ~1/P of the model, so both the hard

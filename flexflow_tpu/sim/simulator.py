@@ -135,6 +135,23 @@ class Simulator:
             local = _pshape_local_bytes(in0)
             return m.allreduce_time(local, deg, axis or "") if not backward else 0.0
 
+        # sequence-parallel attention: the seq axis shards both inputs and
+        # outputs, so the generic contraction rules see no collective —
+        # price the schedule's real communication explicitly. Ring: n-1
+        # collective-permutes of the local k AND v blocks; Ulysses: 3
+        # input all-to-alls + 1 output all-to-all of activation blocks
+        # (parallel/ring_attention.py). This is also what makes the two
+        # seq_mode search candidates cost-distinguishable.
+        if (t is OpType.MULTIHEAD_ATTENTION
+                and getattr(op, "seq_axis", None) and in0 is not None):
+            axis = op.seq_axis
+            deg = _axis_degree(op, axis)
+            if deg > 1:
+                block = _pshape_local_bytes(in0)  # one local activation block
+                if getattr(op, "seq_mode", "ring") == "a2a":
+                    return 4.0 * m.alltoall_time(block, deg, axis)
+                return 2.0 * (deg - 1) * m.permute_time(block, deg, axis)
+
         # compute op: explicit contraction structure first (Linear/Conv/…)
         out_bytes = sum(_pshape_local_bytes(p) for p in op.output_shapes)
         out_axes = {

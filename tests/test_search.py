@@ -288,7 +288,10 @@ def test_substitution_json_changes_search_outcome(tmp_path, monkeypatch):
 
     def build():
         ff = FFModel(FFConfig(batch_size=32))
-        x = ff.create_tensor((32, 64, 128), DataType.FLOAT, name="x")
+        # LONG sequence: the simulator now charges the ring-permute comm
+        # of seq parallelism, so SP must save real S^2 attention compute
+        # to win (it does at S=1024; it would not at S=64)
+        x = ff.create_tensor((32, 1024, 128), DataType.FLOAT, name="x")
         # 2 heads: NOT divisible by the 4-way model axis, so the built-in
         # heads-sharding candidate is filtered and {} is the only builtin
         a = ff.multihead_attention(x, x, x, 128, 2, name="attn")

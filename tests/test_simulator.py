@@ -141,3 +141,52 @@ def test_task_graph_and_memory():
     assert mu.weights == w
     assert mu.optimizer_state == 2 * w
     assert sim.fits_memory(ops)
+
+
+def test_sp_attention_comm_priced_and_modes_differ():
+    """The simulator charges sequence-parallel attention's schedule comm
+    (ring permutes vs Ulysses all-to-alls) — previously the generic rules
+    saw none, making the seq_mode candidates indistinguishable."""
+    from flexflow_tpu import ActiMode
+    from flexflow_tpu.sim.simulator import Simulator as _Sim
+
+    machine = SimpleMachineModel(CHIP_PRESETS["test"], 8)
+    sim = Simulator(machine, OpCostModel(machine))
+    axis = {"data": 2, "seq": 4}
+
+    def attn_ops(seq_mode):
+        ff = FFModel(FFConfig(batch_size=8))
+        x = ff.create_tensor((8, 64, 32), DataType.FLOAT, name="x")
+        ff.multihead_attention(x, x, x, 32, 4, name="attn",
+                               strategy={"seq": "seq", "seq_mode": seq_mode})
+        input_ps = {x.tensor_id: ParallelTensorShape(
+            (ParallelDim(8, 2, "data"), ParallelDim(64), ParallelDim(32)),
+            DataType.FLOAT)}
+        ops, _ = build_ops(ff.layers, input_ps, axis,
+                           {"attn": {"seq": "seq", "seq_mode": seq_mode}})
+        return next(o for o in ops if o.name == "attn")
+
+    ring = sim._comm_time(attn_ops("ring"), backward=False)
+    a2a = sim._comm_time(attn_ops("a2a"), backward=False)
+    assert ring > 0 and a2a > 0
+    assert ring != a2a  # distinguishable to the search
+
+
+def test_zero_optimizer_shrinks_search_memory_model():
+    """--zero-optimizer: full_search charges 1/dp of the optimizer state
+    per device (runtime: ZeRO-1 shards it over the data axis)."""
+    from flexflow_tpu.search.unity import full_search
+
+    ff = FFModel(FFConfig(batch_size=64))
+    x = ff.create_tensor((64, 256), DataType.FLOAT, name="x")
+    t = ff.dense(x, 512)
+    ff.softmax(t)
+    machine = SimpleMachineModel(CHIP_PRESETS["test"], 8)
+
+    r_repl = full_search(ff.layers, [x], machine,
+                         FFConfig(batch_size=64),
+                         mesh_shapes=[{"data": 8}])
+    r_zero = full_search(ff.layers, [x], machine,
+                         FFConfig(batch_size=64, zero_optimizer=True),
+                         mesh_shapes=[{"data": 8}])
+    assert r_zero.est_memory < r_repl.est_memory
