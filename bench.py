@@ -24,6 +24,7 @@ import os
 import subprocess
 import sys
 import time
+from typing import Tuple
 
 import numpy as np
 
@@ -248,6 +249,29 @@ def _measure(force_cpu: bool) -> dict:
 # orchestrator (the default entry): subprocess + hard timeout + CPU fallback
 # --------------------------------------------------------------------------
 
+def _probe_device_backend(timeout_s: float = 240.0) -> Tuple[bool, str]:
+    """Fast liveness probe for the device backend in a THROWAWAY process.
+
+    A wedged TPU tunnel makes backend init HANG (not error) — observed
+    live: the axon plugin's register() forces jax_platforms='axon,cpu' at
+    interpreter start, so jax.devices() blocks on the dead tunnel. Without
+    this probe the orchestrator burns 2 x device-timeout (40 min) before
+    reaching the CPU fallback."""
+    code = "import jax; d = jax.devices(); print('PROBE_OK', d[0].platform)"
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.DEVNULL,
+                              timeout=timeout_s, text=True)
+    except subprocess.TimeoutExpired:
+        return False, f"backend init hung > {timeout_s:.0f}s (dead tunnel?)"
+    except OSError as e:
+        return False, f"probe failed to launch: {e}"
+    if proc.returncode == 0 and "PROBE_OK" in proc.stdout:
+        return True, proc.stdout.strip()
+    return False, f"probe rc={proc.returncode}"
+
+
 def _run_child(force_cpu: bool, timeout_s: float):
     """Run the measurement child; returns (result_dict | None, error | None)."""
     cmd = [sys.executable, os.path.abspath(__file__), "--child"]
@@ -289,7 +313,17 @@ def main():
             device_timeout = 1200.0
         errors = []
         result = None
-        for attempt in (1, 2):
+        # probe budget scales with the configured device timeout (a big
+        # topology may legitimately take minutes to init)
+        probe_timeout = min(device_timeout, max(240.0, device_timeout / 4))
+        alive, msg = _probe_device_backend(probe_timeout)
+        _progress(f"device backend probe: {msg}")
+        if not alive:
+            errors.append(f"device probe: {msg}")
+        # healthy probe: two full attempts; failed probe: still ONE
+        # attempt (the probe could be a false negative) before the CPU
+        # fallback — bounds wedged-tunnel waste to one device timeout
+        for attempt in ((1, 2) if alive else (1,)):
             result, err = _run_child(force_cpu=False, timeout_s=device_timeout)
             if result is not None:
                 break

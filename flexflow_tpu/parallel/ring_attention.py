@@ -185,11 +185,12 @@ def ulysses_attention(
     head-sharded with the FULL sequence per device, attention runs locally
     and exactly, and a second all-to-all restores sequence sharding.
 
-    Trade-off vs :func:`ring_attention`: 2 all-to-alls of the activations
-    instead of n-1 k/v permutes — cheaper when heads are plentiful and the
-    axis degree divides them (required: heads % degree == 0); ring wins
-    when n is large or heads are few. Both are exposed to the strategy
-    search as ``seq_mode`` alternatives.
+    Trade-off vs :func:`ring_attention`: 4 all-to-alls of activation
+    blocks (q/k/v in, output back) instead of 2(n-1) k/v permutes —
+    cheaper when heads are plentiful and the axis degree divides them
+    (required: heads % degree == 0); ring wins when n is large or heads
+    are few. Both are exposed to the strategy search as ``seq_mode``
+    alternatives, priced accordingly (sim/simulator.py _comm_time).
     """
     n = mesh.shape[axis]
     scale = scale if scale is not None else q.shape[-1] ** -0.5

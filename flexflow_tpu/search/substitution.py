@@ -115,8 +115,8 @@ def candidate_strategies(
         if seq_deg > 1:
             cands.append({"seq": "seq"})  # ring schedule (default)
             if layer.attrs.get("num_heads", 0) % seq_deg == 0:
-                # Ulysses all-to-all alternative: 2 activation a2a's vs
-                # n-1 k/v permutes (parallel/ring_attention.py)
+                # Ulysses all-to-all alternative: 4 activation a2a's vs
+                # 2(n-1) k/v permutes (parallel/ring_attention.py)
                 cands.append({"seq": "seq", "seq_mode": "a2a"})
     elif t is OpType.EMBEDDING and param_ok:
         vocab = layer.attrs.get("num_entries", 0)

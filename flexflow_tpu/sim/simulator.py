@@ -137,20 +137,24 @@ class Simulator:
 
         # sequence-parallel attention: the seq axis shards both inputs and
         # outputs, so the generic contraction rules see no collective —
-        # price the schedule's real communication explicitly. Ring: n-1
-        # collective-permutes of the local k AND v blocks; Ulysses: 3
-        # input all-to-alls + 1 output all-to-all of activation blocks
-        # (parallel/ring_attention.py). This is also what makes the two
-        # seq_mode search candidates cost-distinguishable.
+        # price the schedule's real communication explicitly and ADD it to
+        # the generic charges (a combined heads-TP x SP strategy still owes
+        # the TP allreduce). Ring: n-1 collective-permutes of the local
+        # k AND v blocks; Ulysses: 3 input all-to-alls + 1 output
+        # all-to-all of activation blocks (parallel/ring_attention.py).
+        # Sized from the OUTPUT pshape: propagate seq-shards it even for
+        # the first layer, whose input arrives unsharded.
+        sp_time = 0.0
         if (t is OpType.MULTIHEAD_ATTENTION
-                and getattr(op, "seq_axis", None) and in0 is not None):
+                and getattr(op, "seq_axis", None) and out0 is not None):
             axis = op.seq_axis
             deg = _axis_degree(op, axis)
             if deg > 1:
-                block = _pshape_local_bytes(in0)  # one local activation block
+                block = _pshape_local_bytes(out0)  # one local seq block
                 if getattr(op, "seq_mode", "ring") == "a2a":
-                    return 4.0 * m.alltoall_time(block, deg, axis)
-                return 2.0 * (deg - 1) * m.permute_time(block, deg, axis)
+                    sp_time = 4.0 * m.alltoall_time(block, deg, axis)
+                else:
+                    sp_time = 2.0 * (deg - 1) * m.permute_time(block, deg, axis)
 
         # compute op: explicit contraction structure first (Linear/Conv/…)
         out_bytes = sum(_pshape_local_bytes(p) for p in op.output_shapes)
@@ -186,7 +190,9 @@ class Simulator:
         for axis, deg, kind in colls:
             if axis not in handled:
                 time += m.allreduce_time(out_bytes, deg, axis)
-        return time  # same magnitude both directions (transpose collective)
+        # same magnitude both directions (transpose collective); SP
+        # schedule comm adds on top
+        return time + sp_time
 
     # ------------------------------------------------------------ task graph
     def build_task_graph(self, ops: List[Op]) -> List[SimTask]:
