@@ -261,7 +261,7 @@ def _probe_device_backend(timeout_s: float = 240.0) -> Tuple[bool, str]:
     try:
         proc = subprocess.run([sys.executable, "-c", code],
                               stdout=subprocess.PIPE,
-                              stderr=subprocess.DEVNULL,
+                              stderr=subprocess.PIPE,
                               timeout=timeout_s, text=True)
     except subprocess.TimeoutExpired:
         return False, f"backend init hung > {timeout_s:.0f}s (dead tunnel?)"
@@ -269,7 +269,8 @@ def _probe_device_backend(timeout_s: float = 240.0) -> Tuple[bool, str]:
         return False, f"probe failed to launch: {e}"
     if proc.returncode == 0 and "PROBE_OK" in proc.stdout:
         return True, proc.stdout.strip()
-    return False, f"probe rc={proc.returncode}"
+    tail = (proc.stderr or "").strip().splitlines()[-3:]
+    return False, f"probe rc={proc.returncode}: {' | '.join(tail)[:300]}"
 
 
 def _run_child(force_cpu: bool, timeout_s: float):

@@ -63,6 +63,7 @@ from .runtime.initializer import (
     ZeroInitializer,
 )
 from .runtime.dataloader import DataLoaderGroup, SingleDataLoader
+from .runtime.guard import DivergenceError, TrainingGuard
 from .runtime.metrics import PerfMetrics
 
 __version__ = "0.1.0"

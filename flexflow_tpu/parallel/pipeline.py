@@ -96,7 +96,8 @@ class PipelinedModel:
 
     def __init__(self, ops, mesh: Mesh, cfg: PipelineConfig, optimizer,
                  loss_fn, metrics_fn, input_ids: List[int], logits_id: int,
-                 params: Dict, wd_mask: Dict, opt_state=None):
+                 params: Dict, wd_mask: Dict, opt_state=None,
+                 compute_dtype=None):
         axis_sizes = mesh_axis_sizes(mesh)
         if cfg.axis not in axis_sizes:
             raise ValueError(f"mesh has no '{cfg.axis}' axis for pipelining")
@@ -119,6 +120,12 @@ class PipelinedModel:
         self.cfg = cfg
         self.mesh = mesh
         self.optimizer = optimizer
+        # bf16 mixed precision inside the stage programs (fp32 masters);
+        # resolved string -> jnp dtype by the compiler's shared helper
+        from ..runtime.compiler import _resolve_compute_dtype
+
+        self.compute_dtype = _resolve_compute_dtype(compute_dtype) \
+            if isinstance(compute_dtype, (str, type(None))) else compute_dtype
         self.loss_fn = loss_fn
         self.metrics_fn = metrics_fn
         self.input_ids = input_ids
@@ -256,20 +263,30 @@ class PipelinedModel:
         mesh = self.submeshes[s]
         needed = self._live_after(s)
 
+        cdt = self.compute_dtype
+        from ..runtime.compiler import cast_op_params, make_caster
+
+        cast = make_caster(cdt)
+
         def fwd(stage_params, acts: Dict[int, jax.Array], rng):
-            ctx = LowerCtx(mesh=mesh, training=training, aux_losses=[])
-            acts = dict(acts)
+            ctx = LowerCtx(mesh=mesh, training=training, aux_losses=[],
+                           compute_dtype=cdt)
+            acts = {k: cast(v) for k, v in acts.items()}
             for oi, op in enumerate(stage_ops):
                 ctx.rng = (jax.random.fold_in(rng, oi)
                            if rng is not None else None)
                 ins = [acts[t.tensor_id] for t in op.layer.inputs]
-                outs = op.forward(ctx, ins, stage_params.get(op.name, {}))
+                p = cast_op_params(cast, op, stage_params.get(op.name, {}),
+                                   cdt)
+                outs = op.forward(ctx, ins, p)
                 for out, t in zip(outs, op.layer.outputs):
-                    acts[t.tensor_id] = out
+                    acts[t.tensor_id] = cast(out)
             out_acts = {k: v for k, v in acts.items() if k in needed}
             aux = ctx.aux_losses or []
-            # aux as a summed scalar so the vjp cotangent is one scalar
-            aux_sum = sum(aux) if aux else jnp.zeros(())
+            # aux as a summed scalar so the vjp cotangent is one scalar;
+            # fp32 like the main compiler's loss path
+            aux_sum = (sum(jnp.asarray(a, jnp.float32) for a in aux)
+                       if aux else jnp.zeros(()))
             return out_acts, aux_sum
 
         return fwd
@@ -308,6 +325,8 @@ class PipelinedModel:
             def f(p, a):
                 out, aux = fwd(p, a, rng)
                 logits = out[logits_id]
+                if self.compute_dtype is not None:
+                    logits = logits.astype(jnp.float32)  # fp32 loss
                 loss = loss_fn(logits, y)
                 return loss + aux, (loss, aux, logits)
 

@@ -200,6 +200,29 @@ def _resolve_compute_dtype(name: Optional[str]):
     raise ValueError(f"unknown compute_dtype {name!r}")
 
 
+def make_caster(compute_dtype):
+    """The ONE mixed-precision cast policy, shared by the main compiler
+    and the pipeline engine: float leaves -> compute_dtype, everything
+    else untouched; None -> identity."""
+    if compute_dtype is None:
+        return lambda x: x
+
+    def cast(x):
+        if jnp.issubdtype(jnp.result_type(x), jnp.floating):
+            return x.astype(compute_dtype)
+        return x
+
+    return cast
+
+
+def cast_op_params(cast, op, params: Dict, compute_dtype):
+    """Per-op weight cast under the shared full-precision exception list
+    (BatchNorm statistics stay fp32)."""
+    if compute_dtype is None or op.op_type in _FULL_PRECISION_PARAM_OPS:
+        return params
+    return {k: cast(v) for k, v in params.items()}
+
+
 def _forward_graph(
     ops: List[Op],
     mesh: Mesh,
@@ -224,21 +247,12 @@ def _forward_graph(
     ctx = LowerCtx(mesh=mesh, training=training, seq_length=seq_length,
                    aux_losses=[], state_updates={} if training else None,
                    compute_dtype=compute_dtype)
-
-    def cast(x):
-        if compute_dtype is None:
-            return x
-        if jnp.issubdtype(jnp.result_type(x), jnp.floating):
-            return x.astype(compute_dtype)
-        return x
-
+    cast = make_caster(compute_dtype)
     acts: Dict[int, jnp.ndarray] = {k: cast(v) for k, v in inputs.items()}
     for oi, op in enumerate(ops):
         ins = [acts[t.tensor_id] for t in op.layer.inputs]
         ctx.rng = jax.random.fold_in(rng, oi) if rng is not None else None
-        p = params.get(op.name, {})
-        if compute_dtype is not None and op.op_type not in _FULL_PRECISION_PARAM_OPS:
-            p = {k: cast(v) for k, v in p.items()}
+        p = cast_op_params(cast, op, params.get(op.name, {}), compute_dtype)
         outs = op.forward(ctx, ins, p)
         for out, t, ps in zip(outs, op.layer.outputs, op.output_shapes):
             out = cast(out)
@@ -386,7 +400,7 @@ def compile_model(
     # BatchMatmul's a/b_seq_length_dim, model.cc:2415-2420). The public
     # wrappers keep the old calling convention with seq_length as a
     # keyword defaulting to -1 (no truncation).
-    def train_step(seq_length, params, opt_state, rng, *batch):
+    def train_step(seq_length, hyper, params, opt_state, rng, *batch):
         xs = batch[:n_inputs]
         y = batch[n_inputs]
 
@@ -412,7 +426,8 @@ def compile_model(
         (loss, (logits, updates)), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(params)
         batch_metrics = compute_batch_metrics(metrics, loss_type, logits, y, from_logits)
-        new_params, new_opt_state = optimizer.update(params, grads, opt_state, wd_mask)
+        new_params, new_opt_state = optimizer.update(
+            params, grads, opt_state, wd_mask, hyper)
         if opt_state_shardings is not None:
             # keep ZeRO state sharded across updates: GSPMD reduce-scatters
             # the grad into the sharded moment update and all-gathers only
@@ -469,11 +484,20 @@ def compile_model(
             return jitted(seq_length, *args)
         return call
 
+    def _wrap_train(jitted):
+        """Like _wrap, plus the optimizer's hyperparams as a DYNAMIC
+        argument read fresh per call — lr schedules/backoffs take effect
+        without re-tracing (pjit caches by the underlying function, so a
+        re-jit would silently reuse the stale executable)."""
+        def call(*args, seq_length: int = -1):
+            return jitted(seq_length, optimizer.hyperparams(), *args)
+        return call
+
     jit_train = None
     jit_grad = None
     if optimizer is not None and loss_type is not None:
-        jit_train = _wrap(
-            jax.jit(train_step, static_argnums=0, donate_argnums=(1, 2)))
+        jit_train = _wrap_train(
+            jax.jit(train_step, static_argnums=0, donate_argnums=(2, 3)))
         jit_grad = _wrap(jax.jit(grad_step, static_argnums=0))
     jit_eval = _wrap(jax.jit(eval_step, static_argnums=0))
     _jit_fwd = jax.jit(forward_fn, static_argnames=("seq_length",))
@@ -507,10 +531,13 @@ def compile_model(
     )
 
     def _refresh_train_step():
-        # fresh jit wrapper → fresh trace → current optimizer hyperparams
-        if optimizer is not None and loss_type is not None:
-            cm.train_step = _wrap(
-                jax.jit(train_step, static_argnums=0, donate_argnums=(1, 2)))
+        # No-op by design: optimizer hyperparams are DYNAMIC step
+        # arguments (optimizer.hyperparams() read fresh per call), so
+        # mutating lr/alpha is already live. Kept as the stable hook the
+        # guard/scheduler call — re-jitting here would be a lie: pjit's
+        # cache is keyed on the underlying function and would silently
+        # reuse the stale executable.
+        pass
 
     cm.refresh_train_step = _refresh_train_step
     return cm

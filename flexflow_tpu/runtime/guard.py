@@ -1,0 +1,102 @@
+"""Failure detection + elastic recovery for training.
+
+The reference has NO failure-detection subsystem (SURVEY.md §5: "none —
+Legion aborts"; its only dynamic hook is RecompileState). This module adds
+one, TPU-shaped: divergence on an accelerator usually surfaces as a
+non-finite loss (bf16 overflow, lr spikes, bad batches), and the cheapest
+recovery is rollback + step-size backoff — not process restart.
+
+:class:`TrainingGuard` keeps a HOST-side snapshot of (params, opt_state)
+from the last healthy epoch (host-side on purpose: the jitted step donates
+its input buffers, so device-side references would die; and a host copy
+survives even a device reset). When ``fit`` sees a non-finite epoch loss
+sum it restores the snapshot with the original shardings and scales the
+learning rate by ``lr_backoff`` — which takes effect immediately because
+hyperparameters are DYNAMIC arguments of the compiled step
+(optimizer.hyperparams(), runtime/compiler.py), no re-trace involved.
+After ``max_restores`` consecutive failures it raises — at that point the
+run needs a human.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+
+
+class DivergenceError(RuntimeError):
+    """Training produced non-finite loss beyond the guard's restore budget."""
+
+
+class TrainingGuard:
+    def __init__(self, max_restores: int = 3, lr_backoff: float = 0.5):
+        self.max_restores = int(max_restores)
+        self.lr_backoff = float(lr_backoff)
+        self.restores_used = 0
+        self._snap: Optional[Tuple[list, list]] = None
+
+    # ---- snapshot ----------------------------------------------------------
+    @staticmethod
+    def _to_host(tree) -> list:
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+
+        def shard_of(l):
+            s = getattr(l, "sharding", None)
+            # an UNCOMMITTED array reports SingleDeviceSharding; restoring
+            # with it would pin the leaf to one device and clash with the
+            # mesh-sharded params inside jit — keep such leaves unplaced
+            if isinstance(s, jax.sharding.SingleDeviceSharding):
+                return None
+            return s
+
+        return [treedef, [(np.asarray(l), shard_of(l)) for l in leaves]]
+
+    @staticmethod
+    def _to_device(snap) -> Any:
+        treedef, pairs = snap
+        return treedef.unflatten([
+            jax.device_put(v, s) if s is not None else jax.numpy.asarray(v)
+            for v, s in pairs])
+
+    def snapshot(self, ff) -> None:
+        """Record the current (healthy) params + optimizer state."""
+        cm = ff.compiled
+        self._snap = (self._to_host(cm.params), self._to_host(cm.opt_state))
+        self.restores_used = 0  # a healthy epoch resets the budget
+
+    def ensure_snapshot(self, ff) -> None:
+        """Initial snapshot before any step runs, so a first-epoch
+        divergence can still roll back (to the init weights)."""
+        if self._snap is None:
+            self.snapshot(ff)
+
+    # ---- recovery ----------------------------------------------------------
+    def recover(self, ff, verbose: bool = True) -> bool:
+        """Roll back to the last snapshot and back off the learning rate.
+        Returns False (caller should raise) when no snapshot exists or the
+        restore budget is exhausted."""
+        if self._snap is None or self.restores_used >= self.max_restores:
+            return False
+        cm = ff.compiled
+        cm.params = self._to_device(self._snap[0])
+        cm.opt_state = self._to_device(self._snap[1])
+        self.restores_used += 1
+        opt = cm.optimizer
+        if self.lr_backoff != 1.0 and opt is not None:
+            for attr in ("lr", "alpha"):
+                if hasattr(opt, attr):
+                    setattr(opt, attr, getattr(opt, attr) * self.lr_backoff)
+                    break
+            # no re-trace needed: hyperparams are dynamic step arguments
+            # read fresh per call (the kept hook is a no-op)
+            if cm.refresh_train_step is not None:
+                cm.refresh_train_step()
+        if verbose:
+            lr = getattr(opt, "lr", getattr(opt, "alpha", None))
+            print(f"[guard] non-finite loss: rolled back to last healthy "
+                  f"snapshot (restore {self.restores_used}/"
+                  f"{self.max_restores}), lr -> {lr}", flush=True)
+        return True

@@ -800,6 +800,7 @@ class FFModel:
                 params=cm.params,
                 wd_mask=cm.wd_mask,
                 opt_state=cm.opt_state,
+                compute_dtype=self.config.compute_dtype,
             )
         # graph exports requested via flags (reference: --compgraph /
         # --taskgraph dumps written right after compile, model.cc:3666-3674)
@@ -966,8 +967,17 @@ class FFModel:
         shuffle: bool = True,
         verbose: bool = True,
         recompile_state=None,
+        guard=None,
     ) -> List[PerfMetrics]:
+        """``guard``: a :class:`runtime.guard.TrainingGuard` — non-finite
+        epoch losses roll back to the last healthy snapshot with lr
+        backoff instead of poisoning the run (no reference equivalent:
+        SURVEY.md §5 lists failure detection as absent upstream)."""
         assert self.compiled is not None, "call compile() first"
+        if guard is not None and self.pipelined is not None:
+            raise ValueError("TrainingGuard does not support pipelined "
+                             "models yet (stage state lives off the "
+                             "CompiledModel)")
         cm = self.compiled
         xs = x if isinstance(x, (list, tuple)) else [x]
         epochs = epochs or self.config.epochs
@@ -990,10 +1000,13 @@ class FFModel:
         loaders.append(SingleDataLoader(y_arr, bs, cm.label_sharding))
         group = DataLoaderGroup(loaders, seed=self.config.seed, shuffle=shuffle)
         history: List[PerfMetrics] = []
+        if guard is not None:
+            guard.ensure_snapshot(self)  # epoch-0 divergence rolls back too
         for epoch in range(epochs):
             group.reset()
             pm = PerfMetrics()
             last_loss = None
+            loss_accum = None  # device-side; NaN/inf in ANY batch survives
             for it in range(group.num_batches):
                 batch = group.next_batch()
                 if self.pipelined is not None:
@@ -1007,6 +1020,11 @@ class FFModel:
                     )
                 pm.accumulate(bm)
                 last_loss = loss
+                if guard is not None:
+                    # sum, not last value: a mid-epoch NaN/inf must not be
+                    # masked by a finite final batch (clipped CE losses
+                    # stay finite on garbage params)
+                    loss_accum = loss if loss_accum is None else loss_accum + loss
                 cm._iteration += 1
                 if recompile_state is not None:
                     # reference: recompile_on_condition evaluated per
@@ -1017,8 +1035,21 @@ class FFModel:
                     if recompile_on_condition(self, recompile_state):
                         cm = self.compiled
             pm.flush()
+            lv = float(last_loss) if last_loss is not None else float("nan")
+            if guard is not None:
+                epoch_ok = (loss_accum is not None
+                            and np.isfinite(float(loss_accum)))
+                if not epoch_ok:
+                    from .guard import DivergenceError
+
+                    if not guard.recover(self, verbose=verbose):
+                        raise DivergenceError(
+                            f"loss {lv} at epoch {epoch} and the guard's "
+                            f"restore budget is exhausted")
+                    history.append(pm)
+                    continue
+                guard.snapshot(self)
             if verbose:
-                lv = float(last_loss) if last_loss is not None else float("nan")
                 print(
                     f"epoch {epoch}: loss {lv:.4f}  {pm.report(cm.metrics)}",
                     flush=True,
@@ -1106,9 +1137,9 @@ class FFModel:
     def set_learning_rate(self, lr: float) -> None:
         """Change the optimizer learning rate mid-training (reference:
         Optimizer::set_learning_rate used by the keras
-        LearningRateScheduler callback). The compiled step bakes
-        hyperparameters in at trace time, so this re-traces it (one XLA
-        compile per change)."""
+        LearningRateScheduler callback). Hyperparameters are DYNAMIC
+        arguments of the compiled step (optimizer.hyperparams() read per
+        call), so the change is live immediately — no re-trace."""
         opt = self.optimizer
         if not hasattr(opt, "lr") and not hasattr(opt, "alpha"):
             raise ValueError("optimizer has no learning-rate attribute")

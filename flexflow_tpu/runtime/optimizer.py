@@ -33,11 +33,21 @@ class Optimizer:
         raise NotImplementedError
 
     def update(
-        self, params: Pytree, grads: Pytree, state: Pytree, wd_mask: Pytree
+        self, params: Pytree, grads: Pytree, state: Pytree, wd_mask: Pytree,
+        hyper=None,
     ) -> Tuple[Pytree, Pytree]:
         """Return (new_params, new_state). ``wd_mask`` is a pytree of bools
-        marking which leaves get weight decay."""
+        marking which leaves get weight decay. ``hyper``: the dict from
+        :meth:`hyperparams`, passed as a DYNAMIC jit argument by the
+        compiled step — mutating ``self.lr``/``self.alpha`` between steps
+        takes effect without re-tracing (jax's pjit cache is keyed on the
+        underlying function, so 're-jitting' the same step closure reuses
+        the old executable with the old constants baked in)."""
         raise NotImplementedError
+
+    def hyperparams(self) -> dict:
+        """Step-size hyperparameters read fresh at every step call."""
+        return {}
 
     # ---- state partitioning (pipeline parallelism) ---------------------- #
     # The pipeline engine holds each stage's params (and optimizer state) on
@@ -76,8 +86,12 @@ class SGDOptimizer(Optimizer):
             return jax.tree.map(lambda p: jnp.zeros((), p.dtype), params)
         return jax.tree.map(jnp.zeros_like, params)
 
-    def update(self, params, grads, state, wd_mask):
-        lr, m, wd = self.lr, self.momentum, self.weight_decay
+    def hyperparams(self):
+        return {"lr": self.lr}
+
+    def update(self, params, grads, state, wd_mask, hyper=None):
+        lr = hyper["lr"] if hyper is not None else self.lr
+        m, wd = self.momentum, self.weight_decay
 
         def upd(p, g, v, use_wd):
             g = g.astype(p.dtype)
@@ -136,12 +150,16 @@ class AdamOptimizer(Optimizer):
             "t": jnp.zeros((), jnp.int32),
         }
 
-    def update(self, params, grads, state, wd_mask):
+    def hyperparams(self):
+        return {"alpha": self.alpha}
+
+    def update(self, params, grads, state, wd_mask, hyper=None):
         b1, b2, wd, eps = self.beta1, self.beta2, self.weight_decay, self.epsilon
+        alpha = hyper["alpha"] if hyper is not None else self.alpha
         t = state["t"] + 1
         # bias-corrected step size (reference: AdamOptimizer::next computes
         # alpha_t = alpha * sqrt(1-b2^t) / (1-b1^t))
-        alpha_t = self.alpha * jnp.sqrt(1.0 - b2 ** t.astype(jnp.float32)) / (
+        alpha_t = alpha * jnp.sqrt(1.0 - b2 ** t.astype(jnp.float32)) / (
             1.0 - b1 ** t.astype(jnp.float32)
         )
 

@@ -1,0 +1,121 @@
+"""Failure detection / elastic recovery (runtime/guard.py — no reference
+equivalent: SURVEY.md §5 lists failure detection as absent upstream)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from flexflow_tpu import (
+    DivergenceError,
+    FFConfig,
+    LossType,
+    MetricsType,
+    SGDOptimizer,
+    TrainingGuard,
+)
+
+from test_e2e_mlp import _toy_classification, build_mlp
+
+
+def _compiled_mlp(lr=0.1, epochs=6):
+    config = FFConfig(batch_size=64, epochs=epochs, seed=0)
+    ff = build_mlp(config)
+    ff.compile(optimizer=SGDOptimizer(lr=lr),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=[MetricsType.ACCURACY])
+    return ff
+
+
+def test_snapshot_restore_roundtrip():
+    ff = _compiled_mlp()
+    guard = TrainingGuard()
+    guard.snapshot(ff)
+    cm = ff.compiled
+    name = next(iter(cm.params))
+    good = np.asarray(cm.params[name]["kernel"])
+    # poison the live params
+    cm.params[name]["kernel"] = jnp.full_like(cm.params[name]["kernel"],
+                                              np.nan)
+    assert guard.recover(ff, verbose=False)
+    np.testing.assert_array_equal(np.asarray(cm.params[name]["kernel"]), good)
+    # lr backed off (live immediately: hyperparams are dynamic step args)
+    assert cm.optimizer.lr == pytest.approx(0.05)
+
+
+def test_guard_budget_exhausts():
+    ff = _compiled_mlp()
+    guard = TrainingGuard(max_restores=2)
+    guard.snapshot(ff)
+    assert guard.recover(ff, verbose=False)
+    assert guard.recover(ff, verbose=False)
+    assert not guard.recover(ff, verbose=False)  # budget gone
+    guard.snapshot(ff)  # healthy epoch resets it
+    assert guard.recover(ff, verbose=False)
+
+
+def _regression_mlp(lr, epochs):
+    """MSE diverges for real at a huge lr (CE's probability clipping keeps
+    its loss finite even with garbage params)."""
+    from flexflow_tpu import ActiMode, DataType, FFModel
+
+    config = FFConfig(batch_size=64, epochs=epochs, seed=0)
+    ff = FFModel(config)
+    x = ff.create_tensor((64, 16), DataType.FLOAT, name="x")
+    t = ff.dense(x, 32, ActiMode.RELU)
+    t = ff.dense(t, 1)
+    ff.compile(optimizer=SGDOptimizer(lr=lr),
+               loss_type=LossType.MEAN_SQUARED_ERROR_AVG_REDUCE, metrics=[])
+    return ff
+
+
+def _regression_data():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(256, 16)).astype(np.float32)
+    y = (x @ rng.normal(size=(16, 1))).astype(np.float32)
+    return x, y
+
+
+def test_fit_recovers_from_divergence():
+    """An absurd lr makes the loss non-finite; the guard rolls back and
+    backs the lr off until training proceeds."""
+    ff = _regression_mlp(lr=1e6, epochs=8)
+    x, y = _regression_data()
+    guard = TrainingGuard(max_restores=6, lr_backoff=1e-4)
+    hist = ff.fit(x, y, verbose=False, guard=guard)
+    assert len(hist) == 8
+    # final params are finite (rolled back + retrained at a sane lr)
+    for leaf in jax.tree_util.tree_leaves(ff.compiled.params):
+        assert np.isfinite(np.asarray(leaf)).all()
+    assert ff.compiled.optimizer.lr < 1e6
+
+
+def test_fit_raises_when_budget_exhausted():
+    ff = _regression_mlp(lr=1e6, epochs=8)
+    x, y = _regression_data()
+    # lr_backoff=1.0: every epoch diverges again, budget runs out
+    guard = TrainingGuard(max_restores=2, lr_backoff=1.0)
+    with pytest.raises(DivergenceError):
+        ff.fit(x, y, verbose=False, guard=guard)
+
+
+def test_lr_change_is_live_without_retrace():
+    """Regression: hyperparams are dynamic step arguments. Baked-constant
+    lr + 're-jit' silently reused the stale executable (pjit caches on the
+    underlying function), so lr changes only took effect by accident."""
+    ff = _regression_mlp(lr=0.0, epochs=1)
+    x, y = _regression_data()
+    cm = ff.compiled
+    name = next(iter(cm.params))
+    before = np.asarray(cm.params[name]["kernel"]).copy()
+    # step at lr=0: params must not move (also traces the executable)
+    p, o, *_ = cm.train_step(cm.params, cm.opt_state, jax.random.key(0),
+                             x[:64], y[:64])
+    cm.params, cm.opt_state = p, o
+    np.testing.assert_array_equal(np.asarray(p[name]["kernel"]), before)
+    # flip lr WITHOUT any sharding change; the very next step must move
+    ff.set_learning_rate(0.5)
+    p, o, *_ = cm.train_step(cm.params, cm.opt_state, jax.random.key(0),
+                             x[:64], y[:64])
+    assert np.abs(np.asarray(p[name]["kernel"]) - before).max() > 1e-4

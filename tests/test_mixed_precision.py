@@ -99,3 +99,28 @@ def test_bf16_batchnorm_stats_stay_fp32():
     after = cm.params[bn_name]["running_mean"]
     assert after.dtype == jnp.float32
     assert not np.allclose(before, np.asarray(after))
+
+
+def test_bf16_pipeline_trains():
+    """Mixed precision reaches the pipeline engine's stage programs
+    (parallel/pipeline.py casts like the main compiler)."""
+    import jax
+
+    from flexflow_tpu import FFModel, make_mesh
+    from flexflow_tpu.parallel.pipeline import PipelineConfig
+
+    config = FFConfig(batch_size=8, seed=0, compute_dtype="bfloat16")
+    ff = build_mlp(config)
+    mesh = make_mesh({"pipe": 2, "data": 4})
+    ff.compile(optimizer=SGDOptimizer(lr=0.1),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=[], mesh=mesh,
+               pipeline=PipelineConfig(num_stages=2, num_microbatches=2))
+    x, y = _toy_classification(n=8)
+    loss, _ = ff.pipelined.train_step(jax.random.key(0),
+                                      [jnp.asarray(x[:8])], jnp.asarray(y[:8]))
+    assert np.isfinite(float(loss))
+    # masters stay fp32
+    for sp in ff.pipelined.stage_params:
+        for leaf in jax.tree_util.tree_leaves(sp):
+            assert leaf.dtype == jnp.float32
