@@ -52,14 +52,28 @@ class TrainingGuard:
                 return None
             return s
 
-        return [treedef, [(np.asarray(l), shard_of(l)) for l in leaves]]
+        def snap_leaf(l):
+            # multi-host arrays span non-addressable devices: np.asarray
+            # would raise, so keep a DEVICE-side copy instead (jnp.copy
+            # allocates fresh buffers, immune to the step's donation)
+            if hasattr(l, "is_fully_addressable") and not l.is_fully_addressable:
+                return (jax.numpy.copy(l), "device")
+            return (np.asarray(l), shard_of(l))
+
+        return [treedef, [snap_leaf(l) for l in leaves]]
 
     @staticmethod
     def _to_device(snap) -> Any:
         treedef, pairs = snap
-        return treedef.unflatten([
-            jax.device_put(v, s) if s is not None else jax.numpy.asarray(v)
-            for v, s in pairs])
+        out = []
+        for v, s in pairs:
+            if s == "device":
+                out.append(jax.numpy.copy(v))  # keep the snapshot intact
+            elif s is not None:
+                out.append(jax.device_put(v, s))
+            else:
+                out.append(jax.numpy.asarray(v))
+        return treedef.unflatten(out)
 
     def snapshot(self, ff) -> None:
         """Record the current (healthy) params + optimizer state."""

@@ -701,6 +701,7 @@ class FFModel:
         strategies: Optional[Dict[str, Dict[str, str]]] = None,
         mesh=None,
         pipeline=None,
+        logits_tensor: Optional[Tensor] = None,
     ) -> None:
         """reference: FFModel::compile (model.cc:2803); Python surface
         flexflow_cffi.py:2022. ``pipeline`` takes a
@@ -721,7 +722,11 @@ class FFModel:
         mtypes: List[MetricsType] = []
         for m in metrics or []:
             mtypes.append(_METRICS_FROM_STRING[m] if isinstance(m, str) else m)
-        logits = self._final_output()
+        # explicit output override for multi-leaf graphs (an imported
+        # module whose recurrent state is also a graph leaf, a BERT whose
+        # pooler is not the tensor to train on); default: the last leaf
+        logits = logits_tensor if logits_tensor is not None \
+            else self._final_output()
         # collect per-layer strategy attrs (the ParallelConfig-override path)
         strat = dict(strategies or {})
         for layer in self.layers:
@@ -1035,21 +1040,23 @@ class FFModel:
                     if recompile_on_condition(self, recompile_state):
                         cm = self.compiled
             pm.flush()
-            lv = float(last_loss) if last_loss is not None else float("nan")
             if guard is not None:
-                epoch_ok = (loss_accum is not None
-                            and np.isfinite(float(loss_accum)))
-                if not epoch_ok:
+                # a zero-batch epoch (loss_accum None) ran nothing: healthy
+                accum = (float(loss_accum) if loss_accum is not None
+                         else 0.0)
+                if not np.isfinite(accum):
                     from .guard import DivergenceError
 
                     if not guard.recover(self, verbose=verbose):
                         raise DivergenceError(
-                            f"loss {lv} at epoch {epoch} and the guard's "
-                            f"restore budget is exhausted")
+                            f"epoch {epoch} loss sum {accum} and the "
+                            f"guard's restore budget is exhausted")
                     history.append(pm)
                     continue
                 guard.snapshot(self)
             if verbose:
+                # host sync only when someone reads the value
+                lv = float(last_loss) if last_loss is not None else float("nan")
                 print(
                     f"epoch {epoch}: loss {lv:.4f}  {pm.report(cm.metrics)}",
                     flush=True,

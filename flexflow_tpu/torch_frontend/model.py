@@ -104,6 +104,26 @@ def _module_record(name, mod, inputs):
         a = dict(embed_dim=mod.embed_dim, num_heads=mod.num_heads,
                  dropout=float(mod.dropout),
                  bias=mod.in_proj_bias is not None)
+    elif isinstance(mod, (nn.LSTM, nn.GRU, nn.RNN)):
+        # our recurrent ops share torch's gate order and weight layout
+        # (ops/recurrent.py), so single-layer unidirectional cells map 1:1
+        if not mod.batch_first:
+            raise ValueError(
+                f"{name}: {type(mod).__name__}(batch_first=False) uses the "
+                f"(seq, batch, feat) layout; construct with batch_first=True")
+        if mod.num_layers != 1 or mod.bidirectional:
+            raise ValueError(
+                f"{name}: only single-layer unidirectional "
+                f"{type(mod).__name__} imports (stack ff.lstm calls for "
+                f"multi-layer)")
+        if isinstance(mod, nn.RNN) and mod.nonlinearity != "tanh":
+            raise ValueError(f"{name}: RNN(nonlinearity='relu') unsupported")
+        if getattr(mod, "proj_size", 0):
+            raise ValueError(f"{name}: LSTM proj_size > 0 unsupported")
+        # isinstance, not type(): user subclasses import like their base
+        op = ("lstm" if isinstance(mod, nn.LSTM)
+              else "gru" if isinstance(mod, nn.GRU) else "rnn")
+        a = dict(hidden_size=mod.hidden_size)
     else:
         raise ValueError(f"unsupported module at {name}: {type(mod).__name__}")
     return {"name": name, "kind": "module", "op": op, "inputs": inputs,
@@ -307,7 +327,24 @@ def _function_record(node, torch, F) -> Dict:
         return rec("mean", [args[0].name],
                    {"dims": dims, "keepdims": bool(node.kwargs.get("keepdim", False))})
     if tgt is operator.getitem:
-        return rec("getitem", [args[0].name], {"index": int(args[1])})
+        idx = args[1]
+        if isinstance(idx, int):
+            return rec("getitem", [args[0].name], {"index": idx})
+        # tensor slicing (x[:, -1], x[:, 1:3]) -> the static Slice op
+        items = []
+        for it in (idx if isinstance(idx, tuple) else (idx,)):
+            if isinstance(it, slice):
+                if any(is_node(v) for v in (it.start, it.stop, it.step)):
+                    raise ValueError(f"{name}: dynamic slice bounds are "
+                                     f"not importable")
+                items.append({"kind": "slice",
+                              "start": it.start, "stop": it.stop,
+                              "step": it.step})
+            elif isinstance(it, int):
+                items.append({"kind": "int", "i": it})
+            else:
+                raise ValueError(f"{name}: unsupported index {it!r}")
+        return rec("slice", [args[0].name], {"items": items})
     raise ValueError(f"unsupported function: {tgt}")
 
 
@@ -496,6 +533,17 @@ class PyTorchModel:
                 x[0], x[1], x[2], a["embed_dim"], a["num_heads"],
                 dropout=a.get("dropout", 0.0), bias=a.get("bias", True),
                 name=name)
+        if op in ("lstm", "gru", "rnn"):
+            outs = getattr(ff, op)(x[0], a["hidden_size"],
+                                   return_sequences=True, return_state=True,
+                                   name=name)
+            # mirror torch's return structure so traced getitems resolve:
+            # LSTM -> (output, (h, c)); GRU/RNN -> (output, h)
+            if op == "lstm":
+                y, h, c = outs
+                return [y, (h, c)]
+            y, h = outs
+            return [y, h]
         if op == "slice":
             return ff.slice_tensor(x[0], a["items"], name=name)
         if op == "getitem":
@@ -585,6 +633,17 @@ def copy_weights(ffmodel, torch_module,
                     if "running_var" in wmap and mod.running_var is not None:
                         wmap["running_var"].set_weights(
                             ffmodel, mod.running_var.detach().numpy())
+            elif isinstance(mod, (torch.nn.LSTM, torch.nn.GRU, torch.nn.RNN)):
+                # same gate order/layout as ops/recurrent.py (torch's)
+                wmap["kernel"].set_weights(
+                    ffmodel, mod.weight_ih_l0.detach().numpy().T)
+                wmap["recurrent_kernel"].set_weights(
+                    ffmodel, mod.weight_hh_l0.detach().numpy().T)
+                if getattr(mod, "bias_ih_l0", None) is not None:
+                    wmap["bias"].set_weights(
+                        ffmodel, mod.bias_ih_l0.detach().numpy())
+                    wmap["recurrent_bias"].set_weights(
+                        ffmodel, mod.bias_hh_l0.detach().numpy())
             elif isinstance(mod, torch.nn.MultiheadAttention):
                 # torch packs q/k/v projections row-wise into
                 # in_proj_weight (3E, E); FF stores per-head (E_in, H, D)

@@ -162,3 +162,73 @@ def test_size_driven_views_import():
     ff, got = _import_and_forward(mod, x, 4)
     want = mod(torch.from_numpy(x)).detach().numpy()
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_import_lstm_classifier_matches_torch():
+    """nn.LSTM/GRU modules import 1:1 (our recurrent ops share torch's
+    gate order/layout, ops/recurrent.py) including tensor slicing of the
+    sequence output."""
+    import torch
+    import torch.nn as nn
+
+    from flexflow_tpu import DataType, FFConfig, FFModel, SGDOptimizer
+    from flexflow_tpu.torch_frontend import PyTorchModel, copy_weights
+
+    class SeqClassifier(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.lstm = nn.LSTM(6, 10, batch_first=True)
+            self.fc = nn.Linear(10, 3)
+
+        def forward(self, x):
+            out, _ = self.lstm(x)
+            return self.fc(out[:, -1])
+
+    torch.manual_seed(0)
+    mod = SeqClassifier().eval()
+    pm = PyTorchModel(mod)
+    ff = FFModel(FFConfig(batch_size=4))
+    x = ff.create_tensor((4, 7, 6), DataType.FLOAT, name="x")
+    (out,) = pm.apply(ff, [x])
+    assert out.dims == (4, 3)
+    ff.compile(optimizer=SGDOptimizer(lr=0.01), loss_type=None, metrics=[])
+    copy_weights(ff, mod, pm.module_paths)
+    xs = np.random.default_rng(0).normal(size=(4, 7, 6)).astype(np.float32)
+    got = np.asarray(ff.compiled.forward_fn(ff.compiled.params, xs))
+    with torch.no_grad():
+        ref = mod(torch.tensor(xs)).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_import_gru_state_output():
+    """GRU returns (output, h); consuming the final state imports too."""
+    import torch
+    import torch.nn as nn
+
+    from flexflow_tpu import DataType, FFConfig, FFModel, SGDOptimizer
+    from flexflow_tpu.torch_frontend import PyTorchModel, copy_weights
+
+    class G(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.gru = nn.GRU(5, 8, batch_first=True)
+
+        def forward(self, x):
+            out, h = self.gru(x)
+            return out
+
+    torch.manual_seed(1)
+    mod = G().eval()
+    pm = PyTorchModel(mod)
+    ff = FFModel(FFConfig(batch_size=3))
+    x = ff.create_tensor((3, 6, 5), DataType.FLOAT, name="x")
+    (out,) = pm.apply(ff, [x])
+    # the unused state output h is also a graph leaf; pin the output
+    ff.compile(optimizer=SGDOptimizer(lr=0.01), loss_type=None, metrics=[],
+               logits_tensor=out)
+    copy_weights(ff, mod, pm.module_paths)
+    xs = np.random.default_rng(1).normal(size=(3, 6, 5)).astype(np.float32)
+    got = np.asarray(ff.compiled.forward_fn(ff.compiled.params, xs))
+    with torch.no_grad():
+        ref = mod(torch.tensor(xs)).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
