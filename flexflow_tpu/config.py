@@ -91,6 +91,11 @@ class FFConfig:
     # it is the TPU-native upgrade — XLA reduce-scatters the gradient into
     # the state update and all-gathers the weight delta)
     zero_optimizer: bool = False
+    # gradient accumulation: each fit step splits its batch into K
+    # microbatches, averages their gradients inside ONE jitted step
+    # (lax.scan), and applies a single optimizer update — K x the
+    # effective batch at 1/K the activation memory. No reference analog.
+    grad_accum_steps: int = 1
     seed: int = 0
     # mesh description: axis names and sizes; None => 1-D data mesh over all
     # visible devices (reference analog: register_all_machine_views'
@@ -177,6 +182,8 @@ class FFConfig:
                 cfg.compute_dtype = _next()
             elif a == "--zero-optimizer":
                 cfg.zero_optimizer = True
+            elif a == "--grad-accum-steps":
+                cfg.grad_accum_steps = int(_next())
             # unknown flags are ignored, matching the reference's tolerance
             i += 1
         return cfg

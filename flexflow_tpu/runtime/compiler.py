@@ -400,11 +400,13 @@ def compile_model(
     # BatchMatmul's a/b_seq_length_dim, model.cc:2415-2420). The public
     # wrappers keep the old calling convention with seq_length as a
     # keyword defaulting to -1 (no truncation).
+    accum = max(1, int(getattr(config, "grad_accum_steps", 1)))
+
     def train_step(seq_length, hyper, params, opt_state, rng, *batch):
         xs = batch[:n_inputs]
         y = batch[n_inputs]
 
-        def loss_fn(params):
+        def loss_fn(params, xs, y, rng):
             acts, aux, updates = _forward_graph(
                 ops, mesh, params, dict(zip(input_ids, xs)), True, rng,
                 seq_length, cdt,
@@ -423,9 +425,58 @@ def compile_model(
                     loss = loss + reg.penalty(params[op.name]["kernel"])
             return loss, (logits, updates)
 
-        (loss, (logits, updates)), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(params)
-        batch_metrics = compute_batch_metrics(metrics, loss_type, logits, y, from_logits)
+        vag = jax.value_and_grad(loss_fn, has_aux=True)
+        if accum == 1:
+            (loss, (logits, updates)), grads = vag(params, xs, y, rng)
+            batch_metrics = compute_batch_metrics(
+                metrics, loss_type, logits, y, from_logits)
+        else:
+            # gradient accumulation: split the batch into K microbatches,
+            # run them through a lax.scan (ONE compiled body, K x less
+            # activation memory), average grads, update once
+            if y.shape[0] % accum != 0:
+                raise ValueError(
+                    f"batch {y.shape[0]} not divisible by "
+                    f"grad_accum_steps {accum}")
+
+            def resh(a):
+                return a.reshape((accum, a.shape[0] // accum) + a.shape[1:])
+
+            xs_k = tuple(resh(a) for a in xs)
+            y_k = resh(y)
+            rngs = jax.random.split(rng, accum)
+
+            def one(xs_i, y_i, rng_i):
+                (li, (lgi, updi)), gi = vag(params, xs_i, y_i, rng_i)
+                bmi = compute_batch_metrics(
+                    metrics, loss_type, lgi, y_i, from_logits)
+                return li, gi, bmi, updi
+
+            def micro(carry, mb):
+                g_acc, bm_acc, l_acc, upd_acc = carry
+                li, gi, bmi, updi = one(*mb)
+                g_acc = jax.tree_util.tree_map(jnp.add, g_acc, gi)
+                bm_acc = {k: bm_acc[k] + bmi[k] for k in bm_acc}
+                # BN running stats: sum now, average after the scan — one
+                # EMA advance driven by the full batch's mean statistics
+                upd_acc = {k: upd_acc[k] + v for k, v in updi.items()}
+                return (g_acc, bm_acc, l_acc + li, upd_acc), None
+
+            # zero-seed the carry from abstract shapes so the body is
+            # traced/compiled ONCE (an unrolled first microbatch would
+            # duplicate the whole fwd+bwd graph)
+            shapes = jax.eval_shape(
+                one, tuple(a[0] for a in xs_k), y_k[0], rngs[0])
+            _, g_s, bm_s, upd_s = shapes
+            zeros = lambda tree: jax.tree_util.tree_map(
+                lambda s: jnp.zeros(s.shape, s.dtype), tree)
+            carry0 = (zeros(g_s), zeros(bm_s), jnp.zeros((), jnp.float32),
+                      zeros(upd_s))
+            (grads, batch_metrics, loss_sum, upd_sum), _ = jax.lax.scan(
+                micro, carry0, (xs_k, y_k, rngs))
+            grads = jax.tree_util.tree_map(lambda g: g / accum, grads)
+            updates = {k: v / accum for k, v in upd_sum.items()}
+            loss = loss_sum / accum
         new_params, new_opt_state = optimizer.update(
             params, grads, opt_state, wd_mask, hyper)
         if opt_state_shardings is not None:

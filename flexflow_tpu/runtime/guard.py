@@ -54,10 +54,13 @@ class TrainingGuard:
 
         def snap_leaf(l):
             # multi-host arrays span non-addressable devices: np.asarray
-            # would raise, so keep a DEVICE-side copy instead (jnp.copy
-            # allocates fresh buffers, immune to the step's donation)
+            # would raise. Snapshot THIS process's addressable shards to
+            # host (no HBM cost, and the copy survives a device reset) and
+            # remember enough to reassemble the global array.
             if hasattr(l, "is_fully_addressable") and not l.is_fully_addressable:
-                return (jax.numpy.copy(l), "device")
+                shards = [(sh.device, np.asarray(sh.data))
+                          for sh in l.addressable_shards]
+                return (("shards", l.shape, l.dtype, shards), l.sharding)
             return (np.asarray(l), shard_of(l))
 
         return [treedef, [snap_leaf(l) for l in leaves]]
@@ -67,8 +70,12 @@ class TrainingGuard:
         treedef, pairs = snap
         out = []
         for v, s in pairs:
-            if s == "device":
-                out.append(jax.numpy.copy(v))  # keep the snapshot intact
+            if isinstance(v, tuple) and v and v[0] == "shards":
+                _, shape, dtype, shards = v
+                bufs = [jax.device_put(np.asarray(d, dtype), dev)
+                        for dev, d in shards]
+                out.append(jax.make_array_from_single_device_arrays(
+                    shape, s, bufs))
             elif s is not None:
                 out.append(jax.device_put(v, s))
             else:

@@ -538,12 +538,15 @@ class PyTorchModel:
                                    return_sequences=True, return_state=True,
                                    name=name)
             # mirror torch's return structure so traced getitems resolve:
-            # LSTM -> (output, (h, c)); GRU/RNN -> (output, h)
+            # LSTM -> (output, (h, c)); GRU/RNN -> (output, h). torch's
+            # states carry a leading num_layers dim — FF's don't — so wrap
+            # each state in a 1-element list: h[0] and h[-1] (the common
+            # final-state idioms) both resolve to the (B, H) tensor
             if op == "lstm":
                 y, h, c = outs
-                return [y, (h, c)]
+                return [y, ([h], [c])]
             y, h = outs
-            return [y, h]
+            return [y, [h]]
         if op == "slice":
             return ff.slice_tensor(x[0], a["items"], name=name)
         if op == "getitem":

@@ -232,3 +232,38 @@ def test_import_gru_state_output():
     with torch.no_grad():
         ref = mod(torch.tensor(xs)).numpy()
     np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_import_lstm_final_state_idiom():
+    """`out, (h, c) = lstm(x); fc(h[-1])` — the most common torch LSTM
+    classifier shape — imports (states emulate torch's num_layers dim)."""
+    import torch
+    import torch.nn as nn
+
+    from flexflow_tpu import DataType, FFConfig, FFModel, SGDOptimizer
+    from flexflow_tpu.torch_frontend import PyTorchModel, copy_weights
+
+    class C(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.lstm = nn.LSTM(5, 9, batch_first=True)
+            self.fc = nn.Linear(9, 2)
+
+        def forward(self, x):
+            out, (h, c) = self.lstm(x)
+            return self.fc(h[-1])
+
+    torch.manual_seed(3)
+    mod = C().eval()
+    pm = PyTorchModel(mod)
+    ff = FFModel(FFConfig(batch_size=4))
+    x = ff.create_tensor((4, 6, 5), DataType.FLOAT, name="x")
+    (out,) = pm.apply(ff, [x])
+    ff.compile(optimizer=SGDOptimizer(lr=0.01), loss_type=None, metrics=[],
+               logits_tensor=out)
+    copy_weights(ff, mod, pm.module_paths)
+    xs = np.random.default_rng(3).normal(size=(4, 6, 5)).astype(np.float32)
+    got = np.asarray(ff.compiled.forward_fn(ff.compiled.params, xs))
+    with torch.no_grad():
+        ref = mod(torch.tensor(xs)).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
