@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""OSDI'22 artifact-evaluation protocol runner.
+
+reference: scripts/osdi22ae/{bert,dlrm,xdl,mlp,candle_uno,inception,
+resnext-50}.sh — each runs a workload twice (searched strategy via
+--budget vs --only-data-parallel) and reports the throughput ratio, the
+`vs_baseline` metric BASELINE.md defines. Here one runner drives the
+example scripts with the same flag pairs.
+
+Usage:
+    python scripts/osdi_ae/run_ae.py [--budget 10] [--epochs 1]
+           [--batch-size 32] [config ...]
+Configs default to the BASELINE.md five: mlp dlrm xdl bert moe.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+EXAMPLES = os.path.join(REPO, "examples", "python", "native")
+
+CONFIGS = {
+    "mlp": "mnist_mlp.py",
+    "dlrm": "dlrm.py",
+    "xdl": "xdl.py",
+    "bert": "bert_proxy_native.py",
+    "moe": "moe.py",
+    "alexnet": "alexnet.py",
+    "inception": "inception.py",
+    "resnext": "resnext50.py",
+    "candle_uno": "candle_uno.py",
+}
+
+
+def run_one(script: str, extra, epochs, batch) -> float:
+    cmd = [sys.executable, script, "--epochs", str(epochs),
+           "--batch-size", str(batch), *extra]
+    proc = subprocess.run(cmd, cwd=EXAMPLES, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(f"{script} {extra}: rc={proc.returncode}\n"
+                           f"{proc.stderr[-1500:]}")
+    m = re.search(r"THROUGHPUT = ([0-9.]+)", proc.stdout)
+    if not m:
+        raise RuntimeError(f"{script}: no THROUGHPUT line\n{proc.stdout[-800:]}")
+    return float(m.group(1))
+
+
+def main():
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--budget", default="10")
+    ap.add_argument("--epochs", default="1")
+    ap.add_argument("--batch-size", default="32")
+    ap.add_argument("configs", nargs="*", choices=[[], *CONFIGS],
+                    default=["mlp", "dlrm", "xdl", "bert", "moe"])
+    ns = ap.parse_args()
+    configs = ns.configs or ["mlp", "dlrm", "xdl", "bert", "moe"]
+    print(f"# OSDI AE protocol: searched (--budget {ns.budget}) vs "
+          f"--only-data-parallel; epochs={ns.epochs} batch={ns.batch_size}")
+    for c in configs:
+        script = CONFIGS[c]
+        searched = run_one(script, ["--budget", ns.budget],
+                           ns.epochs, ns.batch_size)
+        dp = run_one(script, ["--only-data-parallel"],
+                     ns.epochs, ns.batch_size)
+        print(f"{c:12s} searched={searched:10.2f}  dp={dp:10.2f}  "
+              f"speedup={searched / dp:6.3f}x")
+
+
+if __name__ == "__main__":
+    main()

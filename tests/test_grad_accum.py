@@ -131,3 +131,37 @@ def test_accum_batchnorm_stats_use_full_batch():
                                rtol=1e-4, atol=1e-6)
     # and it must have actually moved off the zero init
     assert not np.allclose(np.asarray(p4[bn4]["running_mean"]), 0.0)
+
+
+def test_accum_composes_with_bf16_zero_and_mesh():
+    """The round's features stack: bf16 compute, ZeRO-1 state sharding,
+    grad accumulation, dp x tp mesh, and the training guard — one fit."""
+    from flexflow_tpu import TrainingGuard
+
+    config = FFConfig(batch_size=32, epochs=6, seed=0,
+                      compute_dtype="bfloat16", zero_optimizer=True,
+                      grad_accum_steps=2, mesh_shape={"data": 4, "model": 2})
+    ff = FFModel(config)
+    x = ff.create_tensor((32, 12), DataType.FLOAT, name="x")
+    t = ff.dense(x, 64, ActiMode.RELU, strategy={"out": "model"})
+    t = ff.dense(t, 4)
+    t = ff.softmax(t)
+    ff.compile(optimizer=SGDOptimizer(lr=0.1, momentum=0.9),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=[MetricsType.ACCURACY])
+    rng = np.random.default_rng(0)
+    xs = rng.normal(size=(128, 12)).astype(np.float32)
+    w = rng.normal(size=(12, 4)).astype(np.float32)
+    ys = np.argmax(xs @ w, axis=1).astype(np.int32).reshape(-1, 1)
+    hist = ff.fit(xs, ys, verbose=False, guard=TrainingGuard())
+    assert hist[-1].accuracy > 0.7, hist[-1].accuracy
+    cm = ff.compiled
+    # all three layout features held: fp32 masters, model-axis TP kernel,
+    # data-sharded momentum
+    for leaf in jax.tree_util.tree_leaves(cm.params):
+        assert leaf.dtype == jnp.float32
+    tp_name = next(op.name for op in cm.ops if op.name in cm.params)
+    assert "model" in str(cm.params[tp_name]["kernel"].sharding.spec)
+    momenta = [l for l in jax.tree_util.tree_leaves(cm.opt_state)
+               if l.ndim >= 1]
+    assert any("data" in str(l.sharding.spec) for l in momenta)
