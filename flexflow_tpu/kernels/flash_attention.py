@@ -106,6 +106,112 @@ def _pick_block(s: int, pref: int) -> Optional[int]:
     return None
 
 
+# -- block-size tuning --------------------------------------------------------
+# Round-2 measurement on a real v5e showed the default tile a hair SLOWER
+# than XLA's fused attention at the bench shape; the right block_q depends
+# on seq/head_dim and the chip. Resolution order: the FLEXFLOW_FA_BLOCK_Q
+# env override, then a per-shape autotune cache (populated by autotune(),
+# persisted to FLEXFLOW_FA_TUNE_CACHE if set), then 128.
+_TUNE_CACHE: dict = {}
+_CACHE_FILE_LOADED = False
+
+
+def default_block_q(sq: int, skv: int, d: int,
+                    causal: bool = False) -> int:
+    import os
+
+    env = os.environ.get("FLEXFLOW_FA_BLOCK_Q")
+    if env:
+        try:
+            v = int(env)
+        except ValueError as e:
+            raise ValueError(
+                f"FLEXFLOW_FA_BLOCK_Q={env!r} is not an integer") from e
+        if v < 8 or v % 8 != 0:
+            raise ValueError(
+                f"FLEXFLOW_FA_BLOCK_Q={v} must be a positive multiple of 8")
+        return v
+    global _CACHE_FILE_LOADED
+    if not _CACHE_FILE_LOADED:
+        _CACHE_FILE_LOADED = True
+        path = os.environ.get("FLEXFLOW_FA_TUNE_CACHE")
+        if path and os.path.exists(path):
+            try:
+                load_tune_cache(path)
+            except (OSError, ValueError):
+                pass
+    return _TUNE_CACHE.get((sq, skv, d, bool(causal)), 128)
+
+
+def autotune(shape=(4, 512, 8, 64), candidates=(64, 128, 256, 512),
+             causal: bool = False, iters: int = 10,
+             cache_path: Optional[str] = None) -> dict:
+    """Time the forward kernel per candidate block_q on the CURRENT
+    backend and remember the winner for this (seq, seq, head_dim).
+
+    Run once on real hardware (tests_tpu/ has a gated smoke); results are
+    process-cached and optionally persisted as JSON. Returns
+    {block_q: seconds} for inspection."""
+    import json
+    import os
+    import time
+
+    import numpy as np
+
+    b, s, h, d = shape
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(b * h, s, d)).astype(np.float32))
+    interpret = pallas_mode() == "interpret"
+    results = {}
+    for cand in candidates:
+        bq = _pick_block(s, cand)
+        if bq != cand:
+            continue  # shape can't tile at this size
+        fn = jax.jit(functools.partial(
+            _flash, causal=causal, scale=d ** -0.5, block_q=cand,
+            interpret=interpret))
+        out = fn(q, q, q)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(q, q, q)
+        jax.block_until_ready(out)
+        results[cand] = (time.perf_counter() - t0) / iters
+    if results:
+        best = min(results, key=results.get)
+        _TUNE_CACHE[(s, s, d, bool(causal))] = best
+        path = cache_path or os.environ.get("FLEXFLOW_FA_TUNE_CACHE")
+        if path:
+            try:
+                data = {}
+                if os.path.exists(path):
+                    with open(path) as f:
+                        data = json.load(f)
+                data[f"{s}x{s}x{d}x{int(bool(causal))}"] = best
+                with open(path, "w") as f:
+                    json.dump(data, f)
+            except (OSError, ValueError):  # incl. a corrupt existing file
+                pass
+    return results
+
+
+def load_tune_cache(path: str) -> int:
+    """Load a persisted autotune cache; returns entries loaded."""
+    import json
+
+    with open(path) as f:
+        data = json.load(f)
+    n = 0
+    for k, v in data.items():
+        parts = [int(x) for x in k.split("x")]
+        if len(parts) == 3:  # pre-causal-key format
+            parts.append(0)
+        s1, s2, d, c = parts
+        _TUNE_CACHE[(s1, s2, d, bool(c))] = int(v)
+        n += 1
+    return n
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash(q, k, v, causal, scale, block_q, interpret):
     out, _ = _flash_fwd(q, k, v, causal, scale, block_q, interpret)
@@ -171,19 +277,25 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 VMEM_BUDGET_BYTES = 12 * 1024 * 1024  # leave headroom under the ~16 MB core
 
 
-def supported(q_shape, k_shape) -> bool:
+def supported(q_shape, k_shape, causal: bool = False) -> bool:
     """Whether the kernel path handles these (B, S, H, D) shapes.
 
     Checks block divisibility and the VMEM working set (K/V panels +
     per-tile q/o/g and logits, float32); longer sequences fall back to the
     jnp path / ring attention rather than failing at Mosaic compile.
+    Budgets with the SAME block the kernel will resolve (env/tuned/128) —
+    a tuned 512 tile must not pass a gate computed for 128.
     """
     if pallas_mode() is None:
         return False
     sq, skv = q_shape[1], k_shape[1]
     d = q_shape[3]
-    bq = _pick_block(sq, 128)
-    bk = _pick_block(skv, 128)
+    try:
+        pref = default_block_q(sq, skv, d, causal)
+    except ValueError:
+        return False  # malformed env override: fall back to the jnp path
+    bq = _pick_block(sq, pref)
+    bk = _pick_block(skv, pref)
     if bq is None or bk is None:
         return False
     # worst case is the dkv backward: full q/g/o panels + one k/v tile +
@@ -193,7 +305,8 @@ def supported(q_shape, k_shape) -> bool:
     return max(working, fwd) <= VMEM_BUDGET_BYTES
 
 
-def sharded_supported(q_shape, k_shape, mesh, batch_axis, heads_axis) -> bool:
+def sharded_supported(q_shape, k_shape, mesh, batch_axis, heads_axis,
+                      causal: bool = False) -> bool:
     """Whether the shard_map-wrapped kernel handles these GLOBAL (B,S,H,D)
     shapes on this mesh: batch/heads must divide by their axis sizes and
     the per-shard block must satisfy :func:`supported`."""
@@ -207,13 +320,13 @@ def sharded_supported(q_shape, k_shape, mesh, batch_axis, heads_axis) -> bool:
         return False
     lq = (b // ddeg, sq, h // hdeg, d)
     lk = (k_shape[0] // ddeg, k_shape[1], k_shape[2] // hdeg, d)
-    return supported(lq, lk)
+    return supported(lq, lk, causal)
 
 
 def sharded_flash_attention(q, k, v, mesh, batch_axis, heads_axis,
                             causal: bool = False,
                             scale: Optional[float] = None,
-                            block_q: int = 128) -> jax.Array:
+                            block_q: Optional[int] = None) -> jax.Array:
     """Flash attention composed with SPMD sharding via shard_map.
 
     Attention is independent across batch and heads, so each device runs
@@ -236,7 +349,7 @@ def sharded_flash_attention(q, k, v, mesh, batch_axis, heads_axis,
 
 def flash_attention(q, k, v, causal: bool = False,
                     scale: Optional[float] = None,
-                    block_q: int = 128) -> jax.Array:
+                    block_q: Optional[int] = None) -> jax.Array:
     """Fused attention. q/k/v: (B, S, H, D) (framework bshd convention).
 
     Differentiable (custom VJP). Caller is responsible for checking
@@ -247,6 +360,8 @@ def flash_attention(q, k, v, causal: bool = False,
     b, sq, h, d = q.shape
     skv = k.shape[1]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    if block_q is None:
+        block_q = default_block_q(sq, skv, d, causal)
     bq = _pick_block(sq, block_q)
     if bq is None or _pick_block(skv, block_q) is None:
         raise ValueError(

@@ -148,7 +148,7 @@ class MultiHeadAttention(Op):
             if drop == 0.0 and pallas_mode() is not None:
                 mesh = ctx.mesh
                 if mesh is None or mesh.size == 1:
-                    if fa.supported(qh.shape, kh.shape):
+                    if fa.supported(qh.shape, kh.shape, self.causal):
                         # Pallas fused attention: (S,S) logits never
                         # touch HBM.
                         ctxv = fa.flash_attention(
@@ -164,7 +164,8 @@ class MultiHeadAttention(Op):
                     heads_ax = (hdim.axis if hdim is not None and
                                 hdim.is_partitioned else None)
                     if fa.sharded_supported(qh.shape, kh.shape, mesh,
-                                            batch_ax, heads_ax):
+                                            batch_ax, heads_ax,
+                                            self.causal):
                         ctxv = fa.sharded_flash_attention(
                             qh, kh, vh, mesh, batch_ax, heads_ax,
                             causal=self.causal, scale=scale)

@@ -226,3 +226,34 @@ def test_moe_model_trains_with_pallas_kernels():
     y = np.argmax(x @ w, axis=1).astype(np.int32).reshape(-1, 1)
     hist = ff.fit(x, y, verbose=False)
     assert hist[-1].accuracy > 0.4, hist[-1].accuracy
+
+
+def test_flash_autotune_mechanics():
+    """autotune() picks a block size, caches it per shape, persists and
+    reloads (interpret mode here; the TPU-gated smoke in tests_tpu/ runs
+    it compiled)."""
+    import json
+
+    from flexflow_tpu.kernels import flash_attention as fa
+
+    results = fa.autotune(shape=(1, 64, 1, 8), candidates=(16, 32, 64),
+                          iters=1)
+    assert results and set(results) <= {16, 32, 64}
+    best = min(results, key=results.get)
+    assert fa.default_block_q(64, 64, 8) == best
+    import tempfile, os
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "tune.json")
+        fa.autotune(shape=(1, 64, 1, 8), candidates=(16, 32), iters=1,
+                    cache_path=p)
+        fa._TUNE_CACHE.clear()
+        assert fa.load_tune_cache(p) == 1
+        assert fa.default_block_q(64, 64, 8) in (16, 32)
+    fa._TUNE_CACHE.clear()
+
+
+def test_flash_env_block_override(monkeypatch):
+    from flexflow_tpu.kernels import flash_attention as fa
+
+    monkeypatch.setenv("FLEXFLOW_FA_BLOCK_Q", "32")
+    assert fa.default_block_q(512, 512, 64) == 32

@@ -87,3 +87,17 @@ def test_moe_kernels_compiled():
     assert np.asarray(g[0]).shape == (b, d)
     assert np.isfinite(np.asarray(g[0])).all()
     assert np.isfinite(np.asarray(g[1])).all()
+
+
+def test_flash_autotune_on_chip():
+    """Compiled-mode autotune at the bench shape; persists the winner so
+    later runs (and bench.py via FLEXFLOW_FA_TUNE_CACHE) pick it up."""
+    from flexflow_tpu.kernels import flash_attention as fa
+
+    results = fa.autotune(shape=(4, 512, 8, 64),
+                          candidates=(64, 128, 256, 512), iters=5)
+    assert results
+    best = min(results, key=results.get)
+    print("flash autotune:", {k: round(v * 1e3, 3) for k, v in results.items()},
+          "best:", best)
+    assert fa.default_block_q(512, 512, 64) == best
