@@ -57,7 +57,8 @@ class _BaseModel:
         self._seed = seed
 
     def fit(self, x, y, epochs: int = 1, batch_size: int = 32,
-            shuffle: bool = True, verbose: bool = False, callbacks=None):
+            shuffle: bool = True, verbose: bool = False, callbacks=None,
+            guard=None):
         """reference: BaseModel.fit (base_model.py:198). A changed
         batch_size forces a rebuild (the graph is compiled batch-first);
         epochs is honored on every call. ``callbacks`` follow the
@@ -87,7 +88,8 @@ class _BaseModel:
         self._build(xs, batch_size, epochs)
         if not callbacks:
             return self.ffmodel.fit(list(xs), y, epochs=epochs,
-                                    shuffle=shuffle, verbose=verbose)
+                                    shuffle=shuffle, verbose=verbose,
+                                    guard=guard)
 
         from .callbacks import CallbackList
 
@@ -105,7 +107,8 @@ class _BaseModel:
                 # fit builds a fresh DataLoaderGroup from config.seed
                 self.ffmodel.config.seed = base_seed + epoch
                 pms = self.ffmodel.fit(list(xs), y, epochs=1,
-                                       shuffle=shuffle, verbose=verbose)
+                                       shuffle=shuffle, verbose=verbose,
+                                       guard=guard)
                 pm = pms[-1]
                 history.extend(pms)
                 logs = {"accuracy": pm.accuracy}

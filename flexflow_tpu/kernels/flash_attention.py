@@ -113,7 +113,7 @@ def _pick_block(s: int, pref: int) -> Optional[int]:
 # env override, then a per-shape autotune cache (populated by autotune(),
 # persisted to FLEXFLOW_FA_TUNE_CACHE if set), then 128.
 _TUNE_CACHE: dict = {}
-_CACHE_FILE_LOADED = False
+_CACHE_FILE_LOADED: Optional[str] = None  # path last loaded successfully
 
 
 def default_block_q(sq: int, skv: int, d: int,
@@ -132,14 +132,15 @@ def default_block_q(sq: int, skv: int, d: int,
                 f"FLEXFLOW_FA_BLOCK_Q={v} must be a positive multiple of 8")
         return v
     global _CACHE_FILE_LOADED
-    if not _CACHE_FILE_LOADED:
-        _CACHE_FILE_LOADED = True
-        path = os.environ.get("FLEXFLOW_FA_TUNE_CACHE")
-        if path and os.path.exists(path):
-            try:
-                load_tune_cache(path)
-            except (OSError, ValueError):
-                pass
+    path = os.environ.get("FLEXFLOW_FA_TUNE_CACHE")
+    # retry until a load SUCCEEDS for the current path: the env var or the
+    # file may appear after the process's first attention call
+    if path and _CACHE_FILE_LOADED != path and os.path.exists(path):
+        try:
+            load_tune_cache(path)
+            _CACHE_FILE_LOADED = path
+        except (OSError, ValueError):
+            pass
     return _TUNE_CACHE.get((sq, skv, d, bool(causal)), 128)
 
 
@@ -167,29 +168,42 @@ def autotune(shape=(4, 512, 8, 64), candidates=(64, 128, 256, 512),
         bq = _pick_block(s, cand)
         if bq != cand:
             continue  # shape can't tile at this size
+        # VMEM gate, same formula as supported(): don't let one oversized
+        # candidate's Mosaic failure discard the other timings
+        fwd_bytes = 4 * (2 * s * d + 3 * cand * d + 2 * cand * s)
+        if fwd_bytes > VMEM_BUDGET_BYTES:
+            continue
         fn = jax.jit(functools.partial(
             _flash, causal=causal, scale=d ** -0.5, block_q=cand,
             interpret=interpret))
-        out = fn(q, q, q)
-        jax.block_until_ready(out)
-        t0 = time.perf_counter()
-        for _ in range(iters):
+        try:
             out = fn(q, q, q)
-        jax.block_until_ready(out)
+            jax.block_until_ready(out)
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = fn(q, q, q)
+            jax.block_until_ready(out)
+        except Exception:  # compile/alloc failure: skip this candidate
+            continue
         results[cand] = (time.perf_counter() - t0) / iters
     if results:
         best = min(results, key=results.get)
         _TUNE_CACHE[(s, s, d, bool(causal))] = best
         path = cache_path or os.environ.get("FLEXFLOW_FA_TUNE_CACHE")
-        if path:
+        # multi-host: only process 0 persists (all processes tuned the
+        # same shapes); write-temp + os.replace keeps readers from ever
+        # seeing a truncated file
+        if path and jax.process_index() == 0:
             try:
                 data = {}
                 if os.path.exists(path):
                     with open(path) as f:
                         data = json.load(f)
                 data[f"{s}x{s}x{d}x{int(bool(causal))}"] = best
-                with open(path, "w") as f:
+                tmp = f"{path}.tmp.{os.getpid()}"
+                with open(tmp, "w") as f:
                     json.dump(data, f)
+                os.replace(tmp, path)
             except (OSError, ValueError):  # incl. a corrupt existing file
                 pass
     return results

@@ -228,13 +228,18 @@ def test_moe_model_trains_with_pallas_kernels():
     assert hist[-1].accuracy > 0.4, hist[-1].accuracy
 
 
-def test_flash_autotune_mechanics():
+def test_flash_autotune_mechanics(monkeypatch):
     """autotune() picks a block size, caches it per shape, persists and
     reloads (interpret mode here; the TPU-gated smoke in tests_tpu/ runs
     it compiled)."""
     import json
 
     from flexflow_tpu.kernels import flash_attention as fa
+
+    # isolate from the developer's real tuning env: interpret-mode winners
+    # must never leak into a hardware cache file
+    monkeypatch.delenv("FLEXFLOW_FA_TUNE_CACHE", raising=False)
+    monkeypatch.delenv("FLEXFLOW_FA_BLOCK_Q", raising=False)
 
     results = fa.autotune(shape=(1, 64, 1, 8), candidates=(16, 32, 64),
                           iters=1)
