@@ -133,14 +133,17 @@ def default_block_q(sq: int, skv: int, d: int,
         return v
     global _CACHE_FILE_LOADED
     path = os.environ.get("FLEXFLOW_FA_TUNE_CACHE")
-    # retry until a load SUCCEEDS for the current path: the env var or the
-    # file may appear after the process's first attention call
+    # load when the current path hasn't been ATTEMPTED yet; a missing file
+    # retries (it may appear later), a present-but-bad file does not (one
+    # parse attempt, not one per attention call). A path CHANGE drops the
+    # previous file's winners first — they were tuned for something else.
     if path and _CACHE_FILE_LOADED != path and os.path.exists(path):
+        _TUNE_CACHE.clear()
         try:
             load_tune_cache(path)
-            _CACHE_FILE_LOADED = path
         except (OSError, ValueError):
             pass
+        _CACHE_FILE_LOADED = path
     return _TUNE_CACHE.get((sq, skv, d, bool(causal)), 128)
 
 
@@ -168,10 +171,9 @@ def autotune(shape=(4, 512, 8, 64), candidates=(64, 128, 256, 512),
         bq = _pick_block(s, cand)
         if bq != cand:
             continue  # shape can't tile at this size
-        # VMEM gate, same formula as supported(): don't let one oversized
+        # VMEM gate shared with supported(): don't let one oversized
         # candidate's Mosaic failure discard the other timings
-        fwd_bytes = 4 * (2 * s * d + 3 * cand * d + 2 * cand * s)
-        if fwd_bytes > VMEM_BUDGET_BYTES:
+        if _fwd_vmem_bytes(s, cand, d) > VMEM_BUDGET_BYTES:
             continue
         fn = jax.jit(functools.partial(
             _flash, causal=causal, scale=d ** -0.5, block_q=cand,
@@ -315,8 +317,13 @@ def supported(q_shape, k_shape, causal: bool = False) -> bool:
     # worst case is the dkv backward: full q/g/o panels + one k/v tile +
     # the (sq, block_k) logits tile, all float32
     working = 4 * (3 * sq * d + 2 * bk * d + 2 * sq * bk)
-    fwd = 4 * (2 * skv * d + 3 * bq * d + 2 * bq * skv)
-    return max(working, fwd) <= VMEM_BUDGET_BYTES
+    return max(working, _fwd_vmem_bytes(skv, bq, d)) <= VMEM_BUDGET_BYTES
+
+
+def _fwd_vmem_bytes(skv: int, block_q: int, d: int) -> int:
+    """Forward tile working set, float32: K/V panels + q/o/lse tiles +
+    the (block_q, Skv) logits tile. Shared by supported() and autotune()."""
+    return 4 * (2 * skv * d + 3 * block_q * d + 2 * block_q * skv)
 
 
 def sharded_supported(q_shape, k_shape, mesh, batch_axis, heads_axis,

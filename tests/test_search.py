@@ -411,3 +411,31 @@ def test_memory_cap_forces_model_parallelism():
     r = graph_optimize(ff.layers, pshapes, {"data": 2, "model": 2}, sim,
                        None)
     assert any("model" in str(v) for v in r.strategies.values()), r.strategies
+
+
+def test_networked_machine_model_drives_search(tmp_path):
+    """End-to-end: a 'networked' --machine-model-file (torus routing +
+    contention, sim/network.py) prices the search and a strategy comes
+    out — the full NetworkedMachineModel -> Simulator -> full_search
+    pipeline (reference: machine-model selection feeding graph_optimize,
+    model.cc:3678-3685)."""
+    import json
+
+    from flexflow_tpu.search.unity import full_search
+    from flexflow_tpu.sim import NetworkedMachineModel, load_machine_model
+
+    p = tmp_path / "net.json"
+    p.write_text(json.dumps({
+        "version": "networked", "chip": "test",
+        "axis_degrees": {"data": 2, "model": 4},
+        "topology": [2, 4]}))
+    machine = load_machine_model(str(p))
+    assert isinstance(machine, NetworkedMachineModel)
+
+    ff = FFModel(FFConfig(batch_size=32))
+    x = ff.create_tensor((32, 256), DataType.FLOAT, name="x")
+    t = ff.dense(x, 4096, name="big")     # TP-profitable layer
+    ff.dense(t, 8, name="head")
+    r = full_search(ff.layers, [x], machine, FFConfig(batch_size=32),
+                    mesh_shapes=[{"data": 2, "model": 4}])
+    assert r.est_step_time > 0 and r.strategies
