@@ -197,15 +197,22 @@ def autotune(shape=(4, 512, 8, 64), candidates=(64, 128, 256, 512),
         # seeing a truncated file
         if path and jax.process_index() == 0:
             try:
-                data = {}
-                if os.path.exists(path):
-                    with open(path) as f:
-                        data = json.load(f)
-                data[f"{s}x{s}x{d}x{int(bool(causal))}"] = best
-                tmp = f"{path}.tmp.{os.getpid()}"
-                with open(tmp, "w") as f:
-                    json.dump(data, f)
-                os.replace(tmp, path)
+                import fcntl
+
+                # lock the read-merge-replace so two processes tuning
+                # different shapes can't lose each other's entries
+                # (same pattern as native_bridge._build)
+                with open(f"{path}.lock", "w") as lk:
+                    fcntl.flock(lk, fcntl.LOCK_EX)
+                    data = {}
+                    if os.path.exists(path):
+                        with open(path) as f:
+                            data = json.load(f)
+                    data[f"{s}x{s}x{d}x{int(bool(causal))}"] = best
+                    tmp = f"{path}.tmp.{os.getpid()}"
+                    with open(tmp, "w") as f:
+                        json.dump(data, f)
+                    os.replace(tmp, path)
             except (OSError, ValueError):  # incl. a corrupt existing file
                 pass
     return results

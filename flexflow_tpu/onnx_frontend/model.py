@@ -30,9 +30,11 @@ def _require_onnx():
 
 
 def _attrs(node) -> Dict:
+    out = {}
+    if not node.attribute:  # no onnx import needed for attribute-less nodes
+        return out
     import onnx
 
-    out = {}
     for a in node.attribute:
         out[a.name] = onnx.helper.get_attribute_value(a)
     return out
@@ -52,12 +54,50 @@ class ONNXModel:
 
         for init in self.model.graph.initializer:
             self.inits[init.name] = numpy_helper.to_array(init)
+        # pending (layer, weight-leaf-name, array-in-FF-layout) recorded by
+        # the handlers; bound post-compile by copy_weights() (reference:
+        # triton/src/onnx_parser.cc loads initializer weights — without this
+        # an imported model runs on random init and returns garbage)
+        self.weight_bindings: List[tuple] = []
+
+    def _bind(self, out_tensor, leaf: str, arr) -> None:
+        self.weight_bindings.append(
+            (out_tensor.owner_layer, leaf, np.asarray(arr)))
+
+    def _init(self, node, i: int, what: str) -> np.ndarray:
+        """Fetch a parameter that MUST be an initializer; a clear error
+        beats a raw KeyError or a silently-default weight."""
+        name = node.input[i]
+        if name not in self.inits:
+            raise ValueError(
+                f"{node.op_type} {node.name!r}: {what} {name!r} is not an "
+                f"initializer (computed parameters are unsupported — export "
+                f"with constant weights)")
+        return self.inits[name]
+
+    def copy_weights(self, ffmodel) -> int:
+        """Bind the recorded ONNX initializer weights into the compiled
+        model (call after ``ffmodel.compile()``). Returns the number of
+        arrays bound. Mirrors torch_frontend.copy_weights."""
+        bound = 0
+        for layer, leaf, arr in self.weight_bindings:
+            wmap = {p.name.split("/")[-1]: p for p in layer.weights}
+            if leaf not in wmap:
+                raise ValueError(
+                    f"layer {layer.name!r} has no weight {leaf!r} to bind "
+                    f"(weights: {sorted(wmap)})")
+            wmap[leaf].set_weights(ffmodel, arr)
+            bound += 1
+        return bound
 
     # ------------------------------------------------------------------ #
     def apply(self, ffmodel, input_tensors: Sequence) -> List:
         """Replay the onnx graph onto ``ffmodel``; ``input_tensors`` bind
         the graph inputs (initializers excluded) in declaration order."""
         env: Dict[str, object] = {}
+        # bindings belong to THIS apply's layers; a stale list from a prior
+        # apply would bind tensors owned by a different FFModel
+        self.weight_bindings = []
         graph_inputs = [
             i for i in self.model.graph.input if i.name not in self.inits
         ]
@@ -80,7 +120,7 @@ class ONNXModel:
     # ---- handlers (reference: model.py handleX methods) ---------------- #
     def handleConv(self, ff, node, env):
         a = _attrs(node)
-        w = self.inits[node.input[1]]
+        w = self._init(node, 1, "weight")
         out_c, _, kh, kw = w.shape
         strides = a.get("strides", [1, 1])
         pads = a.get("pads", [0, 0, 0, 0])
@@ -101,9 +141,13 @@ class ONNXModel:
             raise ValueError(
                 f"Conv {node.name!r}: auto_pad={auto_pad!r} unsupported "
                 f"(export with explicit pads)")
-        return ff.conv2d(env[node.input[0]], out_c, kh, kw, strides[0],
-                         strides[1], pads[0], pads[1], groups=group,
-                         use_bias=len(node.input) > 2, name=node.name or None)
+        out = ff.conv2d(env[node.input[0]], out_c, kh, kw, strides[0],
+                        strides[1], pads[0], pads[1], groups=group,
+                        use_bias=len(node.input) > 2, name=node.name or None)
+        self._bind(out, "kernel", w)  # ONNX W is OIHW = FF conv layout
+        if len(node.input) > 2:
+            self._bind(out, "bias", self._init(node, 2, "bias"))
+        return out
 
     def _pool(self, ff, node, env, pt):
         a = _attrs(node)
@@ -120,7 +164,7 @@ class ONNXModel:
         return self._pool(ff, node, env, PoolType.AVG)
 
     def handleGemm(self, ff, node, env):
-        w = self.inits[node.input[1]]
+        w = self._init(node, 1, "weight B")
         a = _attrs(node)
         # reject attribute values the dense lowering would silently ignore
         if a.get("transA", 0):
@@ -133,14 +177,37 @@ class ONNXModel:
             raise ValueError(
                 f"Gemm {node.name!r}: beta={a.get('beta')} unsupported")
         out_dim = w.shape[0] if a.get("transB", 0) else w.shape[1]
-        return ff.dense(env[node.input[0]], int(out_dim),
-                        use_bias=len(node.input) > 2, name=node.name or None)
+        out = ff.dense(env[node.input[0]], int(out_dim),
+                       use_bias=len(node.input) > 2, name=node.name or None)
+        # FF dense kernel is (in, out); transB=1 stores (out, in)
+        self._bind(out, "kernel", w.T if a.get("transB", 0) else w)
+        if len(node.input) > 2:
+            b = np.asarray(self._init(node, 2, "bias C"))
+            try:
+                # ONNX Gemm C is unidirectionally broadcastable to (M, N);
+                # per-batch-row bias (an (M, N) or (M, 1) C that varies
+                # over M) can't map onto a (N,) dense bias
+                b = np.broadcast_to(b.reshape(-1) if b.ndim > 1
+                                    and b.shape[0] == 1 else b,
+                                    (int(out_dim),)).copy()
+            except ValueError:
+                raise ValueError(
+                    f"Gemm {node.name!r}: bias C shape {b.shape} not "
+                    f"broadcastable to ({out_dim},)") from None
+            self._bind(out, "bias", b)
+        return out
 
     def handleMatMul(self, ff, node, env):
         if node.input[1] in self.inits:
             w = self.inits[node.input[1]]
-            return ff.dense(env[node.input[0]], int(w.shape[-1]),
-                            use_bias=False, name=node.name or None)
+            if w.ndim != 2:
+                raise ValueError(
+                    f"MatMul {node.name!r}: initializer weight of rank "
+                    f"{w.ndim} unsupported (dense kernels are 2-D)")
+            out = ff.dense(env[node.input[0]], int(w.shape[-1]),
+                           use_bias=False, name=node.name or None)
+            self._bind(out, "kernel", w)  # (K, N) = FF (in, out)
+            return out
         return ff.batch_matmul(env[node.input[0]], env[node.input[1]],
                                name=node.name or None)
 
@@ -217,8 +284,16 @@ class ONNXModel:
         return ff.transpose(x, list(perm), name=node.name or None)
 
     def handleBatchNormalization(self, ff, node, env):
-        return ff.batch_norm(env[node.input[0]], relu=False,
-                             name=node.name or None)
+        a = _attrs(node)
+        out = ff.batch_norm(env[node.input[0]], relu=False,
+                            eps=float(a.get("epsilon", 1e-5)),
+                            name=node.name or None)
+        # ONNX inputs: X, scale, B, input_mean, input_var
+        for i, leaf in ((1, "scale"), (2, "bias"),
+                        (3, "running_mean"), (4, "running_var")):
+            if len(node.input) > i and node.input[i]:
+                self._bind(out, leaf, self._init(node, i, leaf))
+        return out
 
     def handleIdentity(self, ff, node, env):
         return ff.identity(env[node.input[0]], name=node.name or None)
@@ -385,8 +460,10 @@ class ONNXModel:
                     f"Gather {node.name!r}: initializer data with "
                     f"axis={axis} unsupported (only axis=0 embedding lookup)")
             w = self.inits[node.input[0]]
-            return ff.embedding(env[node.input[1]], int(w.shape[0]),
-                                int(w.shape[1]), name=node.name or None)
+            out = ff.embedding(env[node.input[1]], int(w.shape[0]),
+                               int(w.shape[1]), name=node.name or None)
+            self._bind(out, "weight", w)
+            return out
         return ff.gather(env[node.input[0]], env[node.input[1]], axis,
                          name=node.name or None)
 
@@ -396,10 +473,14 @@ class ONNXModel:
         axis = int(a.get("axis", -1)) % len(x.dims)
         # onnx normalizes over ALL dims in [axis, rank)
         axes = list(range(axis, len(x.dims)))
-        return ff.layer_norm(x, axes=axes,
-                             elementwise_affine=len(node.input) > 1,
-                             eps=float(a.get("epsilon", 1e-5)),
-                             name=node.name or None)
+        out = ff.layer_norm(x, axes=axes,
+                            elementwise_affine=len(node.input) > 1,
+                            eps=float(a.get("epsilon", 1e-5)),
+                            name=node.name or None)
+        for i, leaf in ((1, "scale"), (2, "bias")):
+            if len(node.input) > i and node.input[i]:
+                self._bind(out, leaf, self._init(node, i, leaf))
+        return out
 
     def handleLSTM(self, ff, node, env):
         raise ValueError(

@@ -194,7 +194,7 @@ class BatchNorm(Op):
 
     def forward(self, ctx, inputs, weights):
         (x,) = inputs
-        eps = 1e-5
+        eps = float(self.attrs.get("eps", 1e-5))
         if ctx.training:
             mean = jnp.mean(x, axis=(0, 2, 3), keepdims=True)
             var = jnp.var(x, axis=(0, 2, 3), keepdims=True)

@@ -191,7 +191,13 @@ class Slice(Op):
             if d < len(self.attrs["items"]):
                 it = self.attrs["items"][d]
                 if it["kind"] == "int":
-                    out.append((it["i"] % size, True))
+                    i = it["i"]
+                    # numpy/torch-exact: out-of-range raises, never wraps
+                    if not (-size <= i < size):
+                        raise ValueError(
+                            f"{self.name}: index {i} out of range for dim "
+                            f"{d} of size {size}")
+                    out.append((i + size if i < 0 else i, True))
                 else:
                     out.append((slice(it.get("start"), it.get("stop"),
                                       it.get("step")), False))

@@ -199,9 +199,11 @@ class FFModel:
         )
         return self._infer_and_add(OpType.POOL2D, [input], attrs, name)
 
-    def batch_norm(self, input: Tensor, relu: bool = True, name: Optional[str] = None) -> Tensor:
+    def batch_norm(self, input: Tensor, relu: bool = True,
+                   eps: float = 1e-5, name: Optional[str] = None) -> Tensor:
         """reference: FFModel::batch_norm (model.h:478, src/ops/batch_norm.cc)."""
-        return self._infer_and_add(OpType.BATCHNORM, [input], dict(relu=relu), name)
+        return self._infer_and_add(
+            OpType.BATCHNORM, [input], dict(relu=relu, eps=float(eps)), name)
 
     def layer_norm(
         self,

@@ -131,6 +131,9 @@ class ModelInstance:
             inputs.append(ff.create_tensor(tuple(dims), name=gi.name))
         onnx_model.apply(ff, inputs)
         ff.compile(optimizer=None, loss_type=None, metrics=[], mesh=mesh)
+        # bind the exported weights — without this the served model would
+        # run on random init (reference: onnx_parser.cc loads initializers)
+        onnx_model.copy_weights(ff)
         return cls(ff, name=name)
 
     def infer(self, inputs: Sequence[np.ndarray]) -> List[np.ndarray]:
@@ -223,14 +226,21 @@ class InferenceEngine:
     def stop(self) -> None:
         for b in self._batchers.values():
             b.close()
-        for t in self._workers.values():
+        still_alive = set()
+        for name, t in self._workers.items():
             t.join(timeout=10)
+            if t.is_alive():  # e.g. stuck in first-call XLA compilation
+                still_alive.add(name)
         self._workers.clear()
         self._started = False
         # closed batchers can't be reopened: re-arm each model with a fresh
-        # queue so a later start()/infer() serves again instead of hanging
+        # queue so a later start()/infer() serves again instead of hanging.
+        # A batcher whose worker didn't exit is LEAKED, not destroyed — the
+        # worker may still call next_batch on it (freeing would be a
+        # use-after-free on the native handle).
         for name, b in list(self._batchers.items()):
-            b.destroy()
+            if name not in still_alive:
+                b.destroy()
             self._batchers[name] = _make_batcher(
                 self._models[name].batch_size, self.batch_timeout_s)
 
