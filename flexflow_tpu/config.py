@@ -62,6 +62,11 @@ class FFConfig:
     enable_parameter_parallel: bool = False
     enable_attribute_parallel: bool = False
     perform_fusion: bool = False
+    # structural graph substitutions in the search: rewritten graphs
+    # (linear+activation fusion, parallel linear/conv merges) compete in
+    # the same DP as the original (reference: GraphXfer,
+    # src/runtime/substitution.cc:596)
+    enable_graph_rewrites: bool = True
     # memory-aware search: lambda binary search between runtime- and
     # memory-optimal strategies (reference: graph.cc:2056-2157); budget =
     # memory_threshold_mb when set, else the machine model's HBM capacity
@@ -146,6 +151,8 @@ class FFConfig:
                 cfg.enable_attribute_parallel = True
             elif a == "--fusion":
                 cfg.perform_fusion = True
+            elif a == "--disable-graph-rewrites":
+                cfg.enable_graph_rewrites = False
             elif a == "--memory-search":
                 cfg.perform_memory_search = True
             elif a == "--memory-threshold":

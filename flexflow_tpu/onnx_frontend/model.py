@@ -79,6 +79,11 @@ class ONNXModel:
         """Bind the recorded ONNX initializer weights into the compiled
         model (call after ``ffmodel.compile()``). Returns the number of
         arrays bound. Mirrors torch_frontend.copy_weights."""
+        if getattr(ffmodel, "_search_layers", None) is not None:
+            raise ValueError(
+                "the search chose a structurally-rewritten graph; imported "
+                "weights cannot be mapped onto merged layers — set "
+                "config.enable_graph_rewrites = False before compile()")
         bound = 0
         for layer, leaf, arr in self.weight_bindings:
             wmap = {p.name.split("/")[-1]: p for p in layer.weights}
@@ -460,6 +465,10 @@ class ONNXModel:
                     f"Gather {node.name!r}: initializer data with "
                     f"axis={axis} unsupported (only axis=0 embedding lookup)")
             w = self.inits[node.input[0]]
+            if w.ndim != 2:
+                raise ValueError(
+                    f"Gather {node.name!r}: initializer data of rank "
+                    f"{w.ndim} unsupported (embedding matrices are 2-D)")
             out = ff.embedding(env[node.input[1]], int(w.shape[0]),
                                int(w.shape[1]), name=node.name or None)
             self._bind(out, "weight", w)

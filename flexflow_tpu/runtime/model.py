@@ -730,6 +730,7 @@ class FFModel:
         logits = logits_tensor if logits_tensor is not None \
             else self._final_output()
         # collect per-layer strategy attrs (the ParallelConfig-override path)
+        self._search_layers = None  # set by _run_search when a rewrite wins
         strat = dict(strategies or {})
         for layer in self.layers:
             if "strategy" in layer.attrs and layer.name not in strat:
@@ -753,14 +754,18 @@ class FFModel:
         # record the strategies actually in effect (search-found, imported,
         # or compile(strategies=...)-supplied) so export_strategy sees them
         self._search_strategies = dict(strat)
-        compile_layers = self.layers
+        # the search may have chosen a structurally-rewritten graph
+        # (search/graph_xfer.py); its boundary tensors — including the
+        # logits — are the original Tensor objects, so everything
+        # downstream (loss attachment, metrics) is unchanged
+        compile_layers = self._search_layers or self.layers
         if self.config.perform_fusion:
             # reference: the --fusion pass packing adjacent ops
             # (model.cc:2964-3061); here it shrinks the graph the search
             # and simulator see — XLA fuses the HLO either way
             from ..ops.fused import apply_fusion
 
-            compile_layers = apply_fusion(self.layers, {logits.tensor_id})
+            compile_layers = apply_fusion(compile_layers, {logits.tensor_id})
         if pipeline is None and mesh is not None:
             # the search may have chosen a pipe-prefixed mesh; honor it by
             # auto-enabling the GPipe engine (stage count = pipe degree).
@@ -849,14 +854,33 @@ class FFModel:
         cfg = self.config
         # extra substitution rules, scoped to THIS config so they never
         # leak into other models' searches (reference:
-        # --substitution-json-path, substitution_loader.cc:78)
+        # --substitution-json-path, substitution_loader.cc:78). Two schemas
+        # are accepted: the REFERENCE's GraphXfer rule collection
+        # ({"rule": [...]}, substitution_loader.h:168 — translated to
+        # structural rewrites) and this framework's strategy-template
+        # format ({"rules": {...}}).
+        cfg._substitution_rules = None  # drop stale rules on recompile
+        cfg._graphxfer_rewrites = None
         if cfg.substitution_json_path:
-            from ..search.substitution import load_substitution_rules
+            import json as _json
 
-            cfg._substitution_rules = load_substitution_rules(
-                cfg.substitution_json_path)
-        else:
-            cfg._substitution_rules = None  # drop stale rules on recompile
+            with open(cfg.substitution_json_path) as f:
+                peek = _json.load(f)
+            if "rule" in peek:
+                from ..search.graph_xfer import (load_graphxfer_rules,
+                                                 rules_to_rewrites)
+
+                coll = load_graphxfer_rules(cfg.substitution_json_path)
+                cfg._graphxfer_rewrites = rules_to_rewrites(coll)
+                if cfg.profiling:
+                    print(f"[search] graphxfer rules: {coll.counts()} -> "
+                          f"{[r.name for r in cfg._graphxfer_rewrites]}",
+                          flush=True)
+            else:
+                from ..search.substitution import load_substitution_rules
+
+                cfg._substitution_rules = load_substitution_rules(
+                    cfg.substitution_json_path)
 
         def make_machine(n=None):
             # --machine-model-file overrides platform detection (reference:
@@ -902,21 +926,49 @@ class FFModel:
                     self.layers, input_pshapes, axis_sizes, sim, cfg,
                     seed=cfg.seed,
                 )
-            elif cfg.perform_memory_search:
-                result = memory_aware_search(
-                    self.layers, input_pshapes, axis_sizes, sim, cfg,
-                    beam_width=beam,
-                    memory_budget=_memory_budget(cfg, machine) * pipe,
-                    memory_cap=cap,
-                )
+                if pipe > 1:
+                    result = _pipe_adjusted(result, self.layers, pipe,
+                                            machine, cfg.batch_size)
             else:
-                result = graph_optimize(
-                    self.layers, input_pshapes, axis_sizes, sim, cfg,
-                    beam_width=beam, memory_cap=cap,
-                )
-            if pipe > 1:
-                result = _pipe_adjusted(result, self.layers, pipe, machine,
-                                        cfg.batch_size)
+                # structural variants compete on the pinned mesh too
+                from ..search.graph_xfer import graph_variants
+
+                result = None
+                first_err = None
+                for rewrites, vlayers in graph_variants(
+                        self.layers, cfg,
+                        rewrites=getattr(cfg, "_graphxfer_rewrites", None)):
+                    if pipe > 1 and len(vlayers) < pipe:
+                        continue  # compile() could not split this variant
+                    try:
+                        if cfg.perform_memory_search:
+                            r = memory_aware_search(
+                                vlayers, input_pshapes, axis_sizes, sim,
+                                cfg, beam_width=beam,
+                                memory_budget=_memory_budget(cfg, machine)
+                                * pipe,
+                                memory_cap=cap,
+                            )
+                        else:
+                            r = graph_optimize(
+                                vlayers, input_pshapes, axis_sizes, sim,
+                                cfg, beam_width=beam, memory_cap=cap,
+                            )
+                    except RuntimeError as e:
+                        if first_err is None:
+                            first_err = e  # original graph's diagnostic
+                        continue
+                    if pipe > 1:
+                        r = _pipe_adjusted(r, vlayers, pipe, machine,
+                                           cfg.batch_size)
+                    if rewrites:
+                        r.rewrites, r.layers = list(rewrites), vlayers
+                    if result is None or r.est_step_time < result.est_step_time:
+                        result = r
+                if result is None:
+                    raise RuntimeError(
+                        "no feasible strategy on the pinned mesh"
+                    ) from first_err
         else:
             machine = make_machine()
             result = full_search(
@@ -926,10 +978,14 @@ class FFModel:
             self.config.mesh_shape = result.mesh_shape
             mesh = make_mesh(result.mesh_shape)
         self.search_result = result
+        # a structural rewrite won: compile() builds the rewritten graph
+        self._search_layers = getattr(result, "layers", None)
         if self.config.profiling:
+            rw = getattr(result, "rewrites", None)
             print(
                 f"[search] mesh={result.mesh_shape} est_step={result.est_step_time*1e3:.3f}ms "
-                f"mem={result.est_memory/2**20:.1f}MiB states={result.states_explored}",
+                f"mem={result.est_memory/2**20:.1f}MiB states={result.states_explored}"
+                + (f" rewrites={rw}" if rw else ""),
                 flush=True,
             )
         if self.config.export_strategy_file:

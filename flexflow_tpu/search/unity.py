@@ -52,6 +52,11 @@ class GraphSearchResult:
     est_memory: int
     states_explored: int = 0
     mem_lambda: float = 0.0  # memory-aware search trade-off (graph.cc:2056)
+    # structural substitutions: the rewrites applied to produce the winning
+    # graph, and that graph's layer list (None = the original builder graph)
+    # — reference: GraphXfer-derived best_graph (substitution.cc:1898)
+    rewrites: List[str] = dataclasses.field(default_factory=list)
+    layers: Optional[List[Layer]] = None
 
 
 def _ps_sig(ps: ParallelTensorShape) -> Tuple:
@@ -319,63 +324,87 @@ def full_search(
 
     ``max_pipe`` bounds pipe-prefixed candidates; the caller passes the
     POST-fusion op count so a fused graph is never promised more stages
-    than compile() can split."""
+    than compile() can split.
+
+    Structural graph substitutions (search/graph_xfer.py) enter here: every
+    bounded graph variant runs the same mesh × DP enumeration, so a
+    rewritten graph wins exactly when its simulated step time is lower —
+    the reference's best-first search over GraphXfer-derived graphs
+    (substitution.cc:1898) collapsed onto the variant loop."""
     from ..ffconst import OpType
+    from .graph_xfer import graph_variants
 
     n = machine.num_devices()
-    if mesh_shapes is None:
-        has_moe = any(l.op_type in (OpType.GROUP_BY, OpType.GROUP_BY_STACKED)
-                      for l in layers)
-        has_attn = any(l.op_type is OpType.MULTIHEAD_ATTENTION for l in layers)
-        if max_pipe is None:
-            # pipe candidates need >=2 layers per stage to be meaningful
-            max_pipe = max(1, len(layers) // 2)
-        mesh_shapes = enumerate_mesh_shapes(n, has_moe, has_attn,
-                                            min(n, max_pipe))
     sample_parallel = config is None or config.enable_sample_parallel
     memory_search = config is not None and config.perform_memory_search
     budget = _memory_budget(config, machine)
     overlap = config is None or config.search_overlap_backward_update
-    # ONE memoized cost model across every mesh shape (the reference keeps
-    # a single hash_to_operator_cost across the whole optimize,
-    # simulator.h:750) — the memo key includes the full sharding signature
+    # ONE memoized cost model across every mesh shape AND graph variant
+    # (the reference keeps a single hash_to_operator_cost across the whole
+    # optimize, simulator.h:750) — the memo key includes the full sharding
+    # signature, and shared subgraphs between variants hit the same entries
     cost_model = OpCostModel(machine)
-    best: Optional[GraphSearchResult] = None
     zero = config is not None and config.zero_optimizer
-    for shape in mesh_shapes:
-        pipe = shape.get("pipe", 1)
-        axis_sizes = {a: s for a, s in shape.items() if a != "pipe"}
-        # ZeRO-1 shards optimizer state over the data axis: the per-device
-        # footprint the memory prune charges shrinks by the data degree
-        opt_mult = 2.0 / shape.get("data", 1) if zero else 2.0
-        sim = Simulator(machine, cost_model, overlap_grad_sync=overlap,
-                        optimizer_state_mult=opt_mult)
-        input_pshapes = data_parallel_input_pshapes(
-            input_tensors, axis_sizes, sample_parallel)
-        # each pipe stage holds only ~1/P of the model, so both the hard
-        # HBM prune and the memory budget scale by the stage count —
-        # pipelining's primary use case is exactly the model that does NOT
-        # fit unsplit
-        cap = machine.chip.hbm_capacity * pipe
-        try:
-            if memory_search:
-                r = memory_aware_search(
-                    layers, input_pshapes, axis_sizes, sim, config,
-                    beam_width, memory_budget=budget * pipe, memory_cap=cap)
-                if r.est_memory > budget * pipe:
-                    continue
+    best: Optional[GraphSearchResult] = None
+    xrewrites = getattr(config, "_graphxfer_rewrites", None) if config else None
+    for rewrites, vlayers in graph_variants(layers, config,
+                                            rewrites=xrewrites):
+        if mesh_shapes is None:
+            has_moe = any(
+                l.op_type in (OpType.GROUP_BY, OpType.GROUP_BY_STACKED)
+                for l in vlayers)
+            has_attn = any(l.op_type is OpType.MULTIHEAD_ATTENTION
+                           for l in vlayers)
+            # a shrunk variant must never be promised more pipe stages
+            # than compile() can split (it would silently un-pipe)
+            if max_pipe is None:
+                # pipe candidates need >=2 layers per stage to be meaningful
+                vmax_pipe = max(1, len(vlayers) // 2)
             else:
-                r = graph_optimize(
-                    layers, input_pshapes, axis_sizes, sim, config,
-                    beam_width, memory_cap=cap,
-                )
-        except RuntimeError:
-            continue
-        if pipe > 1:
-            r = _pipe_adjusted(r, layers, pipe, machine,
-                               config.batch_size if config else None)
-        if best is None or r.est_step_time < best.est_step_time:
-            best = r
+                vmax_pipe = min(max_pipe, max(1, len(vlayers) // 2))
+            vmesh_shapes = enumerate_mesh_shapes(n, has_moe, has_attn,
+                                                 min(n, vmax_pipe))
+        else:
+            vmesh_shapes = mesh_shapes
+        for shape in vmesh_shapes:
+            pipe = shape.get("pipe", 1)
+            axis_sizes = {a: s for a, s in shape.items() if a != "pipe"}
+            # ZeRO-1 shards optimizer state over the data axis: the
+            # per-device footprint the memory prune charges shrinks by the
+            # data degree
+            opt_mult = 2.0 / shape.get("data", 1) if zero else 2.0
+            sim = Simulator(machine, cost_model, overlap_grad_sync=overlap,
+                            optimizer_state_mult=opt_mult)
+            input_pshapes = data_parallel_input_pshapes(
+                input_tensors, axis_sizes, sample_parallel)
+            # each pipe stage holds only ~1/P of the model, so both the
+            # hard HBM prune and the memory budget scale by the stage
+            # count — pipelining's primary use case is exactly the model
+            # that does NOT fit unsplit
+            cap = machine.chip.hbm_capacity * pipe
+            try:
+                if memory_search:
+                    r = memory_aware_search(
+                        vlayers, input_pshapes, axis_sizes, sim, config,
+                        beam_width, memory_budget=budget * pipe,
+                        memory_cap=cap)
+                    if r.est_memory > budget * pipe:
+                        continue
+                else:
+                    r = graph_optimize(
+                        vlayers, input_pshapes, axis_sizes, sim, config,
+                        beam_width, memory_cap=cap,
+                    )
+            except RuntimeError:
+                continue
+            if pipe > 1:
+                r = _pipe_adjusted(r, vlayers, pipe, machine,
+                                   config.batch_size if config else None)
+            if rewrites:
+                r.rewrites = list(rewrites)
+                r.layers = vlayers
+            if best is None or r.est_step_time < best.est_step_time:
+                best = r
     if best is None:
         raise RuntimeError("no feasible mesh/strategy found")
     return best

@@ -113,6 +113,10 @@ class ModelInstance:
         from ..runtime.model import FFModel
 
         config = config or FFConfig(computation_mode=CompMode.INFERENCE)
+        # structural rewrites replace builder layers, which would orphan
+        # the recorded initializer weights (and a merged layer has no
+        # meaningful weight mapping for imported arrays)
+        config.enable_graph_rewrites = False
         ff = FFModel(config)
         onnx_model = ONNXModel(onnx_path)
         # bind graph inputs: dynamic/zero batch dims become config.batch_size

@@ -592,6 +592,11 @@ def copy_weights(ffmodel, torch_module,
     """
     import torch
 
+    if getattr(ffmodel, "_search_layers", None) is not None:
+        raise ValueError(
+            "the search chose a structurally-rewritten graph; imported "
+            "weights cannot be mapped onto merged layers — set "
+            "config.enable_graph_rewrites = False before compile()")
     name_of = {}  # FF layer name -> torch submodule
     gm_modules = dict(torch_module.named_modules())
     for layer in ffmodel.layers:

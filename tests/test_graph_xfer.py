@@ -1,0 +1,283 @@
+"""Structural graph substitutions (reference: GraphXfer::run
+src/runtime/substitution.cc:596, generators :1726-1869/:3099-3240, JSON
+rule library substitutions/graph_subst_3_v2.json)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import (
+    ActiMode,
+    DataType,
+    FFConfig,
+    FFModel,
+    LossType,
+    SGDOptimizer,
+)
+from flexflow_tpu.ffconst import OpType
+from flexflow_tpu.search.graph_xfer import (
+    LinearActivationFusion,
+    ParallelConvMerge,
+    ParallelLinearMerge,
+    graph_variants,
+    load_graphxfer_rules,
+    rules_to_rewrites,
+)
+
+REF_RULES = "/root/reference/substitutions/graph_subst_3_v2.json"
+
+
+def _mlp_layers():
+    ff = FFModel(FFConfig(batch_size=8))
+    x = ff.create_tensor((8, 16), name="x")
+    t = ff.dense(x, 32, name="d1")
+    t = ff.relu(t, name="r1")
+    t = ff.dense(t, 4, name="d2")
+    return ff, x
+
+
+def _branchy_layers(k=4, width=32):
+    ff = FFModel(FFConfig(batch_size=8))
+    x = ff.create_tensor((8, 16), name="x")
+    outs = [ff.dense(x, width, name=f"b{i}") for i in range(k)]
+    cat = ff.concat(outs, axis=-1, name="cat")
+    t = ff.relu(cat, name="act")
+    t = ff.dense(t, 4, name="head")
+    return ff, x
+
+
+# ------------------------------------------------------------ rewrite units
+def test_linear_activation_fusion_rewrite():
+    ff, _ = _mlp_layers()
+    rw = LinearActivationFusion()
+    sites = rw.find(ff.layers)
+    assert len(sites) == 1
+    new = rw.apply_all(list(ff.layers))
+    assert len(new) == len(ff.layers) - 1
+    fused = new[0]
+    assert fused.op_type is OpType.LINEAR
+    assert fused.attrs["activation"] is ActiMode.RELU
+    # boundary tensor reuse: downstream d2 still reads the same tensor id
+    assert fused.outputs[0].tensor_id == ff.layers[1].outputs[0].tensor_id
+    # the builder graph is untouched
+    assert len(ff.layers) == 3
+    assert ff.layers[0].attrs.get("activation", ActiMode.NONE) is ActiMode.NONE
+
+
+def test_linear_activation_fusion_skips_multi_consumer():
+    ff = FFModel(FFConfig(batch_size=8))
+    x = ff.create_tensor((8, 16), name="x")
+    t = ff.dense(x, 32, name="d1")
+    r = ff.relu(t, name="r1")
+    s = ff.add(r, t, name="skip")  # t read twice: fusion must not fire
+    assert LinearActivationFusion().find(ff.layers) == []
+
+
+def test_parallel_linear_merge_rewrite():
+    ff, _ = _branchy_layers(k=3, width=32)
+    rw = ParallelLinearMerge()
+    sites = rw.find(ff.layers)
+    assert len(sites) == 1
+    new = rw.apply_all(list(ff.layers))
+    # 3 linears + concat -> 1 merged linear
+    assert len(new) == len(ff.layers) - 3
+    merged = new[0]
+    assert merged.op_type is OpType.LINEAR
+    assert merged.attrs["out_dim"] == 96
+    assert merged.outputs[0].tensor_id == ff.layers[3].outputs[0].tensor_id
+
+
+def test_parallel_linear_merge_requires_same_input():
+    ff = FFModel(FFConfig(batch_size=8))
+    x = ff.create_tensor((8, 16), name="x")
+    a = ff.dense(x, 32, name="b0")
+    b = ff.dense(ff.relu(x), 32, name="b1")  # different input tensor
+    ff.concat([a, b], axis=-1, name="cat")
+    assert ParallelLinearMerge().find(ff.layers) == []
+
+
+def test_parallel_conv_merge_rewrite():
+    ff = FFModel(FFConfig(batch_size=4))
+    x = ff.create_tensor((4, 8, 16, 16), name="img")
+    a = ff.conv2d(x, 16, 3, 3, 1, 1, 1, 1, name="c0")
+    b = ff.conv2d(x, 8, 3, 3, 1, 1, 1, 1, name="c1")
+    ff.concat([a, b], axis=1, name="cat")
+    rw = ParallelConvMerge()
+    new = rw.apply_all(list(ff.layers))
+    assert len(new) == 1
+    assert new[0].attrs["out_channels"] == 24
+    # mismatched geometry must not merge
+    ff2 = FFModel(FFConfig(batch_size=4))
+    y = ff2.create_tensor((4, 8, 16, 16), name="img")
+    a2 = ff2.conv2d(y, 16, 3, 3, 1, 1, 1, 1, name="c0")
+    b2 = ff2.conv2d(y, 8, 5, 5, 1, 1, 2, 2, name="c1")
+    ff2.concat([a2, b2], axis=1, name="cat")
+    assert ParallelConvMerge().find(ff2.layers) == []
+
+
+def test_graph_variants_enumeration_and_gate():
+    ff, _ = _branchy_layers()
+    variants = graph_variants(ff.layers)
+    descs = [tuple(d) for d, _ in variants]
+    assert descs[0] == ()  # original always first
+    assert any("parallel_linear_merge" in d for d in descs)
+    # composed variant: merge THEN fuse the following relu into the merged
+    composed = [ls for d, ls in variants if len(d) >= 2]
+    assert composed and any(
+        l.op_type is OpType.LINEAR
+        and l.attrs.get("activation") is ActiMode.RELU
+        and l.attrs["out_dim"] == 128
+        for l in composed[0]
+    )
+    cfg = FFConfig(batch_size=8)
+    cfg.enable_graph_rewrites = False
+    assert len(graph_variants(ff.layers, cfg)) == 1
+
+
+# --------------------------------------------------------- search integration
+def test_structural_rewrite_wins_search():
+    """A rewritten graph must both change the chosen graph and lower the
+    simulated step time (VERDICT round-2 done-criterion)."""
+    from flexflow_tpu.search.unity import full_search
+    from flexflow_tpu.sim import detect_machine_model
+
+    ff, x = _branchy_layers(k=4, width=32)
+    machine = detect_machine_model(8)
+    cfg = FFConfig(batch_size=8)
+    best = full_search(ff.layers, [x], machine, cfg, beam_width=8)
+    assert best.rewrites, "no structural rewrite won the search"
+    assert best.layers is not None and len(best.layers) < len(ff.layers)
+    cfg2 = FFConfig(batch_size=8)
+    cfg2.enable_graph_rewrites = False
+    base = full_search(ff.layers, [x], machine, cfg2, beam_width=8)
+    assert best.est_step_time < base.est_step_time
+
+
+def test_rewritten_graph_compiles_and_trains():
+    ff, _ = _branchy_layers(k=4, width=32)
+    ff.config.search_budget = -1
+    ff.config.mesh_shape = {"data": 8}
+    ff.compile(optimizer=SGDOptimizer(lr=0.1),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=["accuracy"])
+    assert ff._search_layers is not None, "rewrite did not reach compile"
+    assert len(ff.compiled.ops) < len(ff.layers) + 1  # graph really shrank
+    rng = np.random.default_rng(0)
+    xs = rng.normal(size=(32, 16)).astype(np.float32)
+    ys = rng.integers(0, 4, size=(32,)).astype(np.int32)
+    hist = ff.fit(xs, ys, epochs=2, verbose=False)
+    assert hist[-1].train_all == 32  # trained, metrics flowed
+
+
+# ------------------------------------------------------------- JSON loader
+def test_reference_rule_schema_roundtrip(tmp_path):
+    """graph_subst-style rules load without error (round-2 done-criterion).
+    A miniature rule file in the exact reference schema
+    (substitution_loader.h:139-179) always runs; the full 640-rule library
+    is exercised when the reference checkout is present."""
+    import json
+
+    mini = {
+        "rule": [
+            {   # linear+relu merge (create_linear_relu_merge analog)
+                "name": "linear_relu_merge",
+                "srcOp": [
+                    {"type": "OP_LINEAR",
+                     "input": [{"opId": -1, "tsId": 0}],
+                     "para": [{"key": "PM_ACTI", "value": 0}]},
+                    {"type": "OP_RELU",
+                     "input": [{"opId": 0, "tsId": 0}], "para": []},
+                ],
+                "dstOp": [
+                    {"type": "OP_LINEAR",
+                     "input": [{"opId": -1, "tsId": 0}],
+                     "para": [{"key": "PM_ACTI", "value": 1}]},
+                ],
+                "mappedOutput": [
+                    {"srcOpId": 1, "srcTsId": 0, "dstOpId": 0, "dstTsId": 0}
+                ],
+            },
+            {   # pure resharding motion: subsumed by GSPMD
+                "name": "partition_swap",
+                "srcOp": [
+                    {"type": "OP_PARTITION",
+                     "input": [{"opId": -1, "tsId": 0}],
+                     "para": [{"key": "PM_PARALLEL_DIM", "value": 1},
+                              {"key": "PM_PARALLEL_DEGREE", "value": 2}]},
+                ],
+                "dstOp": [
+                    {"type": "OP_PARTITION",
+                     "input": [{"opId": -1, "tsId": 0}],
+                     "para": [{"key": "PM_PARALLEL_DIM", "value": 2},
+                              {"key": "PM_PARALLEL_DEGREE", "value": 2}]},
+                ],
+                "mappedOutput": [
+                    {"srcOpId": 0, "srcTsId": 0, "dstOpId": 0, "dstTsId": 0}
+                ],
+            },
+            {   # TASO-specific op: classified unsupported, not an error
+                "name": "enlarge_rule",
+                "srcOp": [{"type": "OP_ENLARGE",
+                           "input": [{"opId": -1, "tsId": 0}], "para": []}],
+                "dstOp": [{"type": "OP_NOOP",
+                           "input": [{"opId": -1, "tsId": 0}], "para": []}],
+                "mappedOutput": [],
+            },
+        ]
+    }
+    p = tmp_path / "rules.json"
+    p.write_text(json.dumps(mini))
+    coll = load_graphxfer_rules(str(p))
+    assert coll.counts() == {"resharding": 1, "structural": 1,
+                             "unsupported": 1}
+    rewrites = rules_to_rewrites(coll)
+    assert [r.name for r in rewrites] == ["linear_activation_fusion"]
+
+
+@pytest.mark.skipif(not os.path.exists(REF_RULES),
+                    reason="reference checkout not present")
+def test_full_reference_rule_library_loads():
+    coll = load_graphxfer_rules(REF_RULES)
+    assert len(coll.rules) == 640
+    c = coll.counts()
+    assert sum(c.values()) == 640
+    # the TASO library is dominated by resharding-motion rules; the load
+    # itself must classify every rule without raising
+    assert c["resharding"] + c["structural"] + c["unsupported"] == 640
+
+
+def test_substitution_json_path_reference_schema(tmp_path):
+    """--substitution-json with a reference-schema file activates the
+    translated rewrites in a real compile."""
+    import json
+
+    rules = {
+        "rule": [{
+            "name": "linear_relu_merge",
+            "srcOp": [
+                {"type": "OP_LINEAR", "input": [{"opId": -1, "tsId": 0}],
+                 "para": []},
+                {"type": "OP_RELU", "input": [{"opId": 0, "tsId": 0}],
+                 "para": []},
+            ],
+            "dstOp": [
+                {"type": "OP_LINEAR", "input": [{"opId": -1, "tsId": 0}],
+                 "para": [{"key": "PM_ACTI", "value": 1}]},
+            ],
+            "mappedOutput": [
+                {"srcOpId": 1, "srcTsId": 0, "dstOpId": 0, "dstTsId": 0}
+            ],
+        }]
+    }
+    p = tmp_path / "ref_rules.json"
+    p.write_text(json.dumps(rules))
+    ff, _ = _mlp_layers()
+    ff.config.search_budget = -1
+    ff.config.mesh_shape = {"data": 8}
+    ff.config.substitution_json_path = str(p)
+    ff.compile(optimizer=SGDOptimizer(lr=0.1),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=[])
+    assert ff._search_layers is not None
+    assert len(ff._search_layers) == 2  # d1+r1 fused, d2 kept
