@@ -750,7 +750,7 @@ class FFModel:
             # Explicit per-layer strategies (builder overrides) win over
             # search. Results are kept off layer.attrs so a re-compile
             # after a config change re-runs the search.
-            strat, mesh = self._run_search(mesh)
+            strat, mesh = self._run_search(mesh, logits)
         # record the strategies actually in effect (search-found, imported,
         # or compile(strategies=...)-supplied) so export_strategy sees them
         self._search_strategies = dict(strat)
@@ -838,11 +838,13 @@ class FFModel:
                 op.layer.weights.append(p)
                 self._param_index[p.tensor_id] = (op.name, ws.name)
 
-    def _run_search(self, mesh):
+    def _run_search(self, mesh, logits=None):
         """Run the auto-parallelization search (reference: §2.5 — Unity DP
         by default via ``graph_optimize``; ``config.search_method="mcmc"``
         selects the MLSys'19 annealing path bounded by
-        ``search_budget``/``search_alpha``). Returns (strategies, mesh)."""
+        ``search_budget``/``search_alpha``). Returns (strategies, mesh).
+        ``logits``: the training-output tensor — structural rewrites must
+        not eliminate it."""
         from ..search.mcmc import mcmc_optimize
         from ..search.unity import (_memory_budget,
                                     data_parallel_input_pshapes, full_search,
@@ -870,7 +872,7 @@ class FFModel:
                 from ..search.graph_xfer import (load_graphxfer_rules,
                                                  rules_to_rewrites)
 
-                coll = load_graphxfer_rules(cfg.substitution_json_path)
+                coll = load_graphxfer_rules(peek)  # already parsed
                 cfg._graphxfer_rewrites = rules_to_rewrites(coll)
                 if cfg.profiling:
                     print(f"[search] graphxfer rules: {coll.counts()} -> "
@@ -892,14 +894,16 @@ class FFModel:
         inputs = self._used_inputs()
         use_mcmc = getattr(cfg, "search_method", "unity") == "mcmc"
         beam = max(cfg.base_optimize_threshold, 8)
+        protected = frozenset(
+            {logits.tensor_id} if logits is not None
+            else {self._final_output().tensor_id})
         # pipe-stage bound: the POST-fusion graph must still have one op
         # per stage, else compile() cannot honor a pipe mesh
         n_effective = len(self.layers)
         if cfg.perform_fusion:
             from ..ops.fused import apply_fusion
 
-            n_effective = len(
-                apply_fusion(self.layers, {self._final_output().tensor_id}))
+            n_effective = len(apply_fusion(self.layers, set(protected)))
         if mesh is not None or cfg.mesh_shape:
             # mesh pinned by the user: search strategies on it only. A
             # pipe axis (user-pinned or persisted from a previous search)
@@ -932,14 +936,23 @@ class FFModel:
             else:
                 # structural variants compete on the pinned mesh too
                 from ..search.graph_xfer import graph_variants
+                from ..search.unity import _effective_layer_count
 
                 result = None
                 first_err = None
                 for rewrites, vlayers in graph_variants(
                         self.layers, cfg,
-                        rewrites=getattr(cfg, "_graphxfer_rewrites", None)):
-                    if pipe > 1 and len(vlayers) < pipe:
-                        continue  # compile() could not split this variant
+                        rewrites=getattr(cfg, "_graphxfer_rewrites", None),
+                        protected=protected):
+                    # a variant too small for the mesh's pipe degree would
+                    # silently un-pipe in compile(); skip it — UNLESS the
+                    # original graph can't pipe either (then compile's
+                    # plain-compile fallback is the intended behavior and
+                    # the search must not dead-end)
+                    n_var = _effective_layer_count(
+                        vlayers, cfg.perform_fusion, protected)
+                    if pipe > 1 and n_var < pipe and n_effective >= pipe:
+                        continue
                     try:
                         if cfg.perform_memory_search:
                             r = memory_aware_search(
@@ -960,7 +973,8 @@ class FFModel:
                         continue
                     if pipe > 1:
                         r = _pipe_adjusted(r, vlayers, pipe, machine,
-                                           cfg.batch_size)
+                                           cfg.batch_size,
+                                           fused=cfg.perform_fusion)
                     if rewrites:
                         r.rewrites, r.layers = list(rewrites), vlayers
                     if result is None or r.est_step_time < result.est_step_time:
@@ -973,7 +987,7 @@ class FFModel:
             machine = make_machine()
             result = full_search(
                 self.layers, inputs, machine, cfg, beam_width=beam,
-                max_pipe=max(1, n_effective // 2),
+                max_pipe=max(1, n_effective // 2), protected=protected,
             )
             self.config.mesh_shape = result.mesh_shape
             mesh = make_mesh(result.mesh_shape)

@@ -69,22 +69,29 @@ def _consumer_count(layers: Sequence[Layer]) -> Dict[int, int]:
 
 
 class GraphRewrite:
-    """One structural substitution kind (reference: one GraphXfer)."""
+    """One structural substitution kind (reference: one GraphXfer).
+
+    ``protected`` carries tensor ids that must survive as produced graph
+    outputs (the logits tensor, an explicit ``logits_tensor=`` override) —
+    a rewrite that would eliminate one is not a legal site, the same
+    contract ops/fused.py's ``apply_fusion`` honors."""
 
     name: str = "rewrite"
 
-    def find(self, layers: Sequence[Layer]) -> List[Tuple]:
+    def find(self, layers: Sequence[Layer],
+             protected: frozenset = frozenset()) -> List[Tuple]:
         raise NotImplementedError
 
     def apply(self, layers: List[Layer], site: Tuple) -> List[Layer]:
         raise NotImplementedError
 
-    def apply_all(self, layers: List[Layer]) -> List[Layer]:
+    def apply_all(self, layers: List[Layer],
+                  protected: frozenset = frozenset()) -> List[Layer]:
         """Apply at every non-overlapping site until fixpoint (bounded —
         each application strictly shrinks the layer count, so this
         terminates)."""
         for _ in range(len(layers)):
-            sites = self.find(layers)
+            sites = self.find(layers, protected)
             if not sites:
                 break
             layers = self.apply(layers, sites[0])
@@ -97,7 +104,7 @@ class LinearActivationFusion(GraphRewrite):
 
     name = "linear_activation_fusion"
 
-    def find(self, layers):
+    def find(self, layers, protected=frozenset()):
         # producers resolved from THIS list (a prior rewrite's clone reuses
         # the original output tensor, whose .owner_layer still points at
         # the builder layer — tensor id is the truth here, like compile's
@@ -118,7 +125,8 @@ class LinearActivationFusion(GraphRewrite):
                 continue
             if src.attrs.get("activation", ActiMode.NONE) is not ActiMode.NONE:
                 continue
-            if consumers.get(src.outputs[0].tensor_id, 0) != 1:
+            tid = src.outputs[0].tensor_id
+            if consumers.get(tid, 0) != 1 or tid in protected:
                 continue  # the intermediate is read elsewhere: keep it
             sites.append((li, ui, act))
         return sites
@@ -157,7 +165,7 @@ class _ParallelMerge(GraphRewrite):
     def _merged_layer(self, branches: List[Layer]) -> Layer:
         raise NotImplementedError
 
-    def find(self, layers):
+    def find(self, layers, protected=frozenset()):
         produced = {l.outputs[0].tensor_id: i
                     for i, l in enumerate(layers) if l.outputs}
         consumers = _consumer_count(layers)
@@ -165,6 +173,8 @@ class _ParallelMerge(GraphRewrite):
         for ci, cat in enumerate(layers):
             if cat.op_type is not OpType.CONCAT or len(cat.inputs) < 2:
                 continue
+            if any(t.tensor_id in protected for t in cat.inputs):
+                continue  # a branch output must survive as a graph output
             nd = len(cat.inputs[0].dims)
             if _concat_axis(cat) != self.concat_axis_of(nd):
                 continue
@@ -283,6 +293,7 @@ def graph_variants(
     config=None,
     rewrites: Optional[Sequence[GraphRewrite]] = None,
     max_variants: int = 8,
+    protected: Optional[frozenset] = None,
 ) -> List[Tuple[List[str], List[Layer]]]:
     """Bounded graph-variant enumeration for the search.
 
@@ -296,6 +307,7 @@ def graph_variants(
     if config is not None and not getattr(config, "enable_graph_rewrites", True):
         return [([], layers)]
     rewrites = list(rewrites if rewrites is not None else BUILTIN_REWRITES)
+    protected = frozenset(protected or ())
 
     def sig(ls: Sequence[Layer]) -> Tuple:
         return tuple(
@@ -307,7 +319,7 @@ def graph_variants(
     variants: List[Tuple[List[str], List[Layer]]] = [([], layers)]
     seen = {sig(layers)}
     for rw in rewrites:
-        nl = rw.apply_all(list(layers))
+        nl = rw.apply_all(list(layers), protected)
         if sig(nl) not in seen:
             seen.add(sig(nl))
             variants.append(([rw.name], nl))
@@ -317,7 +329,7 @@ def graph_variants(
     for _ in range(4):
         before = sig(cur)
         for rw in rewrites:
-            nxt = rw.apply_all(cur)
+            nxt = rw.apply_all(cur, protected)
             if sig(nxt) != sig(cur):
                 applied.append(rw.name)
                 cur = nxt
@@ -408,15 +420,19 @@ def _classify(rule: XferRule) -> str:
     return "unsupported"
 
 
-def load_graphxfer_rules(path: str) -> RuleCollection:
+def load_graphxfer_rules(path_or_data) -> RuleCollection:
     """Load a rule file in the REFERENCE's schema
     (substitutions/graph_subst_3_v2.json; substitution_loader.cc:55-78:
     ``{"rule": [{name, srcOp, dstOp, mappedOutput}]}``) and classify every
-    rule. Never raises on a well-formed file — unknown op/param names
-    classify the rule as unsupported rather than failing the load, because
-    the library spans TASO's op set, not ours."""
-    with open(path) as f:
-        data = json.load(f)
+    rule. Accepts a path or an already-parsed dict (callers that peeked at
+    the schema needn't re-parse). Never raises on a well-formed file —
+    unknown op/param names classify the rule as unsupported rather than
+    failing the load, because the library spans TASO's op set, not ours."""
+    if isinstance(path_or_data, dict):
+        data = path_or_data
+    else:
+        with open(path_or_data) as f:
+            data = json.load(f)
     rules = []
     for j in data.get("rule", []):
         r = XferRule(

@@ -34,6 +34,7 @@ import dataclasses
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..config import FFConfig
+from ..ffconst import OpType
 from ..core.layer import Layer
 from ..core.op import create_op
 from ..core.parallel_tensor import ParallelDim, ParallelTensorShape
@@ -318,6 +319,7 @@ def full_search(
     beam_width: int = 64,
     mesh_shapes: Optional[List[Dict[str, int]]] = None,
     max_pipe: Optional[int] = None,
+    protected: Optional[frozenset] = None,
 ) -> GraphSearchResult:
     """Outer loop over mesh shapes × inner DP (reference: the top-level
     try_one_lambda / machine-mapping enumeration in graph_optimize_task).
@@ -347,8 +349,10 @@ def full_search(
     zero = config is not None and config.zero_optimizer
     best: Optional[GraphSearchResult] = None
     xrewrites = getattr(config, "_graphxfer_rewrites", None) if config else None
+    fusion = config is not None and config.perform_fusion
     for rewrites, vlayers in graph_variants(layers, config,
-                                            rewrites=xrewrites):
+                                            rewrites=xrewrites,
+                                            protected=protected):
         if mesh_shapes is None:
             has_moe = any(
                 l.op_type in (OpType.GROUP_BY, OpType.GROUP_BY_STACKED)
@@ -356,12 +360,15 @@ def full_search(
             has_attn = any(l.op_type is OpType.MULTIHEAD_ATTENTION
                            for l in vlayers)
             # a shrunk variant must never be promised more pipe stages
-            # than compile() can split (it would silently un-pipe)
+            # than compile() can split (it would silently un-pipe); with
+            # fusion on, compile splits the POST-fusion op list, so bound
+            # by that count
+            n_eff = _effective_layer_count(vlayers, fusion, protected)
             if max_pipe is None:
                 # pipe candidates need >=2 layers per stage to be meaningful
-                vmax_pipe = max(1, len(vlayers) // 2)
+                vmax_pipe = max(1, n_eff // 2)
             else:
-                vmax_pipe = min(max_pipe, max(1, len(vlayers) // 2))
+                vmax_pipe = min(max_pipe, max(1, n_eff // 2))
             vmesh_shapes = enumerate_mesh_shapes(n, has_moe, has_attn,
                                                  min(n, vmax_pipe))
         else:
@@ -399,7 +406,8 @@ def full_search(
                 continue
             if pipe > 1:
                 r = _pipe_adjusted(r, vlayers, pipe, machine,
-                                   config.batch_size if config else None)
+                                   config.batch_size if config else None,
+                                   fused=fusion)
             if rewrites:
                 r.rewrites = list(rewrites)
                 r.layers = vlayers
@@ -408,6 +416,17 @@ def full_search(
     if best is None:
         raise RuntimeError("no feasible mesh/strategy found")
     return best
+
+
+def _effective_layer_count(layers: List[Layer], fusion: bool,
+                           protected: Optional[frozenset] = None) -> int:
+    """Op count compile() will actually split into stages: post-fusion
+    when --fusion is on."""
+    if not fusion:
+        return len(layers)
+    from ..ops.fused import apply_fusion
+
+    return len(apply_fusion(list(layers), set(protected or ())))
 
 
 def pipe_microbatches(batch_size: Optional[int]) -> int:
@@ -422,6 +441,7 @@ def pipe_microbatches(batch_size: Optional[int]) -> int:
 def _pipe_adjusted(
     r: GraphSearchResult, layers: List[Layer], pipe: int,
     machine: MachineModel, batch_size: Optional[int] = None,
+    fused: bool = False,
 ) -> GraphSearchResult:
     """GPipe bubble cost model for a pipe-prefixed mesh.
 
@@ -436,18 +456,17 @@ def _pipe_adjusted(
     """
     M = pipe_microbatches(batch_size)
     bubble = (M + pipe - 1) / (M * pipe)
-    # boundary traffic: approximate each of the P-1 cut points by the mean
-    # layer-output size; forward activation + backward cotangent per step.
-    # Boundary tensors stay batch-sharded over the inner data axis, so each
-    # device moves only its shard.
-    out_bytes = [
-        4.0 * _numel(t.dims) for layer in layers for t in layer.outputs
-    ]
-    mean_out = sum(out_bytes) / max(1, len(out_bytes))
-    mean_out /= max(1, r.mesh_shape.get("data", 1))
+    # boundary traffic from the ACTUAL stage-cut tensors: run the same
+    # FLOP-balanced contiguous splitter compile()'s pipeline uses
+    # (parallel/pipeline.py split_stages), then charge every tensor that
+    # crosses a stage boundary — forward activation + backward cotangent
+    # per step. Boundary tensors stay batch-sharded over the inner data
+    # axis, so each device moves only its shard.
+    cut_bytes = _stage_cut_bytes(layers, pipe, fused=fused)
+    cut_bytes /= max(1, r.mesh_shape.get("data", 1))
     bw = machine.chip.ici_link_bandwidth
-    comm = 2.0 * (pipe - 1) * mean_out / bw
-    return GraphSearchResult(
+    comm = 2.0 * cut_bytes / bw
+    res = GraphSearchResult(
         r.strategies,
         {"pipe": pipe, **r.mesh_shape},
         r.est_step_time * bubble + comm,
@@ -455,6 +474,69 @@ def _pipe_adjusted(
         r.states_explored,
         r.mem_lambda,
     )
+    res.rewrites, res.layers = r.rewrites, r.layers
+    return res
+
+
+def _stage_cut_bytes(layers: List[Layer], pipe: int,
+                     fused: bool = False) -> float:
+    """Total bytes crossing stage boundaries for ONE traversal direction,
+    using the exact stage assignment compile() will choose: the same
+    ``split_stages`` over the same ``Op.flops()`` (on the post-fusion op
+    list when --fusion is on, which is what compile splits). Falls back to
+    the historical mean-output heuristic if the graph cannot be
+    materialized (fewer layers than stages, an op that rejects unsharded
+    propagation — full_search filters those meshes, but a caller-pinned
+    mesh may not)."""
+    from ..parallel.pipeline import split_stages
+
+    if fused:
+        from ..ops.fused import apply_fusion
+
+        layers = apply_fusion(list(layers), set())
+    try:
+        ops = []
+        pshapes: Dict[int, ParallelTensorShape] = {}
+        for layer in layers:
+            in_shapes = []
+            for t in layer.inputs:
+                if t.tensor_id not in pshapes:
+                    pshapes[t.tensor_id] = ParallelTensorShape(
+                        tuple(ParallelDim(s) for s in t.dims), t.dtype)
+                in_shapes.append(pshapes[t.tensor_id])
+            op = create_op(layer, in_shapes)
+            outs, _ = op.propagate(in_shapes, {"_axis_sizes": {}})
+            op.output_shapes = outs
+            for t, ps in zip(layer.outputs, outs):
+                pshapes[t.tensor_id] = ps
+            ops.append(op)
+        stages = split_stages(ops, pipe)
+    except Exception:
+        out_bytes = [4.0 * _numel(t.dims)
+                     for layer in layers for t in layer.outputs]
+        mean = sum(out_bytes) / max(1, len(out_bytes))
+        return (pipe - 1) * mean
+    stage_of: Dict[int, int] = {}
+    i = 0
+    for si, st in enumerate(stages):
+        for _ in st:
+            stage_of[i] = si
+            i += 1
+    produced: Dict[int, int] = {}
+    for li, layer in enumerate(layers):
+        for t in layer.outputs:
+            produced[t.tensor_id] = li
+    total = 0.0
+    counted = set()
+    for li, layer in enumerate(layers):
+        for t in layer.inputs:
+            pi = produced.get(t.tensor_id)
+            if pi is None or t.tensor_id in counted:
+                continue
+            if stage_of[pi] != stage_of[li]:
+                total += 4.0 * _numel(t.dims)
+                counted.add(t.tensor_id)
+    return total
 
 
 def _numel(dims) -> float:

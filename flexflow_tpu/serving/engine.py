@@ -112,11 +112,14 @@ class ModelInstance:
         from ..onnx_frontend import ONNXModel
         from ..runtime.model import FFModel
 
+        import dataclasses as _dc
+
         config = config or FFConfig(computation_mode=CompMode.INFERENCE)
         # structural rewrites replace builder layers, which would orphan
         # the recorded initializer weights (and a merged layer has no
-        # meaningful weight mapping for imported arrays)
-        config.enable_graph_rewrites = False
+        # meaningful weight mapping for imported arrays). Copy, don't
+        # mutate the caller's config object.
+        config = _dc.replace(config, enable_graph_rewrites=False)
         ff = FFModel(config)
         onnx_model = ONNXModel(onnx_path)
         # bind graph inputs: dynamic/zero batch dims become config.batch_size

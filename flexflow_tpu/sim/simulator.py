@@ -196,35 +196,76 @@ class Simulator:
 
     # ------------------------------------------------------------ task graph
     def build_task_graph(self, ops: List[Op]) -> List[SimTask]:
-        """Materialize fwd/bwd/comm/update tasks with dependencies —
-        exported for inspection/tests (reference: the SimTask graph that
-        simulate_runtime builds before replay)."""
+        """Materialize fwd/bwd/comm/update tasks with REAL data-dependency
+        edges — exported for inspection/tests (reference: the SimTask DAG
+        simulate_runtime builds, simulator.cc:850-905, where backward tasks
+        depend on their consumers' backward tasks, not on a global chain).
+
+        Comm rides its own task on the network lane in BOTH directions, so
+        one branch's collective overlaps another branch's compute — the
+        chain-backward model serialized parallel branches (inception / MoE
+        / multi-tower DLRM) and biased the search against them.
+
+        Backward edges: ``bwd(op)`` consumes the output-gradient produced
+        by every consumer's ``bwd``; an op with no consumers is a loss
+        frontier — its gradient is available right after its own forward
+        (+ fwd collective)."""
         tasks: List[SimTask] = []
-        fwd_idx: Dict[int, int] = {}  # tensor_id -> producing fwd task index
-        for op in ops:
+        ready_idx: Dict[int, int] = {}  # tensor_id -> task producing it
+        fwd_out: Dict[int, int] = {}    # op position -> fwd-side ready task
+        for oi, op in enumerate(ops):
             cm = self.cost_model.measure(op)
             deps = tuple(
-                fwd_idx[t.tensor_id] for t in op.layer.inputs if t.tensor_id in fwd_idx
+                ready_idx[t.tensor_id] for t in op.layer.inputs
+                if t.tensor_id in ready_idx
             )
+            idx = len(tasks)
+            tasks.append(SimTask(f"{op.name}:fwd", "fwd", cm.forward_time,
+                                 deps))
             comm = self._comm_time(op, backward=False)
-            idx = len(tasks)
-            tasks.append(SimTask(f"{op.name}:fwd", "fwd", cm.forward_time + comm, deps))
+            out = idx
+            if comm > 0.0:
+                out = len(tasks)
+                tasks.append(SimTask(f"{op.name}:fwd_comm", "comm", comm,
+                                     (idx,)))
+            fwd_out[oi] = out
             for t in op.layer.outputs:
-                fwd_idx[t.tensor_id] = idx
-        # backward: reverse order, dep on the full forward frontier
-        frontier = len(tasks) - 1
-        prev = frontier
-        for op in reversed(ops):
+                ready_idx[t.tensor_id] = out
+        # consumer map over op positions (the reverse edges of the fwd DAG)
+        produced_by: Dict[int, int] = {}
+        for oi, op in enumerate(ops):
+            for t in op.layer.outputs:
+                produced_by[t.tensor_id] = oi
+        consumers: Dict[int, List[int]] = {oi: [] for oi in range(len(ops))}
+        for oi, op in enumerate(ops):
+            for t in op.layer.inputs:
+                pi = produced_by.get(t.tensor_id)
+                if pi is not None:
+                    consumers[pi].append(oi)
+        bwd_out: Dict[int, int] = {}  # op position -> bwd-side ready task
+        for oi in range(len(ops) - 1, -1, -1):
+            op = ops[oi]
             cm = self.cost_model.measure(op)
-            comm = self._comm_time(op, backward=True)
+            if consumers[oi]:
+                deps = tuple(sorted({bwd_out[ci] for ci in consumers[oi]}))
+            else:
+                # loss frontier: cotangent exists once this op's forward
+                # (and its collective) finished
+                deps = (fwd_out[oi],)
             idx = len(tasks)
-            tasks.append(
-                SimTask(f"{op.name}:bwd", "bwd", cm.backward_time + comm, (prev,))
-            )
-            prev = idx
-        # gradient sync + update
+            tasks.append(SimTask(f"{op.name}:bwd", "bwd", cm.backward_time,
+                                 deps))
+            comm = self._comm_time(op, backward=True)
+            out = idx
+            if comm > 0.0:
+                out = len(tasks)
+                tasks.append(SimTask(f"{op.name}:bwd_comm", "comm", comm,
+                                     (idx,)))
+            bwd_out[oi] = out
+        # gradient sync + update: sync needs every op's backward done
         sync = sum(self.cost_model.measure(op).sync_time for op in ops)
-        tasks.append(SimTask("grad_sync", "comm", sync, (prev,)))
+        sync_deps = tuple(sorted(set(bwd_out.values())))
+        tasks.append(SimTask("grad_sync", "comm", sync, sync_deps))
         tasks.append(SimTask("update", "update", 0.0, (len(tasks) - 1,)))
         return tasks
 

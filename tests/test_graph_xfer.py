@@ -281,3 +281,20 @@ def test_substitution_json_path_reference_schema(tmp_path):
                metrics=[])
     assert ff._search_layers is not None
     assert len(ff._search_layers) == 2  # d1+r1 fused, d2 kept
+
+
+def test_logits_tensor_protected_from_rewrites():
+    """A rewrite must not eliminate the tensor compile() trains on
+    (explicit logits_tensor= override): without protection the fused
+    layer's output replaces it and loss attachment KeyErrors."""
+    ff = FFModel(FFConfig(batch_size=8))
+    ff.config.search_budget = -1
+    ff.config.mesh_shape = {"data": 8}
+    x = ff.create_tensor((8, 16), name="x")
+    d = ff.dense(x, 10, name="d")
+    ff.relu(d, name="r")  # d's only consumer: fusion would eat d
+    ff.compile(optimizer=SGDOptimizer(lr=0.1),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=[], logits_tensor=d)
+    names = [o.name for o in ff.compiled.ops]
+    assert "d" in names  # the producer of the logits tensor survived

@@ -190,3 +190,96 @@ def test_zero_optimizer_shrinks_search_memory_model():
                          FFConfig(batch_size=64, zero_optimizer=True),
                          mesh_shapes=[{"data": 8}])
     assert r_zero.est_memory < r_repl.est_memory
+
+
+def _branchy_ops(axis_sizes, strategies=None, k=2, width=256):
+    """x -> k parallel TP-sharded dense branches -> concat -> head."""
+    ff = FFModel(FFConfig(batch_size=32))
+    x = ff.create_tensor((32, 64), DataType.FLOAT, name="x")
+    outs = [ff.dense(x, width, name=f"b{i}") for i in range(k)]
+    cat = ff.concat(outs, axis=-1, name="cat")
+    ff.dense(cat, 16, name="head")
+    input_ps = {
+        x.tensor_id: ParallelTensorShape(
+            (ParallelDim(32), ParallelDim(64)), DataType.FLOAT)
+    }
+    ops, _ = build_ops(ff.layers, input_ps, axis_sizes, strategies or {})
+    return ops
+
+
+def test_backward_is_a_dag_not_a_chain():
+    """Reverse dependency structure (reference: simulator.cc:850-905 —
+    bwd tasks depend on their consumers' bwd, not a global chain): the two
+    branches' bwd tasks must have the SAME dep (the concat's bwd), and the
+    first op's bwd must not depend on the last op's bwd."""
+    sim = Simulator(SimpleMachineModel(CHIP_PRESETS["test"], 4))
+    ops = _branchy_ops({"data": 1})
+    tasks = sim.build_task_graph(ops)
+    by_name = {t.name: i for i, t in enumerate(tasks)}
+    cat_bwd = by_name["cat:bwd"]
+    b0_deps = tasks[by_name["b0:bwd"]].deps
+    b1_deps = tasks[by_name["b1:bwd"]].deps
+    assert b0_deps == (cat_bwd,) and b1_deps == (cat_bwd,)
+    # grad sync waits on EVERY branch's backward
+    gs = tasks[by_name["grad_sync"]]
+    assert by_name["b0:bwd"] in gs.deps and by_name["b1:bwd"] in gs.deps
+
+
+def test_branch_comm_overlaps_compute_in_backward():
+    """Two independent TP branches: each bwd emits a collective on the
+    network lane, which overlaps the sibling's bwd compute — makespan <
+    serialized sum (the VERDICT round-2 done-criterion; the chain model
+    charged everything serially)."""
+    sim = Simulator(SimpleMachineModel(CHIP_PRESETS["test"], 4),
+                    overlap_grad_sync=False)
+    strategies = {"b0": {"in": "model"}, "b1": {"in": "model"},
+                  "_axis_sizes": None}
+    strategies = {k: v for k, v in strategies.items() if v is not None}
+    ops = _branchy_ops({"model": 4}, strategies, width=512)
+    tasks = sim.build_task_graph(ops)
+    # the sharded-contraction branches must actually emit fwd collectives
+    comm = [t for t in tasks if t.kind == "comm" and t.run_time > 0]
+    assert len(comm) >= 2
+    makespan = sim.simulate_runtime(ops) - sim.machine.chip.step_overhead
+    serial = sum(t.run_time for t in tasks)
+    assert makespan < serial * 0.999
+
+
+def test_straight_chain_unchanged_by_dag_backward():
+    """A straight chain has no branch overlap: DAG deps must reproduce the
+    chain schedule (fwd+bwd+sync accumulate serially)."""
+    sim = Simulator(SimpleMachineModel(CHIP_PRESETS["test"], 1),
+                    overlap_grad_sync=False)
+    ops = _mlp_ops({"data": 1})
+    tasks = sim.build_task_graph(ops)
+    total = sim.simulate_runtime(ops) - sim.machine.chip.step_overhead
+    assert np.isclose(total, sum(t.run_time for t in tasks))
+
+
+def test_pipe_boundary_bytes_use_real_cut_tensors():
+    """_pipe_adjusted charges the ACTUAL stage-cut tensor, not the mean
+    output (VERDICT weak item 4). The FLOP balancer puts the boundary
+    right after the dominant 'wide' layer, whose (8, 4096) activation is
+    the real cut — 2x what the old mean-output heuristic would charge."""
+    from flexflow_tpu.search.unity import _stage_cut_bytes
+
+    ff = FFModel(FFConfig(batch_size=8))
+    x = ff.create_tensor((8, 1024), name="x")
+    h = ff.dense(x, 4096, name="wide")   # dominant FLOPs -> stage cut here
+    h = ff.dense(h, 8, name="narrow")
+    h = ff.dense(h, 4096, name="wide2")
+    h = ff.dense(h, 8, name="out")
+    cut = _stage_cut_bytes(ff.layers, 2)
+    assert cut == 4.0 * 8 * 4096  # exactly the crossing tensor's bytes
+    sizes = [4.0 * np.prod(t.dims) for l in ff.layers for t in l.outputs]
+    mean_heuristic = sum(sizes) / len(sizes)  # what the old model charged
+    assert not np.isclose(cut, mean_heuristic)
+    # a skip connection crossing the same boundary is charged too
+    ff2 = FFModel(FFConfig(batch_size=8))
+    x2 = ff2.create_tensor((8, 1024), name="x")
+    a = ff2.dense(x2, 4096, name="wide")
+    b = ff2.dense(a, 8, name="narrow")
+    c = ff2.dense(b, 4096, name="wide2")
+    ff2.add(a, c, name="skip")  # 'a' crosses the cut twice, counted once
+    cut2 = _stage_cut_bytes(ff2.layers, 2)
+    assert cut2 >= cut  # wide's activation + narrow's output cross
