@@ -29,6 +29,20 @@ import numpy as np
 from ..utils.dot import DotFile
 
 
+def synth_array(t, rng) -> np.ndarray:
+    """Random host array matching a frontend Tensor's declared shape AND
+    dtype — the single synthesizer shared by per-op profiling and
+    calibration timing (two drifting copies previously disagreed on
+    float-dtype handling)."""
+    dt = np.dtype(t.dtype.to_jnp())
+    if np.issubdtype(dt, np.integer):
+        # small non-negative ints: valid class indices / embedding ids
+        return rng.integers(0, 2, size=t.dims).astype(dt)
+    if dt == np.bool_:
+        return rng.integers(0, 2, size=t.dims).astype(bool)
+    return rng.normal(size=t.dims).astype(dt)
+
+
 # --------------------------------------------------------------- jax tracing
 @contextlib.contextmanager
 def trace(logdir: str):
@@ -59,10 +73,7 @@ def profile_ops(ffmodel, iters: int = 10, warmup: int = 2) -> List[Dict]:
     rng = np.random.default_rng(0)
     acts: Dict[int, np.ndarray] = {}
     for t, sh in zip(cm.input_tensors, cm.input_shardings):
-        arr = rng.normal(size=t.dims).astype(np.float32) \
-            if t.dtype.to_jnp() == jnp.float32 else \
-            rng.integers(0, 2, size=t.dims).astype(np.int32)
-        acts[t.tensor_id] = jax.device_put(arr, sh)
+        acts[t.tensor_id] = jax.device_put(synth_array(t, rng), sh)
     records: List[Dict] = []
     ctx = LowerCtx(mesh=cm.mesh, training=False, rng=None)
     for op in cm.ops:

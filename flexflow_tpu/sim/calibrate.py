@@ -11,16 +11,18 @@ simulator actually predicts — FULL train-step times:
 
     real_step ≈ scale * simulated_step + step_overhead
 
-measured on two model sizes (a small config exposes the fixed per-step
-dispatch overhead; a large one exposes the efficiency scale). ``scale``
-folds into the chip's mxu/hbm efficiencies, ``step_overhead`` becomes
-``TPUChipSpec.step_overhead``. The fitted v5e constants live in
-``CHIP_PRESETS`` (see CALIBRATION.md for the measured table).
+least-squares over three model points (a small transformer exposes the
+fixed per-step dispatch overhead; the bench transformer exposes the
+efficiency scale; an AlexNet point keeps conv costs fit rather than
+extrapolated from transformers). ``scale`` folds into the chip's mxu/hbm
+efficiencies, ``step_overhead`` becomes ``TPUChipSpec.step_overhead``.
+The fitted v5e constants live in ``CHIP_PRESETS`` (see CALIBRATION.md
+for the measured table).
 
 Usage (on a machine with the target chip)::
 
     from flexflow_tpu.sim.calibrate import calibrate
-    result = calibrate()          # builds + times two transformers
+    result = calibrate()          # builds + times the three configs
     print(result.report())        # markdown table for CALIBRATION.md
     machine = result.machine      # machine model with fitted chip
 """
@@ -61,28 +63,37 @@ class CalibrationResult:
         return "\n".join(lines)
 
 
-def measure_step_time(ff, batch: int, seq: int, hidden: int,
+def measure_step_time(ff, batch: Optional[int] = None,
+                      seq: Optional[int] = None,
+                      hidden: Optional[int] = None,
                       warmup: int = 3, iters: int = 20) -> float:
     """Execution-fenced train-step timing (the bench.py protocol: the loss
     of iteration N depends on iteration N-1's params, so ONE value fetch at
     the end fences the whole chain — block_until_ready alone does not fence
-    through a device tunnel)."""
+    through a device tunnel). Input/label arrays are synthesized from the
+    compiled model's tensor specs, so any workload (transformer, CNN, …)
+    times the same way; the legacy (batch, seq, hidden) positionals are
+    accepted and ignored."""
     import jax
+
+    from ..runtime.profiling import synth_array
 
     cm = ff.compiled
     rng = np.random.default_rng(0)
-    x = rng.normal(size=(batch, seq, hidden)).astype(np.float32)
-    y = rng.normal(size=(batch, seq, 1)).astype(np.float32)
-    xb = jax.device_put(x, cm.input_shardings[0])
-    yb = jax.device_put(y, cm.label_sharding)
+    xs = [jax.device_put(synth_array(t, rng), sh)
+          for t, sh in zip(cm.input_tensors, cm.input_shardings)]
+    # the compiler records the label's true spec (shape (batch, 1) INT32
+    # for sparse CE, logits-shaped float otherwise — compiler.py:306-323)
+    yb = jax.device_put(synth_array(cm.label_tensor, rng),
+                        cm.label_sharding)
     key = jax.random.key(0)
     p, o = cm.params, cm.opt_state
     for _ in range(warmup):
-        p, o, loss, _ = cm.train_step(p, o, key, xb, yb)
+        p, o, loss, _ = cm.train_step(p, o, key, *xs, yb)
     float(loss)
     t0 = time.perf_counter()
     for _ in range(iters):
-        p, o, loss, _ = cm.train_step(p, o, key, xb, yb)
+        p, o, loss, _ = cm.train_step(p, o, key, *xs, yb)
     float(loss)
     return (time.perf_counter() - t0) / iters
 
@@ -108,17 +119,43 @@ def _build_transformer(batch, layers, seq, hidden, heads):
     return ff
 
 
-# (name, batch, layers, seq, hidden, heads): one overhead-dominated point,
-# one compute-dominated point (the bench transformer, transformer.cc:78-86)
+def _build_cnn(batch: int):
+    """AlexNet on 32x32x3 (the models-zoo builder): the conv-heavy
+    calibration point — conv rooflines extrapolated from a transformer
+    fit carry a systematic bias this point exposes/corrects."""
+    import jax
+
+    from ..config import FFConfig
+    from ..core.machine import make_mesh
+    from ..ffconst import LossType
+    from ..models.alexnet import build_alexnet
+    from ..runtime.model import FFModel
+    from ..runtime.optimizer import SGDOptimizer
+
+    ff = FFModel(FFConfig(batch_size=batch, seed=0))
+    build_alexnet(ff, batch)
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type=LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+               metrics=[],
+               mesh=make_mesh({"data": 1}, devices=jax.devices()[:1]))
+    return ff
+
+
+# (name, builder): one overhead-dominated transformer point, one
+# compute-dominated point (the bench transformer, transformer.cc:78-86),
+# one conv-heavy CNN point (AlexNet, BASELINE.md's CNN family)
 CALIBRATION_CONFIGS = [
-    ("small b8 L4 s256 h512", 8, 4, 256, 512, 8),
-    ("bert-base b8 L12 s512 h1024", 8, 12, 512, 1024, 16),
+    ("small b8 L4 s256 h512", lambda: _build_transformer(8, 4, 256, 512, 8)),
+    ("bert-base b8 L12 s512 h1024",
+     lambda: _build_transformer(8, 12, 512, 1024, 16)),
+    ("alexnet b64 32x32", lambda: _build_cnn(64)),
 ]
 
 
 def calibrate(machine=None, configs=None, iters: int = 20) -> CalibrationResult:
     """Fit (scale, step_overhead) on the current device and return a
-    machine model with the calibrated chip."""
+    machine model with the calibrated chip (least-squares over all
+    configured points — two transformers + a CNN by default)."""
     from . import OpCostModel, Simulator, detect_machine_model
 
     if machine is None:
@@ -135,9 +172,9 @@ def calibrate(machine=None, configs=None, iters: int = 20) -> CalibrationResult:
     base_machine = SimpleMachineModel(base_chip, machine.num_devices())
 
     pts = []
-    for name, b, L, s, h, heads in configs:
-        ff = _build_transformer(b, L, s, h, heads)
-        real = measure_step_time(ff, b, s, h, iters=iters)
+    for name, build in configs:
+        ff = build()
+        real = measure_step_time(ff, iters=iters)
         sim = Simulator(base_machine, OpCostModel(base_machine))
         est = sim.simulate_runtime(ff.compiled.ops)
         pts.append((name, real, est, ff))

@@ -29,7 +29,7 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
-from ..ffconst import DataType
+from ..ffconst import DataType, OpType
 from ..core.op import Op
 from ..core.parallel_tensor import ParallelTensorShape
 from .machine_model import MachineModel
@@ -88,20 +88,54 @@ def _op_strategy_key(op: Op) -> Tuple:
     )
 
 
+# Per-op-family backward/forward time ratios (reference: each op measures
+# its backward separately in measure_operator_cost — e.g.
+# src/ops/linear.cc:792; a uniform 2x misranks strategies whose ops have
+# different fwd/bwd asymmetry):
+#   * matmul family — dgrad + wgrad GEMMs, each the size of the fwd GEMM
+#   * attention — per projection 2 GEMM grads, plus the softmax/logits
+#     chain recomputed against both dQK directions (~2.5x in practice)
+#   * norms — backward fuses two reduction sweeps with the scale/bias
+#     grads over the same bytes (~1.5x)
+#   * recurrent — the scan replays gate GEMMs for dgrad+wgrad (2x)
+#   * weightless elementwise/structural/reduction ops — one pass over the
+#     same bytes (1x)
+# EMBEDDING is special-cased in _measure_uncached: its backward is a
+# bytes-bound scatter-add sized by the touched rows, not a factor of the
+# gather.
+BWD_FACTORS: Dict[OpType, float] = {
+    OpType.LINEAR: 2.0,
+    OpType.CONV2D: 2.0,
+    OpType.BATCHMATMUL: 2.0,
+    OpType.EXPERT_LINEAR: 2.0,
+    OpType.MULTIHEAD_ATTENTION: 2.5,
+    OpType.LAYERNORM: 1.5,
+    OpType.BATCHNORM: 1.5,
+    OpType.LSTM: 2.0,
+    OpType.GRU: 2.0,
+    OpType.RNN: 2.0,
+}
+
+
 class OpCostModel:
     """Analytic roofline cost, memoized.
 
-    The backward pass of a matmul-dominated op costs ~2× forward (dgrad +
-    wgrad GEMMs); elementwise ops ~1×. We use 2× uniformly like the
-    reference's simulator does when an op provides no backward measurement —
-    the constant cancels in strategy comparisons.
+    Backward time is forward time scaled by a per-op-family factor
+    (``BWD_FACTORS``); unlisted ops default to 2x when they carry weights
+    (dgrad + wgrad) and 1x when weightless (one elementwise pass).
     """
 
-    BWD_FACTOR = 2.0
+    BWD_FACTOR = 2.0  # legacy default for unlisted weighted ops
 
     def __init__(self, machine: MachineModel):
         self.machine = machine
         self._cache: Dict[Tuple, CostMetrics] = {}
+
+    def bwd_factor(self, op: Op) -> float:
+        f = BWD_FACTORS.get(op.op_type)
+        if f is not None:
+            return f
+        return self.BWD_FACTOR if op.weight_shapes else 1.0
 
     def measure(self, op: Op) -> CostMetrics:
         key = _op_strategy_key(op)
@@ -166,7 +200,14 @@ class OpCostModel:
         flops_per_dev = total_flops / max(parts, 1)
 
         fwd = self._forward_time(op, flops_per_dev, in_bytes + out_bytes + w_bytes)
-        bwd = self.BWD_FACTOR * fwd
+        if op.op_type is OpType.EMBEDDING:
+            # backward is a scatter-add over ONLY the gathered rows:
+            # read grad (out_bytes) + read-modify-write the touched table
+            # rows (~2 * out_bytes) + indices — bytes-bound, independent
+            # of the full table size the fwd roofline charges
+            bwd = self._forward_time(op, 0.0, in_bytes + 3 * out_bytes)
+        else:
+            bwd = self.bwd_factor(op) * fwd
 
         # gradient sync: any weight replicated across an axis must be
         # all-reduced over that axis's degree (reference: nccl_update_task
@@ -218,9 +259,16 @@ class ProfilingCostModel(OpCostModel):
             return analytic
         if measured is None:
             return analytic
+        # scale the measured forward by the family ratio; embedding keeps
+        # its analytic bytes-bound backward (a factor of the measured
+        # gather would re-import the table-size bias)
+        if op.op_type is OpType.EMBEDDING:
+            bwd = analytic.backward_time
+        else:
+            bwd = self.bwd_factor(op) * measured
         return CostMetrics(
             measured,
-            self.BWD_FACTOR * measured,
+            bwd,
             analytic.sync_time,
             analytic.inputs_memory,
             analytic.outputs_memory,

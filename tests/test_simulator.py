@@ -283,3 +283,41 @@ def test_pipe_boundary_bytes_use_real_cut_tensors():
     ff2.add(a, c, name="skip")  # 'a' crosses the cut twice, counted once
     cut2 = _stage_cut_bytes(ff2.layers, 2)
     assert cut2 >= cut  # wide's activation + narrow's output cross
+
+
+def test_per_op_family_backward_factors():
+    """Backward/forward ratios are per-family (reference: per-op
+    measure_operator_cost, e.g. linear.cc:792 — the uniform 2x misranked
+    strategies with different fwd/bwd asymmetry)."""
+    from flexflow_tpu.ffconst import OpType
+
+    ff = FFModel(FFConfig(batch_size=16))
+    x = ff.create_tensor((16, 64), DataType.FLOAT, name="x")
+    ids = ff.create_tensor((16, 8), DataType.INT32, name="ids")
+    e = ff.embedding(ids, 50000, 64, name="emb")   # huge table
+    h = ff.dense(x, 128, name="fc")
+    h = ff.relu(h, name="act")
+    h = ff.layer_norm(h, axes=[1], name="ln")
+    input_ps = {
+        t.tensor_id: ParallelTensorShape(
+            tuple(ParallelDim(s) for s in t.dims), t.dtype)
+        for t in (x, ids)
+    }
+    ops, _ = build_ops(ff.layers, input_ps, {"data": 1}, {})
+    cm = OpCostModel(SimpleMachineModel(CHIP_PRESETS["test"], 1))
+    by = {o.name: cm.measure(o) for o in ops}
+    byop = {o.name: o for o in ops}
+    # pinned family ratios
+    assert np.isclose(by["fc"].backward_time, 2.0 * by["fc"].forward_time)
+    assert np.isclose(by["ln"].backward_time, 1.5 * by["ln"].forward_time)
+    # weightless elementwise: one pass (the old model charged 2x)
+    assert np.isclose(by["act"].backward_time, by["act"].forward_time)
+    # embedding backward is bytes-bound on the TOUCHED rows, not a factor
+    # of the table-sized forward: far below 2x fwd for a huge vocab
+    emb = by["emb"]
+    assert emb.backward_time < 0.25 * emb.forward_time
+    assert cm.bwd_factor(byop["fc"]) == 2.0
+    # attention family factor
+    from flexflow_tpu.sim.cost_model import BWD_FACTORS
+    assert BWD_FACTORS[OpType.MULTIHEAD_ATTENTION] == 2.5
+    assert BWD_FACTORS[OpType.CONV2D] == 2.0

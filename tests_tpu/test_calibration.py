@@ -1,5 +1,7 @@
 """Simulator-vs-hardware regression (VERDICT round-1 item 4: "simulated
-step-time within 2x of measured for the bench transformer").
+step-time within 2x of measured for the bench transformer"; round-2 item
+6 adds a conv-heavy point so CNN costs are fit, not extrapolated from
+transformers).
 
 Runs only when a real TPU backend is present. The default machine model
 (detect_machine_model) carries the calibrated chip constants from
@@ -17,20 +19,24 @@ if jax.default_backend() == "cpu":
                 allow_module_level=True)
 
 
-@pytest.mark.parametrize(
-    "name,b,L,s,h,heads",
-    [
-        ("small", 8, 4, 256, 512, 8),
-        ("bert-base-bench", 8, 12, 512, 1024, 16),
-    ],
-)
-def test_simulated_step_within_2x_of_measured(name, b, L, s, h, heads):
-    from flexflow_tpu.sim import OpCostModel, Simulator, detect_machine_model
-    from flexflow_tpu.sim.calibrate import (_build_transformer,
-                                            measure_step_time)
+def _cases():
+    from flexflow_tpu.sim.calibrate import _build_cnn, _build_transformer
 
-    ff = _build_transformer(b, L, s, h, heads)
-    real = measure_step_time(ff, b, s, h, iters=15)
+    return [
+        ("small", lambda: _build_transformer(8, 4, 256, 512, 8)),
+        ("bert-base-bench", lambda: _build_transformer(8, 12, 512, 1024, 16)),
+        ("alexnet-cnn", lambda: _build_cnn(64)),
+    ]
+
+
+@pytest.mark.parametrize("case", range(3))
+def test_simulated_step_within_2x_of_measured(case):
+    from flexflow_tpu.sim import OpCostModel, Simulator, detect_machine_model
+    from flexflow_tpu.sim.calibrate import measure_step_time
+
+    name, build = _cases()[case]
+    ff = build()
+    real = measure_step_time(ff, iters=15)
     machine = detect_machine_model(1)
     sim = Simulator(machine, OpCostModel(machine))
     est = sim.simulate_runtime(ff.compiled.ops)
