@@ -1,0 +1,31 @@
+# One-command gates (mirrored by .github/workflows/ci.yml; reference:
+# .github/workflows/{build,gpu-ci,multinode-test}.yml).
+#
+#   make ci       — everything below, in order (the green gate)
+#   make native   — build the C++ helpers (scheduler/batcher/sim engine)
+#   make test     — full suite on the virtual 8-device CPU mesh
+#   make dryrun   — compile+run one training step per parallelism mode
+#   make bench    — the benchmark (real chip when present, CPU fallback)
+
+PY ?= python
+CPU_MESH = JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8"
+
+.PHONY: ci native native-check test dryrun bench
+
+ci: native native-check test dryrun
+
+native:
+	$(MAKE) -C native -s
+
+native-check:
+	$(CPU_MESH) $(PY) -c "from flexflow_tpu import native_bridge as nb; \
+	  print('native helpers:', 'OK' if nb.available() else 'FALLBACK (pure python)')"
+
+test:
+	$(CPU_MESH) $(PY) -m pytest tests/ -x -q
+
+dryrun:
+	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+bench:
+	$(PY) bench.py

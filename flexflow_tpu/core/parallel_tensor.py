@@ -89,6 +89,18 @@ class ParallelTensorShape:
             n *= d.degree
         return n
 
+    def has_duplicate_axes(self) -> bool:
+        """True when one mesh axis shards two dims of this tensor — an
+        impossible GSPMD layout (NamedSharding rejects it); the search
+        must never select such a candidate."""
+        seen = set()
+        for d in self.dims:
+            if d.is_partitioned:
+                if d.axis in seen:
+                    return True
+                seen.add(d.axis)
+        return False
+
     def partition_spec(self) -> PartitionSpec:
         """Lower to a GSPMD PartitionSpec: sharded dims carry their axis
         name, everything else (incl. replica axes) is unspecified, which in

@@ -340,7 +340,6 @@ class NativeBatcher:
                                            int(timeout_s * 1e6))
         if not self._h:
             raise RuntimeError("fftpu_batcher_create failed")
-        self._ids = (ctypes.c_int64 * self.max_batch)()
 
     def submit(self, request_id: int) -> None:
         self._lib.fftpu_batcher_submit(self._h, int(request_id))
@@ -349,11 +348,17 @@ class NativeBatcher:
         return int(self._lib.fftpu_batcher_pending(self._h))
 
     def next_batch(self) -> Optional[List[int]]:
-        """Blocks; returns ids, or None once closed and drained."""
-        n = self._lib.fftpu_batcher_next(self._h, self._ids)
+        """Blocks; returns ids, or None once closed and drained.
+
+        Reentrant: each call writes into its OWN buffer — instance groups
+        run one consumer thread per instance against a shared batcher, and
+        a shared output buffer would let one consumer's result overwrite
+        another's between the native call and the Python read."""
+        ids = (ctypes.c_int64 * self.max_batch)()
+        n = self._lib.fftpu_batcher_next(self._h, ids)
         if n < 0:
             return None
-        return list(self._ids[:n])
+        return list(ids[:n])
 
     def close(self) -> None:
         if getattr(self, "_h", None):

@@ -114,12 +114,17 @@ class Linear(Op):
 
         out_axis = strategy.get("out")
         in_axis = strategy.get("in")
-        if out_axis:
+        # an axis already sharding a batch/seq dim of the output cannot
+        # also shard the feature dim (one mesh axis maps to at most one
+        # dim per tensor — NamedSharding rejects the layout)
+        used = {d.axis for d in out_dims if d.is_partitioned}
+        if out_axis and out_axis not in used:
             deg = strategy.get("_axis_sizes", {}).get(out_axis, 1)
             if deg > 1 and self.out_dim % deg == 0:
                 kdims[1] = ParallelDim(self.out_dim, deg, out_axis)
                 out_feat = ParallelDim(self.out_dim, deg, out_axis)
-        if in_axis:
+        if in_axis and in_axis not in {d.axis for d in in0.dims[:-1]
+                                       if d.is_partitioned}:
             deg = strategy.get("_axis_sizes", {}).get(in_axis, 1)
             if deg > 1 and self.in_dim % deg == 0:
                 kdims[0] = ParallelDim(self.in_dim, deg, in_axis)

@@ -144,16 +144,19 @@ class _AggregateBase(Op):
 
     def _combine(self, gate_weights, assign, stacked, ctx=None):
         """Gate-weighted combine of stacked (n, capacity, d) expert rows
-        (reference: aggregate.cu gather)."""
+        (reference: aggregate.cu gather). Batch comes from the RUNTIME
+        arrays, not compile-time shapes — the pipeline engine (and any
+        microbatching caller) feeds fractions of the compiled batch, and a
+        static reshape would silently mis-fold tokens into features."""
         if ctx is not None and _use_pallas(ctx):
             from ..kernels.moe_kernels import moe_combine
 
             return moe_combine(stacked, assign,
-                               gate_weights.reshape(self.batch, self.k))
+                               gate_weights.reshape(-1, self.k))
         dispatch = moe_dispatch_mask(assign, self.n, self.capacity)  # (T,n,c)
         combine = dispatch * gate_weights.reshape(-1)[:, None, None]
         out_flat = jnp.einsum("tnc,ncf->tf", combine, stacked)  # (T,d)
-        return out_flat.reshape(self.batch, self.k, -1).sum(axis=1)
+        return out_flat.reshape(-1, self.k, out_flat.shape[-1]).sum(axis=1)
 
     def _stack(self, exp_preds):
         return jnp.stack([p.reshape(self.capacity, -1) for p in exp_preds])
@@ -167,7 +170,9 @@ class _AggregateBase(Op):
         counts = jnp.sum(
             jax.nn.one_hot(assign.reshape(-1), self.n, dtype=jnp.float32), axis=0
         )
-        g = (self.lambda_bal * self.n / self.batch) * counts  # (n,)
+        # runtime batch (assign rows): microbatched callers feed fractions
+        # of the compiled batch and the per-sample scale must not change
+        g = (self.lambda_bal * self.n / assign.shape[0]) * counts  # (n,)
         g = g - jnp.mean(g)
         return jnp.sum(jax.lax.stop_gradient(g)[None, :] * full_gate)
 

@@ -116,6 +116,13 @@ def build_ops(
         strategy["_axis_sizes"] = axis_sizes
         op.axis_sizes = dict(axis_sizes)  # single source for sim/search costs
         out_shapes, weight_shapes = op.propagate(in_shapes, strategy)
+        for ps in list(out_shapes) + list(weight_shapes.values()):
+            if ps.has_duplicate_axes():
+                raise ValueError(
+                    f"{layer.name}: strategy {strategies.get(layer.name)} "
+                    f"maps one mesh axis onto two dims of a tensor "
+                    f"({ps.partition_spec()}) — impossible GSPMD layout; "
+                    f"pick a different axis for this op")
         op.output_shapes = out_shapes
         op.weight_shapes = weight_shapes
         # sanity: inferred logical sizes must match the declared outputs

@@ -29,18 +29,32 @@ import numpy as np
 from ..utils.dot import DotFile
 
 
-def synth_array(t, rng) -> np.ndarray:
+def synth_array(t, rng, int_high: int = 2) -> np.ndarray:
     """Random host array matching a frontend Tensor's declared shape AND
     dtype — the single synthesizer shared by per-op profiling and
     calibration timing (two drifting copies previously disagreed on
-    float-dtype handling)."""
+    float-dtype handling).
+
+    ``int_high``: exclusive upper bound for integer inputs. Callers timing
+    embedding-heavy workloads should pass the real vocab bound — ids
+    drawn from {0, 1} gather two cache-hot rows of a huge table and make
+    the measurement systematically optimistic."""
     dt = np.dtype(t.dtype.to_jnp())
     if np.issubdtype(dt, np.integer):
-        # small non-negative ints: valid class indices / embedding ids
-        return rng.integers(0, 2, size=t.dims).astype(dt)
+        return rng.integers(0, max(2, int_high), size=t.dims).astype(dt)
     if dt == np.bool_:
         return rng.integers(0, 2, size=t.dims).astype(bool)
     return rng.normal(size=t.dims).astype(dt)
+
+
+def _min_vocab_bound(ffmodel_or_ops) -> int:
+    """Smallest embedding vocab among the model's ops (a safe id bound:
+    ids must index every embedding they reach)."""
+    ops = getattr(ffmodel_or_ops, "compiled", None)
+    ops = ops.ops if ops is not None else ffmodel_or_ops
+    vocabs = [op.attrs["num_entries"] for op in ops
+              if op.attrs.get("num_entries")]
+    return min(vocabs) if vocabs else 2
 
 
 # --------------------------------------------------------------- jax tracing
@@ -64,7 +78,6 @@ def profile_ops(ffmodel, iters: int = 10, warmup: int = 2) -> List[Dict]:
     Returns one record per op: name, type, ms, flops, arithmetic intensity.
     """
     import jax
-    import jax.numpy as jnp
 
     from ..core.op import LowerCtx
 
@@ -72,8 +85,10 @@ def profile_ops(ffmodel, iters: int = 10, warmup: int = 2) -> List[Dict]:
     assert cm is not None, "compile() first"
     rng = np.random.default_rng(0)
     acts: Dict[int, np.ndarray] = {}
+    bound = _min_vocab_bound(cm.ops)
     for t, sh in zip(cm.input_tensors, cm.input_shardings):
-        acts[t.tensor_id] = jax.device_put(synth_array(t, rng), sh)
+        acts[t.tensor_id] = jax.device_put(
+            synth_array(t, rng, int_high=bound), sh)
     records: List[Dict] = []
     ctx = LowerCtx(mesh=cm.mesh, training=False, rng=None)
     for op in cm.ops:

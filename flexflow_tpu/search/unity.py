@@ -142,6 +142,11 @@ def graph_optimize(
                     out_shapes, weight_shapes = op.propagate(in_shapes, strategy)
                 except Exception:
                     continue
+                # a layout sharding one mesh axis onto two dims of a
+                # tensor cannot exist under GSPMD — never select it
+                if any(ps.has_duplicate_axes()
+                       for ps in list(out_shapes) + list(weight_shapes.values())):
+                    continue
                 op.output_shapes = out_shapes
                 op.weight_shapes = weight_shapes
                 c = cm.measure(op)
@@ -455,7 +460,13 @@ def _pipe_adjusted(
     (model.h:190-192).
     """
     M = pipe_microbatches(batch_size)
-    bubble = (M + pipe - 1) / (M * pipe)
+    # a shared-host virtual mesh runs all "stages" on one socket: no
+    # pipeline speedup exists there (same honesty as
+    # machine_model.effective_parallelism for sharding)
+    if machine.effective_parallelism(pipe) > 1.0:
+        bubble = (M + pipe - 1) / (M * pipe)
+    else:
+        bubble = 1.0
     # boundary traffic from the ACTUAL stage-cut tensors: run the same
     # FLOP-balanced contiguous splitter compile()'s pipeline uses
     # (parallel/pipeline.py split_stages), then charge every tensor that
@@ -466,6 +477,11 @@ def _pipe_adjusted(
     cut_bytes /= max(1, r.mesh_shape.get("data", 1))
     bw = machine.chip.ici_link_bandwidth
     comm = 2.0 * cut_bytes / bw
+    # the GPipe engine is host-driven: every stage×microbatch×direction is
+    # its own program dispatch (parallel/pipeline.py train_step), so the
+    # per-dispatch overhead the chip pays once per fused step is paid
+    # 2·M·P times here — a real cost on tunneled chips and shared hosts
+    comm += 2.0 * M * pipe * machine.chip.step_overhead
     res = GraphSearchResult(
         r.strategies,
         {"pipe": pipe, **r.mesh_shape},

@@ -22,7 +22,7 @@ import itertools
 import threading
 import time
 from concurrent.futures import Future
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -101,6 +101,16 @@ class ModelInstance:
         self.batch_size = cm.input_tensors[0].dims[0]
         self.n_inputs = len(cm.input_tensors)
 
+    @property
+    def devices(self) -> frozenset:
+        """The device set this instance executes on (reference:
+        instance.cc's per-instance device binding) — disjointness across
+        instances is the placement invariant."""
+        mesh = self._cm.mesh
+        if mesh is None:
+            return frozenset()
+        return frozenset(mesh.devices.flat)
+
     @classmethod
     def from_onnx(cls, onnx_path: str, config=None, name: str = "model",
                   mesh=None):
@@ -175,29 +185,65 @@ class InferenceRequest:
 
 class InferenceEngine:
     """Multi-model serving engine (reference: triton/src/backend.cc model
-    repository + scheduler). One dynamic batcher + worker thread per
-    registered model; requests are single samples (leading dim added here)
-    or micro-batches of rows.
+    repository + scheduler; instance.cc instance groups). Each model owns
+    one dynamic batcher and N instances on DISJOINT device submeshes
+    (serving/placement.py); one worker thread per instance drains the
+    shared batcher, so instances of the same model execute concurrently.
+    Requests are single samples (leading dim added here) or micro-batches
+    of rows.
     """
 
     def __init__(self, batch_timeout_s: float = 0.005):
         self.batch_timeout_s = batch_timeout_s
-        self._models: Dict[str, ModelInstance] = {}
+        self._models: Dict[str, List[ModelInstance]] = {}
         self._batchers: Dict[str, object] = {}
         self._requests: Dict[str, Dict[int, InferenceRequest]] = {}
-        self._workers: Dict[str, threading.Thread] = {}
+        self._workers: Dict[Tuple[str, int], threading.Thread] = {}
         self._ids = itertools.count()
         self._mu = threading.Lock()
         self._started = False
 
     # ---- model repository --------------------------------------------------
     def register(self, instance: ModelInstance) -> None:
-        if instance.name in self._models:
-            raise ValueError(f"model {instance.name!r} already registered")
-        self._models[instance.name] = instance
-        self._batchers[instance.name] = _make_batcher(
-            instance.batch_size, self.batch_timeout_s)
-        self._requests[instance.name] = {}
+        """Register one instance. Repeated registrations under the same
+        name form an instance group — their device sets must be disjoint
+        (the placement invariant instance.cc enforces per group)."""
+        group = self._models.get(instance.name)
+        if group:
+            # full spec check: a different-topology instance silently
+            # joining a group would serve a DIFFERENT function for a
+            # fraction of requests (whichever worker drains the batch)
+            def sig(i):
+                cm = i._cm
+                # op TYPES + shapes, not names: layer-name counters are
+                # process-global, so two builds of the same model differ
+                # in names while being the same function
+                return (
+                    i.batch_size, i.n_inputs,
+                    tuple((tuple(t.dims), t.dtype)
+                          for t in cm.input_tensors),
+                    tuple(cm.logits_tensor.dims),
+                    tuple((o.op_type,
+                           tuple(tuple(t.dims) for t in o.layer.outputs))
+                          for o in cm.ops),
+                )
+
+            if sig(instance) != sig(group[0]):
+                raise ValueError(
+                    f"instance group {instance.name!r} mixes model specs "
+                    f"(inputs/outputs/graph must match instance 0)")
+            used = frozenset().union(*(i.devices for i in group))
+            if instance.devices & used:
+                raise ValueError(
+                    f"instance of {instance.name!r} overlaps devices "
+                    f"already serving that model: "
+                    f"{sorted(str(d) for d in instance.devices & used)}")
+            group.append(instance)
+        else:
+            self._models[instance.name] = [instance]
+            self._batchers[instance.name] = _make_batcher(
+                instance.batch_size, self.batch_timeout_s)
+            self._requests[instance.name] = {}
         if self._started:
             self._spawn(instance.name)
 
@@ -213,15 +259,83 @@ class InferenceEngine:
         self.register(inst)
         return inst
 
+    def register_onnx_instances(self, onnx_path: str, name: str,
+                                meshes, batch_size=None) -> List[ModelInstance]:
+        """N instances of one ONNX model on the given (disjoint) meshes."""
+        from ..config import FFConfig
+        from ..ffconst import CompMode
+
+        out = []
+        for mesh in meshes:
+            config = FFConfig(computation_mode=CompMode.INFERENCE)
+            if batch_size:
+                config.batch_size = int(batch_size)
+            out.append(self.register_onnx(onnx_path, name=name,
+                                          config=config, mesh=mesh))
+        return out
+
+    def register_built_instances(self, build, name: str, meshes,
+                                 batch_size: int = 8,
+                                 strategies=None) -> List[ModelInstance]:
+        """N instances of a builder-defined model, one compile per mesh
+        (reference: backend.cc creating `count` ModelInstances per group).
+        ``build(ff, batch_size)`` constructs the graph like the examples'
+        build functions; ``strategies`` is the per-model strategy dict the
+        reference keeps in per-model files."""
+        import jax
+
+        from ..config import FFConfig
+        from ..ffconst import CompMode
+        from ..runtime.model import FFModel
+
+        out = []
+        for mesh in meshes:
+            ff = FFModel(FFConfig(batch_size=batch_size,
+                                  computation_mode=CompMode.INFERENCE))
+            build(ff, batch_size)
+            ff.compile(optimizer=None, loss_type=None, metrics=[],
+                       mesh=mesh, strategies=strategies)
+            if out:
+                # every instance serves the SAME function: replicate
+                # instance 0's weights (fresh builds differ — layer-name
+                # counters are process-global, so init streams diverge).
+                # Pair ops by ORDER, not name, for the same reason.
+                src = out[0]._cm
+                dst = ff.compiled
+                for op0, op1 in zip(src.ops, dst.ops):
+                    if op0.name not in src.params:
+                        continue
+                    for w, v in src.params[op0.name].items():
+                        dst.params[op1.name][w] = jax.device_put(
+                            np.asarray(v),
+                            dst.param_shardings[op1.name][w])
+            out.append(self.register_ffmodel(ff, name=name))
+        return out
+
+    def load_repository(self, path: str, builders=None,
+                        devices=None) -> Dict[str, int]:
+        """Per-model config file -> placed instance groups
+        (serving/placement.py; reference: the Triton model repository)."""
+        from .placement import load_repository
+
+        return load_repository(self, path, builders=builders,
+                               devices=devices)
+
     def models(self) -> List[str]:
         return list(self._models)
 
+    def instances(self, name: str) -> List[ModelInstance]:
+        return list(self._models[name])
+
     # ---- lifecycle ---------------------------------------------------------
     def _spawn(self, name: str) -> None:
-        t = threading.Thread(target=self._worker, args=(name,), daemon=True,
-                             name=f"ffserve-{name}")
-        self._workers[name] = t
-        t.start()
+        for idx in range(len(self._models[name])):
+            if (name, idx) in self._workers:
+                continue
+            t = threading.Thread(target=self._worker, args=(name, idx),
+                                 daemon=True, name=f"ffserve-{name}-{idx}")
+            self._workers[(name, idx)] = t
+            t.start()
 
     def start(self) -> None:
         if self._started:
@@ -234,7 +348,7 @@ class InferenceEngine:
         for b in self._batchers.values():
             b.close()
         still_alive = set()
-        for name, t in self._workers.items():
+        for (name, idx), t in self._workers.items():
             t.join(timeout=10)
             if t.is_alive():  # e.g. stuck in first-call XLA compilation
                 still_alive.add(name)
@@ -249,7 +363,7 @@ class InferenceEngine:
             if name not in still_alive:
                 b.destroy()
             self._batchers[name] = _make_batcher(
-                self._models[name].batch_size, self.batch_timeout_s)
+                self._models[name][0].batch_size, self.batch_timeout_s)
 
     # ---- request path ------------------------------------------------------
     def infer_async(self, model: str, inputs: Sequence[np.ndarray]) -> Future:
@@ -257,7 +371,7 @@ class InferenceEngine:
         resolves to the model's per-request output array."""
         if not self._started:
             self.start()
-        inst = self._models[model]
+        inst = self._models[model][0]  # all group instances share the spec
         # validate per-request shapes HERE so one malformed request fails
         # alone instead of poisoning every co-batched request
         if len(inputs) != inst.n_inputs:
@@ -281,8 +395,8 @@ class InferenceEngine:
         return self.infer_async(model, inputs).result(timeout)
 
     # ---- worker ------------------------------------------------------------
-    def _worker(self, name: str) -> None:
-        inst = self._models[name]
+    def _worker(self, name: str, idx: int = 0) -> None:
+        inst = self._models[name][idx]
         batcher = self._batchers[name]
         while True:
             ids = batcher.next_batch()

@@ -76,14 +76,18 @@ def measure_step_time(ff, batch: Optional[int] = None,
     accepted and ignored."""
     import jax
 
-    from ..runtime.profiling import synth_array
+    from ..runtime.profiling import _min_vocab_bound, synth_array
 
     cm = ff.compiled
     rng = np.random.default_rng(0)
-    xs = [jax.device_put(synth_array(t, rng), sh)
+    # ids span the smallest embedding table so gathers touch a realistic
+    # row spread, not two cache-hot rows
+    bound = _min_vocab_bound(ff)
+    xs = [jax.device_put(synth_array(t, rng, int_high=bound), sh)
           for t, sh in zip(cm.input_tensors, cm.input_shardings)]
     # the compiler records the label's true spec (shape (batch, 1) INT32
-    # for sparse CE, logits-shaped float otherwise — compiler.py:306-323)
+    # for sparse CE, logits-shaped float otherwise — compiler.py:306-323);
+    # labels stay in {0,1}: always-valid class indices
     yb = jax.device_put(synth_array(cm.label_tensor, rng),
                         cm.label_sharding)
     key = jax.random.key(0)
@@ -120,9 +124,11 @@ def _build_transformer(batch, layers, seq, hidden, heads):
 
 
 def _build_cnn(batch: int):
-    """AlexNet on 32x32x3 (the models-zoo builder): the conv-heavy
-    calibration point — conv rooflines extrapolated from a transformer
-    fit carry a systematic bias this point exposes/corrects."""
+    """AlexNet at its native 229x229x3 (the models-zoo builder's default
+    — the topology needs the large input; 32x32 collapses at the third
+    pool): the conv-heavy calibration point — conv rooflines extrapolated
+    from a transformer fit carry a systematic bias this point
+    exposes/corrects."""
     import jax
 
     from ..config import FFConfig
@@ -148,7 +154,7 @@ CALIBRATION_CONFIGS = [
     ("small b8 L4 s256 h512", lambda: _build_transformer(8, 4, 256, 512, 8)),
     ("bert-base b8 L12 s512 h1024",
      lambda: _build_transformer(8, 12, 512, 1024, 16)),
-    ("alexnet b64 32x32", lambda: _build_cnn(64)),
+    ("alexnet b64 229x229", lambda: _build_cnn(64)),
 ]
 
 

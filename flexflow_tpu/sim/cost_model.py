@@ -197,9 +197,16 @@ class OpCostModel:
         parts = 1
         for deg in axis_deg.values():
             parts *= deg
-        flops_per_dev = total_flops / max(parts, 1)
+        # shared-host virtual meshes get NO compute credit from sharding
+        # (machine_model.effective_parallelism) — real chips divide fully
+        eff = self.machine.effective_parallelism(parts)
+        flops_per_dev = total_flops / eff
+        # same honesty for memory traffic: on a real chip each device
+        # streams only its shard; on a shared host every shard's bytes
+        # funnel through one memory system (parts/eff == 1 on real chips)
+        bytes_eff = (in_bytes + out_bytes + w_bytes) * (max(parts, 1) / eff)
 
-        fwd = self._forward_time(op, flops_per_dev, in_bytes + out_bytes + w_bytes)
+        fwd = self._forward_time(op, flops_per_dev, bytes_eff)
         if op.op_type is OpType.EMBEDDING:
             # backward is a scatter-add over ONLY the gathered rows:
             # read grad (out_bytes) + read-modify-write the touched table

@@ -75,6 +75,21 @@ CHIP_PRESETS: Dict[str, TPUChipSpec] = {
         ici_latency=1e-6, dcn_bandwidth=1e9, dcn_latency=1e-5,
         mxu_efficiency=1.0, hbm_efficiency=1.0, kernel_overhead=0.0,
     ),
+    # host CPU running a VIRTUAL device mesh
+    # (xla_force_host_platform_device_count): all "devices" share one
+    # socket, so sharding buys no compute and collectives are memcpys.
+    # Modeled so the search tells the truth on this platform: it should
+    # conclude that parallelism does not pay and keep the graph simple
+    # (used with shared_host=True, which removes the per-device compute
+    # credit entirely).
+    "cpu-host": TPUChipSpec(
+        "cpu-host", 2e11, 2e10, 16 << 30, 5e9, 1,
+        ici_latency=5e-6, dcn_bandwidth=1e9, dcn_latency=5e-5,
+        mxu_efficiency=0.5, hbm_efficiency=0.5, kernel_overhead=5e-6,
+        # jitted-program dispatch from the Python host (~0.1 ms): what the
+        # host-driven pipeline engine pays per stage×microbatch
+        step_overhead=1e-4,
+    ),
 }
 
 
@@ -91,6 +106,14 @@ class MachineModel:
 
     def num_devices(self) -> int:
         raise NotImplementedError
+
+    def effective_parallelism(self, parts: int) -> float:
+        """Wall-clock compute speedup from splitting work ``parts`` ways.
+        Real chips: ``parts`` (each shard runs on its own MXU). A virtual
+        shared-host mesh: 1.0 — the shards time-slice one socket, so
+        sharding buys nothing (the cost model consults this so the search
+        doesn't hallucinate speedups the platform can't deliver)."""
+        return float(max(parts, 1))
 
     # every cost takes per-participant payload bytes and the axis degree
     def allreduce_time(self, bytes_per_device: float, degree: int, axis: str = "") -> float:
@@ -116,15 +139,31 @@ class SimpleMachineModel(MachineModel):
     laid out on the torus by the XLA runtime.
     """
 
-    def __init__(self, chip: TPUChipSpec = CHIP_PRESETS["v5e"], n_devices: int = 1):
+    def __init__(self, chip: TPUChipSpec = CHIP_PRESETS["v5e"],
+                 n_devices: int = 1, shared_host: bool = False):
         self.chip = chip
         self._n = n_devices
+        self.shared_host = shared_host
 
     def num_devices(self) -> int:
         return self._n
 
+    def effective_parallelism(self, parts: int) -> float:
+        if self.shared_host:
+            return 1.0
+        return float(max(parts, 1))
+
     # ring formulas; ICI links are bidirectional so a ring all-gather can use
     # both directions → effective per-link bandwidth ×2.
+    def _serial(self, degree: int) -> float:
+        """Shared-host serialization: the ring formulas assume ``degree``
+        links transferring concurrently; a virtual CPU mesh funnels every
+        'link' through ONE memory system, so collective wall-clock scales
+        back up by the degree. Without this the search under-prices
+        collectives ~n× on the virtual mesh and picks sharded strategies
+        that lose in real wall-clock (observed on the AE protocol)."""
+        return float(degree) if self.shared_host else 1.0
+
     def _bw(self, axis: str) -> float:
         return self.chip.ici_link_bandwidth * 2.0
 
@@ -138,21 +177,24 @@ class SimpleMachineModel(MachineModel):
     def allgather_time(self, bytes_per_device, degree, axis=""):
         if degree <= 1:
             return 0.0
-        return (degree - 1) * (bytes_per_device / self._bw(axis) + self._lat(axis))
+        return self._serial(degree) * (degree - 1) * (
+            bytes_per_device / self._bw(axis) + self._lat(axis))
 
     def reducescatter_time(self, bytes_per_device, degree, axis=""):
         # same volume pattern as all-gather (each device ends with 1/degree)
         if degree <= 1:
             return 0.0
         shard = bytes_per_device / degree
-        return (degree - 1) * (shard / self._bw(axis) + self._lat(axis))
+        return self._serial(degree) * (degree - 1) * (
+            shard / self._bw(axis) + self._lat(axis))
 
     def allreduce_time(self, bytes_per_device, degree, axis=""):
         # reduce-scatter + all-gather of the scattered shard
         if degree <= 1:
             return 0.0
         shard = bytes_per_device / degree
-        return 2 * (degree - 1) * (shard / self._bw(axis) + self._lat(axis))
+        return self._serial(degree) * 2 * (degree - 1) * (
+            shard / self._bw(axis) + self._lat(axis))
 
     def alltoall_time(self, bytes_per_device, degree, axis=""):
         if degree <= 1:
@@ -161,12 +203,14 @@ class SimpleMachineModel(MachineModel):
         # bidirectional ring average hop distance degree/4 over degree
         # concurrent links → effective time ≈ vol / (2·bw)
         vol = bytes_per_device * (degree - 1) / degree
-        return vol / (2.0 * self._bw(axis)) + self._lat(axis) * degree / 2
+        return (self._serial(degree) * vol / (2.0 * self._bw(axis))
+                + self._lat(axis) * degree / 2)
 
     def permute_time(self, bytes_per_device, degree, axis=""):
         if degree <= 1:
             return 0.0
-        return bytes_per_device / self._bw_unidir(axis) + self._lat(axis)
+        return (self._serial(degree) * bytes_per_device / self._bw_unidir(axis)
+                + self._lat(axis))
 
 
 class TorusMachineModel(SimpleMachineModel):
@@ -299,6 +343,12 @@ def detect_machine_model(n_devices: Optional[int] = None) -> MachineModel:
 
     devs = jax.devices()
     n = n_devices if n_devices is not None else len(devs)
+    if devs and devs[0].platform == "cpu":
+        # a virtual CPU mesh (xla_force_host_platform_device_count): the
+        # "devices" time-slice one socket — model it honestly so the
+        # search picks strategies that actually help HERE (usually: none)
+        return SimpleMachineModel(CHIP_PRESETS["cpu-host"], n,
+                                  shared_host=True)
     kind = getattr(devs[0], "device_kind", "").lower() if devs else ""
     compact = kind.replace(" ", "")
     # device_kind strings: "TPU v4", "TPU v5 lite"/"TPU v5e", "TPU v5p",

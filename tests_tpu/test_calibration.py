@@ -19,22 +19,15 @@ if jax.default_backend() == "cpu":
                 allow_module_level=True)
 
 
-def _cases():
-    from flexflow_tpu.sim.calibrate import _build_cnn, _build_transformer
-
-    return [
-        ("small", lambda: _build_transformer(8, 4, 256, 512, 8)),
-        ("bert-base-bench", lambda: _build_transformer(8, 12, 512, 1024, 16)),
-        ("alexnet-cnn", lambda: _build_cnn(64)),
-    ]
+# the gate runs EXACTLY the points calibrate() fits — one shared list
+from flexflow_tpu.sim.calibrate import CALIBRATION_CONFIGS  # noqa: E402
 
 
-@pytest.mark.parametrize("case", range(3))
-def test_simulated_step_within_2x_of_measured(case):
+@pytest.mark.parametrize("name,build", CALIBRATION_CONFIGS,
+                         ids=[n for n, _ in CALIBRATION_CONFIGS])
+def test_simulated_step_within_2x_of_measured(name, build):
     from flexflow_tpu.sim import OpCostModel, Simulator, detect_machine_model
     from flexflow_tpu.sim.calibrate import measure_step_time
-
-    name, build = _cases()[case]
     ff = build()
     real = measure_step_time(ff, iters=15)
     machine = detect_machine_model(1)
