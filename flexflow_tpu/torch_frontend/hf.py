@@ -23,6 +23,7 @@ goes further because HF graphs are messier than torchvision's:
 
 from __future__ import annotations
 
+import contextlib
 import math
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -31,9 +32,99 @@ import numpy as np
 
 def _shape_of(node) -> Optional[Tuple[int, ...]]:
     tm = node.meta.get("tensor_meta")
-    if tm is None:
+    if tm is None or not hasattr(tm, "shape"):
+        # multi-output nodes (split) carry a TUPLE of metadata — no single
+        # shape exists; callers treat that like "unknown"
         return None
     return tuple(int(s) for s in tm.shape)
+
+
+@contextlib.contextmanager
+def _hf_trace_patches(model, batch_size: int, seq_length: int):
+    """Work around upstream fx blockers during the trace (restored after):
+
+    * ``masking_utils.create_causal_mask`` runs ``torch.vmap`` over fx
+      proxies (untraceable); under static shapes the causal mask IS a
+      constant, so return it as one (HF's own fx tests stub mask creation
+      the same way).
+    * GPT-2's attention unpacks ``key_states.shape`` (proxy iteration —
+      metadata is lost on ``split`` outputs in transformers>=4.5x); swap
+      in a functionally identical forward with STATIC shapes — the same
+      static-shape contract the whole importer (and XLA) already assumes.
+      Covers the no-cache, self-attention form (what an encoder-style
+      import needs); cross-attention raises.
+    """
+    import sys
+
+    import torch
+
+    undo = []
+    S = int(seq_length)
+
+    def _const_causal(*a, **kw):
+        # a padding mask proxy means the user wants masked attention —
+        # the constant causal mask would silently attend padded positions
+        import torch.fx as _fx
+
+        am = kw.get("attention_mask")
+        if am is None and len(a) > 2:
+            am = a[2]
+        if isinstance(am, _fx.Proxy):
+            raise NotImplementedError(
+                "decoder import with a padding attention_mask is not "
+                "supported — trace with input_names=['input_ids'] (full "
+                "sequences) or pre-pack the batch")
+        m = torch.full((1, 1, S, S), -1e9)
+        return torch.triu(m, diagonal=1)
+
+    for name, mod in list(sys.modules.items()):
+        if (name.startswith("transformers")
+                and getattr(mod, "create_causal_mask", None) is not None):
+            undo.append((mod, "create_causal_mask", mod.create_causal_mask))
+            mod.create_causal_mask = _const_causal
+
+    try:
+        from transformers.models.gpt2.modeling_gpt2 import GPT2Attention
+    except ImportError:  # pragma: no cover - transformers layout change
+        GPT2Attention = None
+    if GPT2Attention is not None and any(
+            isinstance(m, GPT2Attention) for m in model.modules()):
+        B = int(batch_size)
+
+        def gpt2_attn_forward(self, hidden_states, past_key_values=None,
+                              cache_position=None, attention_mask=None,
+                              head_mask=None, encoder_hidden_states=None,
+                              encoder_attention_mask=None,
+                              output_attentions=False, **kwargs):
+            import torch.nn.functional as F
+
+            if encoder_hidden_states is not None:
+                raise ValueError(
+                    "GPT-2 cross-attention import is unsupported")
+            if getattr(self, "scale_attn_by_inverse_layer_idx", False):
+                raise ValueError(
+                    "scale_attn_by_inverse_layer_idx import unsupported")
+            q, k, v = self.c_attn(hidden_states).split(self.split_size,
+                                                       dim=2)
+            H, D = self.num_heads, self.head_dim
+            q = q.view(B, S, H, D).transpose(1, 2)
+            k = k.view(B, S, H, D).transpose(1, 2)
+            v = v.view(B, S, H, D).transpose(1, 2)
+            out = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=attention_mask,
+                is_causal=attention_mask is None)
+            out = out.transpose(1, 2).contiguous().view(B, S, H * D)
+            out = self.c_proj(out)
+            out = self.resid_dropout(out)
+            return out, None
+
+        undo.append((GPT2Attention, "forward", GPT2Attention.forward))
+        GPT2Attention.forward = gpt2_attn_forward
+    try:
+        yield
+    finally:
+        for obj, attr, val in undo:
+            setattr(obj, attr, val)
 
 
 def trace_hf(model, input_names: Sequence[str] = ("input_ids",),
@@ -45,7 +136,21 @@ def trace_hf(model, input_names: Sequence[str] = ("input_ids",),
     from torch.fx.passes.shape_prop import ShapeProp
     from transformers.utils import fx as hf_fx
 
-    gm = hf_fx.symbolic_trace(model, input_names=list(input_names))
+    from .model import _is_hf_conv1d
+
+    class _Tracer(hf_fx.HFTracer):
+        def is_leaf_module(self, m, module_qualified_name):
+            # transformers' Conv1D (GPT-2 projections) must stay a leaf:
+            # traced through, its weight/bias surface as raw get_attr
+            # params with an addmm — opaque to the importer; as a leaf it
+            # maps 1:1 onto dense (see _module_record)
+            if _is_hf_conv1d(m):
+                return True
+            return super().is_leaf_module(m, module_qualified_name)
+
+    with _hf_trace_patches(model, batch_size, seq_length):
+        gm = hf_fx.symbolic_trace(model, input_names=list(input_names),
+                                  tracer_cls=_Tracer)
 
     # example batch for shape propagation (ids → zeros; masks → ones)
     examples = []
@@ -160,6 +265,10 @@ def trace_hf(model, input_names: Sequence[str] = ("input_ids",),
             mask_val = torch.from_numpy(m)
         elif attn_mask is None:
             mask_val = None
+        elif isinstance(attn_mask, torch.Tensor):
+            # a raw tensor baked in at trace time (the patched
+            # create_causal_mask returns a concrete constant)
+            mask_val = attn_mask
         else:
             if not is_const(attn_mask):
                 raise ValueError(

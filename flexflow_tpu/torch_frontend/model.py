@@ -27,6 +27,14 @@ def _pair(v):
 
 
 # --------------------------------------------------------------------- trace
+def _is_hf_conv1d(mod) -> bool:
+    """transformers.pytorch_utils.Conv1D (GPT-2's projection layer),
+    duck-typed so torch_frontend works without transformers installed.
+    THE single predicate shared by tracing (leaf-module policy), record
+    mapping, and weight copy — they must agree on what a Conv1D is."""
+    return type(mod).__name__ == "Conv1D" and hasattr(mod, "nf")
+
+
 def _module_record(name, mod, inputs):
     import torch.nn as nn
 
@@ -82,6 +90,11 @@ def _module_record(name, mod, inputs):
             raise ValueError(f"unsupported Flatten({mod.start_dim},{mod.end_dim})")
     elif isinstance(mod, nn.Identity):
         op = "identity"
+    elif _is_hf_conv1d(mod):
+        # x @ W + b with W stored (in, out) — a dense whose kernel needs
+        # NO transpose in copy_weights (unlike nn.Linear's (out, in))
+        op = "dense"
+        a = dict(out_dim=int(mod.nf), use_bias=mod.bias is not None)
     elif isinstance(mod, nn.MultiheadAttention):
         # fx treats nn.MultiheadAttention as a leaf module, so it arrives
         # as one call_module node mapping 1:1 onto
@@ -264,6 +277,12 @@ def _function_record(node, torch, F) -> Dict:
                                                     if not is_node(a)]})
         if m == "contiguous" or m == "clone" or m == "detach":
             return rec("identity", [self_arg])
+        if m == "split":
+            sizes = args[1]
+            axis = int(node.kwargs.get("dim", args[2] if len(args) > 2 else 0))
+            sizes = (list(sizes) if isinstance(sizes, (tuple, list))
+                     else int(sizes))
+            return rec("split", [self_arg], {"sizes": sizes, "axis": axis})
         if m == "softmax":
             return rec("softmax", [self_arg], {"axis": int(args[1])})
         if m == "mean":
@@ -297,6 +316,10 @@ def _function_record(node, torch, F) -> Dict:
         return rec("tanh", [args[0].name])
     if tgt in (torch.exp,):
         return rec("exp", [args[0].name])
+    if tgt in (torch.pow,):
+        if is_node(args[1]):
+            raise ValueError("pow with tensor exponent is not importable")
+        return rec("pow", [args[0].name], {"exponent": float(args[1])})
     if tgt is F.softmax or tgt is torch.softmax:
         axis = node.kwargs.get("dim", args[1] if len(args) > 1 else -1)
         return rec("softmax", [args[0].name], {"axis": int(axis)})
@@ -490,6 +513,8 @@ class PyTorchModel:
         if op in ("relu", "gelu", "sigmoid", "tanh", "elu", "exp", "sin",
                   "cos", "rsqrt", "identity"):
             return getattr(ff, op)(x[0], name=name)
+        if op == "pow":
+            return ff.pow(x[0], a["exponent"], name=name)
         if op == "softmax":
             return ff.softmax(x[0], axis=a.get("axis", -1), name=name)
         if op == "flat":
@@ -525,7 +550,16 @@ class PyTorchModel:
         if op == "concat":
             return ff.concat(x, axis=a["axis"], name=name)
         if op == "split":
-            return ff.split(x[0], a["sizes"], axis=a["axis"], name=name)
+            sizes = a["sizes"]
+            if isinstance(sizes, int):
+                # torch semantics: int = CHUNK SIZE with a short final
+                # remainder chunk (ff.split's int means equal part COUNT)
+                total = x[0].dims[a["axis"] % len(x[0].dims)]
+                chunk = sizes
+                sizes = [chunk] * (total // chunk)
+                if total % chunk:
+                    sizes.append(total % chunk)
+            return ff.split(x[0], sizes, axis=a["axis"], name=name)
         if op == "batch_matmul":
             return ff.batch_matmul(x[0], x[1], name=name)
         if op == "multihead_attention":
@@ -617,7 +651,15 @@ def copy_weights(ffmodel, torch_module,
             continue
         wmap = {p.name.split("/")[-1]: p for p in layer.weights}
         with torch.no_grad():
-            if isinstance(mod, torch.nn.Linear):
+            if _is_hf_conv1d(mod):
+                # transformers Conv1D stores (in, out) — the FF layout
+                # already; NO transpose (nn.Linear below needs one)
+                wmap["kernel"].set_weights(ffmodel,
+                                           mod.weight.detach().numpy())
+                if "bias" in wmap and mod.bias is not None:
+                    wmap["bias"].set_weights(ffmodel,
+                                             mod.bias.detach().numpy())
+            elif isinstance(mod, torch.nn.Linear):
                 wmap["kernel"].set_weights(ffmodel, mod.weight.detach().numpy().T)
                 if "bias" in wmap and mod.bias is not None:
                     wmap["bias"].set_weights(ffmodel, mod.bias.detach().numpy())

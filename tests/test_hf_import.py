@@ -96,3 +96,57 @@ def test_hf_bert_finetunes():
             params, opt_state, jax.random.key(i), ids, y)
         losses.append(float(loss))
     assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+# ------------------------------------------------------------------- GPT-2
+def _tiny_gpt2():
+    from transformers import GPT2Config, GPT2Model
+
+    cfg = GPT2Config(n_embd=32, n_layer=2, n_head=2, vocab_size=100,
+                     n_positions=16, resid_pdrop=0.0, embd_pdrop=0.0,
+                     attn_pdrop=0.0, use_cache=False)
+    return GPT2Model(cfg).eval()
+
+
+def test_hf_gpt2_forward_matches_torch():
+    """GPT-2 import parity (round-2 VERDICT item 10: the upstream
+    transformers.fx path vmaps the causal mask over proxies and loses
+    metadata on split outputs; trace-time patches in torch_frontend/hf.py
+    swap in static-shape equivalents). Conv1D kernels bind untransposed."""
+    m = _tiny_gpt2()
+    pm = PyTorchModel(m, input_names=["input_ids"], batch_size=B,
+                      seq_length=S)
+    ff = FFModel(FFConfig(batch_size=B, seed=0))
+    x = ff.create_tensor((B, S), DataType.INT32, name="input_ids")
+    outs = pm.apply(ff, [x])
+    assert outs[0].dims == (B, S, 32)
+    ff.compile(optimizer=SGDOptimizer(lr=0.01), loss_type=None, metrics=[])
+    copy_weights(ff, m, pm.module_paths)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 100, (B, S)).astype(np.int32)
+    got = np.asarray(ff.compiled.forward_fn(ff.compiled.params, ids))
+    with torch.no_grad():
+        want = m(torch.from_numpy(ids.astype(np.int64))
+                 ).last_hidden_state.numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_hf_gpt2_trace_patches_restore():
+    """The trace-time patches must not leak: after import, the upstream
+    GPT2Attention.forward and create_causal_mask are restored."""
+    import sys
+
+    from transformers.models.gpt2.modeling_gpt2 import GPT2Attention
+
+    before = GPT2Attention.forward
+    masks_before = {
+        name: mod.create_causal_mask
+        for name, mod in list(sys.modules.items())
+        if name.startswith("transformers")
+        and getattr(mod, "create_causal_mask", None) is not None
+    }
+    m = _tiny_gpt2()
+    PyTorchModel(m, input_names=["input_ids"], batch_size=B, seq_length=S)
+    assert GPT2Attention.forward is before
+    for name, fn in masks_before.items():
+        assert sys.modules[name].create_causal_mask is fn
