@@ -95,10 +95,19 @@ class Conv2D(Op):
         return [apply_activation(y, self.activation)]
 
     def propagate(self, input_shapes, strategy):
-        """Channel parallelism: ``{"out_channels": axis}`` shards the kernel
-        O-dim and the output channel dim (the reference's conv channel
-        partition xfers, OptCNN patterns in generate_all_pcg_xfers;
-        attribute parallelism on non-batch dims, model.cc:3627)."""
+        """Attribute parallelism on non-batch dims (model.cc:3627):
+
+        * ``{"out_channels": axis}`` shards the kernel O-dim and the
+          output channel dim (the reference's conv channel partition
+          xfers, OptCNN patterns in generate_all_pcg_xfers);
+        * ``{"spatial": axis}`` shards the image HEIGHT of input and
+          output (the reference's spatial partition,
+          substitution.cc:87-95). Under GSPMD the halo exchange the
+          reference hand-schedules is emitted by XLA's spatial conv
+          partitioner; the simulator prices it (sim/simulator.py). Legal
+          when both heights divide and each shard is taller than the
+          halo.
+        """
         out_shapes, weight_shapes = super().propagate(input_shapes, strategy)
         axis = strategy.get("out_channels")
         if axis:
@@ -117,6 +126,17 @@ class Conv2D(Op):
                         (ParallelDim(self.out_channels, deg, axis),),
                         weight_shapes["bias"].dtype,
                     )
+        sp_axis = strategy.get("spatial")
+        if sp_axis:
+            deg = strategy.get("_axis_sizes", {}).get(sp_axis, 1)
+            in_h = input_shapes[0].sizes[2]
+            out_h = out_shapes[0].sizes[2]
+            used = {d.axis for d in out_shapes[0].dims if d.is_partitioned}
+            if (deg > 1 and sp_axis not in used
+                    and in_h % deg == 0 and out_h % deg == 0
+                    and in_h // deg > self.kernel[0] // 2):
+                out_shapes[0] = out_shapes[0].with_dim(
+                    2, ParallelDim(out_h, deg, sp_axis))
         return out_shapes, weight_shapes
 
     def flops(self) -> float:
@@ -138,6 +158,24 @@ class Pool2D(Op):
         sh, sw = self.attrs["stride"]
         return [((n, c, _conv_out(h, kh, ph, sh), _conv_out(w, kw, pw, sw)),
                  self.input_shapes[0].dtype)]
+
+    def propagate(self, input_shapes, strategy):
+        """Pooling changes H/W, so the base size-match rule drops a
+        spatial sharding; carry it through when the pooled height still
+        divides (reference: create_mapping_xfers<Pool2D> keeps the
+        partition across pooling, substitution.cc:87-95). The simulator
+        prices any halo from the sharded output H + kernel/stride
+        directly (overlapping windows only; sim/simulator.py)."""
+        out_shapes, weight_shapes = super().propagate(input_shapes, strategy)
+        hd = input_shapes[0].dims[2]
+        out_h = out_shapes[0].sizes[2]
+        if (hd.is_partitioned and out_h % hd.degree == 0
+                and not out_shapes[0].dims[2].is_partitioned
+                and hd.axis not in {d.axis for d in out_shapes[0].dims
+                                    if d.is_partitioned}):
+            out_shapes[0] = out_shapes[0].with_dim(
+                2, ParallelDim(out_h, hd.degree, hd.axis))
+        return out_shapes, weight_shapes
 
     def forward(self, ctx, inputs, weights):
         (x,) = inputs

@@ -127,11 +127,25 @@ def candidate_strategies(
                 cands.append({"vocab": a})
             if out_dim % n == 0:
                 cands.append({"out": a})
-    elif t is OpType.CONV2D and param_ok:
+    elif t is OpType.CONV2D:
         out_c = layer.attrs.get("out_channels", 0)
-        for a in model_axes:
-            if out_c % axis_sizes[a] == 0:
-                cands.append({"out_channels": a})
+        if param_ok:
+            for a in model_axes:
+                if out_c % axis_sizes[a] == 0:
+                    cands.append({"out_channels": a})
+        if attr_ok and layer.inputs and len(layer.inputs[0].dims) == 4:
+            # spatial (H) partitioning with halo exchange (reference:
+            # substitution.cc:87-95 image-dim partition)
+            in_h = layer.inputs[0].dims[2]
+            kh, _ = layer.attrs.get("kernel", (1, 1))
+            ph, _ = layer.attrs.get("padding", (0, 0))
+            sh, _ = layer.attrs.get("stride", (1, 1))
+            out_h = (in_h + 2 * ph - kh) // sh + 1
+            for a in model_axes:
+                n = axis_sizes[a]
+                if (in_h % n == 0 and out_h % n == 0
+                        and in_h // n > kh // 2):
+                    cands.append({"spatial": a})
     elif t is OpType.GROUP_BY_STACKED and param_ok:
         # expert parallelism: shard the stacked expert dim. The data axis is
         # a legitimate EP axis here (GShard-style: expert shards colocate

@@ -156,6 +156,28 @@ class Simulator:
                 else:
                     sp_time = 2.0 * (deg - 1) * m.permute_time(block, deg, axis)
 
+        # spatial (H) partitioning of conv/pool: each shard needs kh//2
+        # input rows from BOTH neighbors per traversal direction — the
+        # halo exchange the reference hand-schedules in its spatial
+        # partition xfers (substitution.cc:87-95); XLA's spatial conv
+        # partitioner emits it as collective-permutes, priced here
+        if (t in (OpType.CONV2D, OpType.POOL2D) and out0 is not None
+                and in0 is not None and len(out0.dims) == 4):
+            hd = out0.dims[2]
+            kh = op.attrs.get("kernel", (1, 1))[0]
+            sh = op.attrs.get("stride", (1, 1))[0]
+            # rows read across an aligned shard boundary: windows overlap
+            # neighbours only when the kernel outruns the stride (a 2x2/s2
+            # pool exchanges NOTHING)
+            halo = max(0, (kh - sh + 1) // 2)
+            if hd.is_partitioned and halo > 0:
+                n_l = in0.dims[0].size // in0.dims[0].degree
+                c_l = in0.dims[1].size // in0.dims[1].degree
+                w = in0.dims[3].size // in0.dims[3].degree
+                row = n_l * c_l * w * in0.dtype.itemsize()
+                sp_time += 2.0 * m.permute_time(halo * row, hd.degree,
+                                                hd.axis)
+
         # compute op: explicit contraction structure first (Linear/Conv/…)
         out_bytes = sum(_pshape_local_bytes(p) for p in op.output_shapes)
         out_axes = {

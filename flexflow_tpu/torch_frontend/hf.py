@@ -104,6 +104,11 @@ def _hf_trace_patches(model, batch_size: int, seq_length: int):
             if getattr(self, "scale_attn_by_inverse_layer_idx", False):
                 raise ValueError(
                     "scale_attn_by_inverse_layer_idx import unsupported")
+            if not getattr(self, "scale_attn_weights", True):
+                # SDPA always scales by 1/sqrt(head_dim); an unscaled
+                # checkpoint would import silently wrong
+                raise ValueError(
+                    "scale_attn_weights=False import unsupported")
             q, k, v = self.c_attn(hidden_states).split(self.split_size,
                                                        dim=2)
             H, D = self.num_heads, self.head_dim

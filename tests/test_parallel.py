@@ -318,3 +318,102 @@ def test_ulysses_transformer_trains_dp_sp():
     p, o, loss, _ = cm.train_step(cm.params, cm.opt_state,
                                   jax.random.key(0), x, y)
     assert np.isfinite(float(loss))
+
+
+# ----------------------------------------------------- spatial (H/W) conv
+def _conv_stack(ff):
+    from flexflow_tpu import ActiMode
+
+    x = ff.create_tensor((8, 3, 16, 16), DataType.FLOAT, name="img")
+    t = ff.conv2d(x, 8, 3, 3, 1, 1, 1, 1, ActiMode.RELU, name="c1")
+    t = ff.pool2d(t, 2, 2, 2, 2, 0, 0, name="p1")
+    t = ff.conv2d(t, 16, 3, 3, 1, 1, 1, 1, name="c2")
+    t = ff.flat(t)
+    t = ff.dense(t, 5, name="head")
+    ff.softmax(t)
+    return x
+
+
+def test_spatial_conv_partitioning_exact():
+    """H-partitioned conv/pool (reference: substitution.cc:87-95 spatial
+    xfers) matches the single-device result exactly — XLA's spatial conv
+    partitioner emits the halo exchanges the reference hand-schedules."""
+    import jax
+
+    from flexflow_tpu import LossType, SGDOptimizer, make_mesh
+
+    ff1 = FFModel(FFConfig(batch_size=8, seed=0))
+    _conv_stack(ff1)
+    ff1.compile(optimizer=SGDOptimizer(lr=0.1),
+                loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                metrics=[],
+                mesh=make_mesh({"data": 1}, devices=jax.devices()[:1]))
+    ff2 = FFModel(FFConfig(batch_size=8, seed=0))
+    _conv_stack(ff2)
+    ff2.compile(optimizer=SGDOptimizer(lr=0.1),
+                loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                metrics=[], mesh=make_mesh({"data": 2, "model": 4}),
+                strategies={"c1": {"spatial": "model"},
+                            "c2": {"spatial": "model"}})
+    c1 = next(o for o in ff2.compiled.ops if o.name == "c1")
+    assert tuple(c1.output_shapes[0].partition_spec()) == (
+        "data", None, "model", None)
+    # pool carries the spatial sharding through (halved height divides)
+    p1 = next(o for o in ff2.compiled.ops if o.name == "p1")
+    assert tuple(p1.output_shapes[0].partition_spec())[2] == "model"
+    # transplant params (layer-name counters are global: pair by order)
+    for o1, o2 in zip(ff1.compiled.ops, ff2.compiled.ops):
+        if o1.name in ff1.compiled.params:
+            for w, v in ff1.compiled.params[o1.name].items():
+                ff2.compiled.params[o2.name][w] = jax.device_put(
+                    np.asarray(v), ff2.compiled.param_shardings[o2.name][w])
+    rng = np.random.default_rng(0)
+    xs = rng.normal(size=(8, 3, 16, 16)).astype(np.float32)
+    o1 = np.asarray(ff1.compiled.forward_fn(ff1.compiled.params, xs))
+    o2 = np.asarray(ff2.compiled.forward_fn(ff2.compiled.params, xs))
+    np.testing.assert_allclose(o1, o2, rtol=1e-5, atol=1e-6)
+
+
+def test_spatial_candidates_and_halo_priced():
+    """The search enumerates {"spatial": axis} for eligible convs and the
+    simulator charges the halo exchange (permutes over the H axis)."""
+    from flexflow_tpu.runtime.compiler import build_ops
+    from flexflow_tpu.search.substitution import candidate_strategies
+    from flexflow_tpu.sim import CHIP_PRESETS, SimpleMachineModel, Simulator
+    from flexflow_tpu.core.parallel_tensor import ParallelTensorShape
+
+    ff = FFModel(FFConfig(batch_size=8))
+    x = _conv_stack(ff)
+    conv = next(l for l in ff.layers if l.name == "c1")
+    cands = candidate_strategies(conv, {"data": 2, "model": 4})
+    assert {"spatial": "model"} in cands
+    # a conv whose height does not divide gets no spatial candidate
+    ff2 = FFModel(FFConfig(batch_size=8))
+    y = ff2.create_tensor((8, 3, 15, 15), DataType.FLOAT, name="odd")
+    ff2.conv2d(y, 8, 3, 3, 1, 1, 1, 1, name="codd")
+    codd = ff2.layers[-1]
+    assert not any("spatial" in c for c in
+                   candidate_strategies(codd, {"data": 2, "model": 4}))
+
+    ops, _ = build_ops(
+        ff.layers,
+        {x.tensor_id: ParallelTensorShape.unpartitioned(
+            (8, 3, 16, 16))},
+        {"model": 4},
+        {"c1": {"spatial": "model"}, "c2": {"spatial": "model"}})
+    sim = Simulator(SimpleMachineModel(CHIP_PRESETS["test"], 4))
+    c1 = next(o for o in ops if o.name == "c1")
+    halo = sim._comm_time(c1, backward=False)
+    # kh=3 -> one halo row each side: 2 permutes of 8*3*16*4 bytes
+    m = sim.machine
+    want = 2.0 * m.permute_time(8 * 3 * 16 * 4, 4, "model")
+    assert np.isclose(halo, want)
+    # 1x1 convs need no halo
+    ff3 = FFModel(FFConfig(batch_size=8))
+    z = ff3.create_tensor((8, 4, 16, 16), DataType.FLOAT, name="z")
+    ff3.conv2d(z, 8, 1, 1, 1, 1, 0, 0, name="c11")
+    ops3, _ = build_ops(
+        ff3.layers,
+        {z.tensor_id: ParallelTensorShape.unpartitioned((8, 4, 16, 16))},
+        {"model": 4}, {"c11": {"spatial": "model"}})
+    assert sim._comm_time(ops3[0], backward=False) == 0.0
