@@ -44,11 +44,16 @@ def run_example(name, build, make_data, loss_type, metrics,
     wb = config.batch_size
     ff.fit([a[:wb] for a in xs] if len(xs) > 1 else xs[0][:wb], y[:wb],
            epochs=1, shuffle=False, verbose=False)
-    start = time.perf_counter()
-    history = ff.fit(xs if len(xs) > 1 else xs[0], y, verbose=True)
-    elapsed = time.perf_counter() - start
-    samples = len(y) * config.epochs
-    # the reference's fenced benchmark print (transformer.cc:205-210)
-    print(f"ELAPSED TIME = {elapsed:.4f}s, "
-          f"THROUGHPUT = {samples / elapsed:.2f} samples/s")
+    # --timing-repeats N repeats the timed window (same compiled step, N
+    # independent measurements) so the AE runner can take a median and a
+    # spread instead of trusting one wall-clock sample
+    history = None
+    for _ in range(max(1, config.timing_repeats)):
+        start = time.perf_counter()
+        history = ff.fit(xs if len(xs) > 1 else xs[0], y, verbose=True)
+        elapsed = time.perf_counter() - start
+        samples = len(y) * config.epochs
+        # the reference's fenced benchmark print (transformer.cc:205-210)
+        print(f"ELAPSED TIME = {elapsed:.4f}s, "
+              f"THROUGHPUT = {samples / elapsed:.2f} samples/s")
     return ff, history

@@ -823,8 +823,15 @@ class FFModel:
             )
         if self.config.export_strategy_task_graph_file:
             self.export_task_graph(self.config.export_strategy_task_graph_file)
-        # parameter index for get/set weights (recompile-safe: drop stale
-        # Parameter handles from a previous compile)
+        self._index_params()
+        # context for the execution playoff (fit-time searched-vs-DP race)
+        self._compile_ctx = dict(loss_type=loss_type, mtypes=mtypes,
+                                 comp_mode=comp_mode, logits=logits)
+        self._playoff_done = False
+
+    def _index_params(self) -> None:
+        """Parameter index for get/set weights (recompile-safe: drop stale
+        Parameter handles from a previous compile)."""
         self._param_index.clear()
         for op in self.compiled.ops:
             op.layer.weights.clear()
@@ -932,7 +939,8 @@ class FFModel:
                 )
                 if pipe > 1:
                     result = _pipe_adjusted(result, self.layers, pipe,
-                                            machine, cfg.batch_size)
+                                            machine, cfg.batch_size,
+                                            fused=cfg.perform_fusion)
             else:
                 # structural variants compete on the pinned mesh too
                 from ..search.graph_xfer import graph_variants
@@ -983,6 +991,33 @@ class FFModel:
                     raise RuntimeError(
                         "no feasible strategy on the pinned mesh"
                     ) from first_err
+                # adoption margin on the pinned mesh too: sharding over
+                # the pinned axes must beat leaving them idle (pure DP)
+                # by more than the cost model's error bar
+                from ..search.unity import (_is_sharded_result,
+                                            adoption_margin, graph_optimize)
+
+                if _is_sharded_result(result):
+                    try:
+                        dp_r = graph_optimize(
+                            self.layers, input_pshapes, axis_sizes, sim,
+                            cfg, beam, memory_cap=cap, dp_only=True)
+                        if pipe > 1:
+                            dp_r = _pipe_adjusted(dp_r, self.layers, pipe,
+                                                  machine, cfg.batch_size,
+                                                  fused=cfg.perform_fusion)
+                        # the memory-aware search's budget binds the DP
+                        # fallback too: never demote to a plan that
+                        # replicates weights past the user's threshold
+                        if (cfg.perform_memory_search and dp_r.est_memory
+                                > _memory_budget(cfg, machine) * pipe):
+                            dp_r = None
+                    except RuntimeError:
+                        dp_r = None
+                    if (dp_r is not None and result.est_step_time
+                            * adoption_margin(cfg, machine)
+                            > dp_r.est_step_time):
+                        result = dp_r
         else:
             machine = make_machine()
             result = full_search(
@@ -1006,6 +1041,140 @@ class FFModel:
             self._search_strategies = dict(result.strategies)
             self.export_strategy(self.config.export_strategy_file)
         return result.strategies, mesh
+
+    # ---- execution playoff (reference: the search grounds its rankings in
+    # measured kernel costs, Op::inner_measure_operator_cost model.cu:17-53;
+    # here: race the searched compile against a plain data-parallel compile
+    # for a few REAL steps on the first fit batch and keep the winner) ----- #
+    def _time_compiled(self, cm, pipelined, xs, y_arr, bs, steps) -> float:
+        """Time ``steps`` real train steps WITHOUT perturbing training
+        state: the functional path runs on copies (the jitted step donates
+        its param/opt-state buffers, so originals must not be passed);
+        the pipelined path mutates its stage state and is restored from
+        the paired CompiledModel afterwards."""
+        import time as _time
+
+        batch = [jax.device_put(np.asarray(a[:bs]), sh)
+                 for a, sh in zip(xs, cm.input_shardings)]
+        yb = np.asarray(y_arr[:bs])
+        if cm.loss_type is LossType.SPARSE_CATEGORICAL_CROSSENTROPY:
+            yb = yb.reshape(yb.shape[0], -1).astype(np.int32)
+        label = jax.device_put(yb, cm.label_sharding)
+        p = s = None
+        if pipelined is None:
+            p = jax.tree.map(lambda a: a.copy(), cm.params)
+            s = jax.tree.map(lambda a: a.copy(), cm.opt_state)
+
+        def one(i):
+            nonlocal p, s
+            rng = jax.random.fold_in(
+                jax.random.key(self.config.seed), 1 << 20 | i)
+            if pipelined is not None:
+                out = pipelined.train_step(rng, batch, label)
+            else:
+                p, s, out, _ = cm.train_step(
+                    p, s, rng, *batch, label,
+                    seq_length=self.iter_config.seq_length)
+            jax.block_until_ready(out)
+
+        one(0)  # warmup: XLA compile outside the timed region
+        t0 = _time.perf_counter()
+        for i in range(steps):
+            one(i + 1)
+        elapsed = (_time.perf_counter() - t0) / steps
+        if pipelined is not None:
+            # undo the timing steps: cm still holds the pre-playoff state
+            pipelined.sync_from(cm)
+        return elapsed
+
+    def _maybe_playoff(self, xs, y_arr, bs) -> None:
+        cfg = self.config
+        steps = getattr(cfg, "playoff_steps", 0)
+        if steps <= 0 or getattr(self, "_playoff_done", True):
+            return
+        from ..core.machine import mesh_axis_sizes
+
+        nontrivial = (
+            any(v for v in self._search_strategies.values())
+            or self._search_layers is not None
+            or self.pipelined is not None
+            or any(a != "data" and s > 1 for a, s in
+                   mesh_axis_sizes(self.compiled.mesh).items())
+        )
+        if not nontrivial:
+            self._playoff_done = True  # plain DP: nothing to ever race
+            return
+        if len(y_arr) < bs:
+            return  # too little data THIS call; retry on the next fit
+        self._playoff_done = True
+        import dataclasses as _dc
+
+        from .compiler import compile_model
+
+        try:
+            t_searched = self._time_compiled(
+                self.compiled, self.pipelined, xs, y_arr, bs, steps)
+            dp_cfg = _dc.replace(cfg, only_data_parallel=True,
+                                 mesh_shape=None, playoff_steps=0)
+            ctx = self._compile_ctx
+            # SAME layer list the searched compile used (incl. a winning
+            # structural rewrite): op/weight names then match 1:1, so the
+            # current weights — possibly user-loaded via set_weights /
+            # the HF importer — carry over to the DP candidate
+            layers = self._search_layers or self.layers
+            if cfg.perform_fusion:
+                from ..ops.fused import apply_fusion
+
+                layers = apply_fusion(list(layers),
+                                      {ctx["logits"].tensor_id})
+            dp_cm = compile_model(
+                dp_cfg, layers, self._used_inputs(), ctx["logits"],
+                self.optimizer, ctx["loss_type"], ctx["mtypes"],
+                strategies={}, mesh=None, comp_mode=ctx["comp_mode"])
+            src_params = self.compiled.params
+            for opn, ws in dp_cm.params.items():
+                for w in ws:
+                    sv = src_params.get(opn, {}).get(w)
+                    if sv is not None and tuple(sv.shape) == tuple(ws[w].shape):
+                        ws[w] = jax.device_put(
+                            np.asarray(sv), dp_cm.param_shardings[opn][w])
+            # optimizer state too (momentum from a checkpoint restore must
+            # survive the swap); tree structures match because the graph
+            # and optimizer are identical — only shardings differ
+            from jax.sharding import NamedSharding
+
+            def _move_leaf(sv, dv):
+                if tuple(np.shape(sv)) != tuple(np.shape(dv)):
+                    return dv
+                if isinstance(getattr(dv, "sharding", None), NamedSharding):
+                    return jax.device_put(np.asarray(sv), dv.sharding)
+                # scalar counters (Adam's t) live uncommitted; a committed
+                # copy would pin them to one device and break the SPMD step
+                return np.asarray(sv)
+
+            sl, st = jax.tree_util.tree_flatten(self.compiled.opt_state)
+            dl, dt = jax.tree_util.tree_flatten(dp_cm.opt_state)
+            if st == dt:
+                dp_cm.opt_state = jax.tree_util.tree_unflatten(
+                    dt, [_move_leaf(sv, dv) for sv, dv in zip(sl, dl)])
+            t_dp = self._time_compiled(dp_cm, None, xs, y_arr, bs, steps)
+        except Exception as e:  # a playoff failure must never kill training
+            print(f"[playoff] skipped: {type(e).__name__}: {e}", flush=True)
+            return
+        if cfg.profiling:
+            print(f"[playoff] searched {t_searched*1e3:.2f}ms/step vs "
+                  f"dp {t_dp*1e3:.2f}ms/step -> "
+                  f"{'dp' if t_dp < t_searched else 'searched'}", flush=True)
+        if t_dp < t_searched:
+            # measured loser is discarded: train data-parallel. The DP
+            # candidate was compiled from the SAME (possibly rewritten)
+            # layer list, so _search_layers stays — only the sharding
+            # strategies are dropped.
+            dp_cm._iteration = self.compiled._iteration
+            self.compiled = dp_cm
+            self.pipelined = None
+            self._search_strategies = {}
+            self._index_params()
 
     def _used_inputs(self) -> List[Tensor]:
         used = set()
@@ -1055,8 +1224,12 @@ class FFModel:
             raise ValueError("TrainingGuard does not support pipelined "
                              "models yet (stage state lives off the "
                              "CompiledModel)")
-        cm = self.compiled
         xs = x if isinstance(x, (list, tuple)) else [x]
+        if (getattr(self.config, "playoff_steps", 0) > 0
+                and not getattr(self, "_playoff_done", True)):
+            self._maybe_playoff([np.asarray(a) for a in xs], np.asarray(y),
+                                batch_size or self.config.batch_size)
+        cm = self.compiled
         epochs = epochs or self.config.epochs
         bs = batch_size or self.config.batch_size
         if self.pipelined is not None:

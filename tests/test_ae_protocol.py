@@ -1,32 +1,64 @@
 """OSDI'22 AE protocol artifact gate (reference: scripts/osdi22ae/*.sh —
 searched strategy vs --only-data-parallel throughput ratios).
 
-AE_r03.json is produced by `python scripts/osdi_ae/run_ae.py --devices 8
---output AE_r03.json` on the virtual 8-device CPU mesh. On that platform
-the honest machine model (shared-host: no compute credit for sharding,
-serialized collectives) mostly concludes parallelism doesn't pay, so the
-gate is parity — the searched strategy must not LOSE to data parallelism.
-Real speedups require real chips (tests_tpu/ + BENCH artifacts)."""
+AE_r{N}.json is produced by `python scripts/osdi_ae/run_ae.py --devices 8
+--output AE_r{N}.json` on the virtual 8-device CPU mesh. The searched
+leg runs with an execution playoff (searched-vs-DP raced for real steps,
+winner kept), so BASELINE.md's success criterion — searched never loses
+to data parallelism — must hold on EVERY config up to run-to-run noise:
+a config may be a "win" or, when the ratio sits inside the measured
+spread, "no_difference"; a "loss" fails the gate. Real speedups beyond
+parity require real chips (tests_tpu/ + BENCH artifacts).
+"""
 
+import glob
 import json
 import os
 
 import pytest
 
-ARTIFACT = os.path.join(os.path.dirname(os.path.dirname(__file__)),
-                        "AE_r03.json")
+ROOT = os.path.dirname(os.path.dirname(__file__))
+
+# every reference AE workload (scripts/osdi22ae/*.sh), CNNs included
+ALL_CONFIGS = {"mlp", "dlrm", "xdl", "bert", "moe",
+               "alexnet", "inception", "resnext", "candle_uno"}
+
+
+def _latest_artifact():
+    arts = sorted(glob.glob(os.path.join(ROOT, "AE_r*.json")))
+    return arts[-1] if arts else None
 
 
 def test_ae_artifact_gate():
-    if not os.path.exists(ARTIFACT):
+    art = _latest_artifact()
+    if art is None:
         pytest.skip("AE artifact not recorded in this checkout")
-    with open(ARTIFACT) as f:
+    with open(art) as f:
         doc = json.load(f)
     results = doc["results"]
-    assert set(results) == {"mlp", "dlrm", "xdl", "bert", "moe"}
-    speedups = {k: v.get("speedup") for k, v in results.items()}
-    errors = [k for k, s in speedups.items() if s is None]
+    if os.path.basename(art) <= "AE_r03.json":
+        pytest.skip("pre-r4 artifact: no spread/verdict fields recorded")
+    assert set(results) == ALL_CONFIGS, (
+        f"AE must cover every reference config; missing "
+        f"{ALL_CONFIGS - set(results)}")
+    errors = [k for k, v in results.items() if "speedup" not in v]
     assert not errors, f"configs failed to run: {errors}"
-    passing = [k for k, s in speedups.items() if s >= 0.95]
-    assert len(passing) >= 4, (
-        f"searched < 0.95x DP on too many configs: {speedups}")
+    losses = {k: (v["speedup"], v["spread_rel"])
+              for k, v in results.items() if v["verdict"] == "loss"}
+    assert not losses, (
+        f"searched strategy LOSES to data-parallel beyond measurement "
+        f"noise on: {losses} — the playoff must keep the DP winner")
+
+
+def test_ae_artifact_records_spread():
+    art = _latest_artifact()
+    if art is None or os.path.basename(art) <= "AE_r03.json":
+        pytest.skip("no r4+ artifact")
+    with open(art) as f:
+        doc = json.load(f)
+    assert int(doc.get("repeats", 1)) >= 3
+    for k, v in doc["results"].items():
+        if "speedup" not in v:
+            continue
+        assert len(v["searched_runs"]) >= 3 and len(v["dp_runs"]) >= 3, k
+        assert v["verdict"] in ("win", "no_difference", "loss"), k
