@@ -876,14 +876,14 @@ class FFModel:
             with open(cfg.substitution_json_path) as f:
                 peek = _json.load(f)
             if "rule" in peek:
-                from ..search.graph_xfer import (load_graphxfer_rules,
-                                                 rules_to_rewrites)
+                from ..search.graph_xfer import load_graphxfer_rules
+                from ..search.rule_interpreter import interpret_rules
 
                 coll = load_graphxfer_rules(peek)  # already parsed
-                cfg._graphxfer_rewrites = rules_to_rewrites(coll)
+                cfg._graphxfer_rewrites, xfer_report = interpret_rules(coll)
                 if cfg.profiling:
-                    print(f"[search] graphxfer rules: {coll.counts()} -> "
-                          f"{[r.name for r in cfg._graphxfer_rewrites]}",
+                    print(f"[search] graphxfer rules: {xfer_report} -> "
+                          f"{len(cfg._graphxfer_rewrites)} rewrites",
                           flush=True)
             else:
                 from ..search.substitution import load_substitution_rules
@@ -1002,16 +1002,19 @@ class FFModel:
                         dp_r = graph_optimize(
                             self.layers, input_pshapes, axis_sizes, sim,
                             cfg, beam, memory_cap=cap, dp_only=True)
-                        if pipe > 1:
-                            dp_r = _pipe_adjusted(dp_r, self.layers, pipe,
-                                                  machine, cfg.batch_size,
-                                                  fused=cfg.perform_fusion)
                         # the memory-aware search's budget binds the DP
                         # fallback too: never demote to a plan that
-                        # replicates weights past the user's threshold
+                        # replicates weights past the user's threshold.
+                        # Checked on the PRE-pipe-adjusted (whole-model)
+                        # footprint against budget*pipe, the same
+                        # convention memory_aware_search uses above.
                         if (cfg.perform_memory_search and dp_r.est_memory
                                 > _memory_budget(cfg, machine) * pipe):
                             dp_r = None
+                        elif pipe > 1:
+                            dp_r = _pipe_adjusted(dp_r, self.layers, pipe,
+                                                  machine, cfg.batch_size,
+                                                  fused=cfg.perform_fusion)
                     except RuntimeError:
                         dp_r = None
                     if (dp_r is not None and result.est_step_time
@@ -1054,12 +1057,10 @@ class FFModel:
         the paired CompiledModel afterwards."""
         import time as _time
 
-        batch = [jax.device_put(np.asarray(a[:bs]), sh)
-                 for a, sh in zip(xs, cm.input_shardings)]
+        xs_np = [np.asarray(a[:bs]) for a in xs]
         yb = np.asarray(y_arr[:bs])
         if cm.loss_type is LossType.SPARSE_CATEGORICAL_CROSSENTROPY:
             yb = yb.reshape(yb.shape[0], -1).astype(np.int32)
-        label = jax.device_put(yb, cm.label_sharding)
         p = s = None
         if pipelined is None:
             p = jax.tree.map(lambda a: a.copy(), cm.params)
@@ -1067,6 +1068,13 @@ class FFModel:
 
         def one(i):
             nonlocal p, s
+            # host->device placement is INSIDE the timed region: the fit
+            # loop pays it per batch, and it differs materially between
+            # strategies (batch-sharded inputs move 1/n per device,
+            # replicated inputs move n full copies)
+            batch = [jax.device_put(a, sh)
+                     for a, sh in zip(xs_np, cm.input_shardings)]
+            label = jax.device_put(yb, cm.label_sharding)
             rng = jax.random.fold_in(
                 jax.random.key(self.config.seed), 1 << 20 | i)
             if pipelined is not None:

@@ -7,20 +7,28 @@ linear+relu merge, combine-concat / inception rewrites — and the 640-rule
 JSON library substitutions/graph_subst_3_v2.json loaded by
 src/runtime/substitution_loader.cc:78).
 
-Translation, not a port. The reference's xfer library mixes two kinds of
-rules:
+Translation, not a port. The reference's xfer library mixes several kinds
+of rules (measured taxonomy — per-rule counts pinned by
+tests/test_rule_interpreter.py over the real 640-rule library):
 
-* **Resharding motion** (partition/combine/replicate/reduction placement —
-  e.g. ``create_combine_concat`` moves a Combine below a Concat, TASO rules
-  commute OP_PARTITION past elementwise ops). Under GSPMD these collectives
-  are *derived from sharding specs*, and XLA's sharding propagation already
-  places them optimally across elementwise/concat boundaries — the rule
-  class is subsumed by the compiler and costed via sharding transitions in
-  the simulator (sim/simulator.py). The loader below recognizes and counts
-  these instead of replaying them.
-* **Structural rewrites** that change the compute graph itself. These are
-  real search moves on TPU too, and are implemented here as
-  :class:`GraphRewrite` passes whose outputs COMPETE in the same frontier
+* **Resharding / sharding-motion / parallel-decomposition rules** (~3/4 of
+  the library): partition/combine/replicate/reduce placement and motion
+  past compute ops, and tensor-parallel decompositions (replicate →
+  split-matmul → partial-sum reduce). Under GSPMD these collectives are
+  *derived from sharding specs* — XLA's sharding propagation places them,
+  and the search prices the decompositions as per-layer sharding
+  candidates (search/substitution.py) and sharding transitions
+  (sim/simulator.py). Notably the reference itself activates almost none
+  of these as xfers: its ``create_xfers`` keeps only single-src-op rules
+  (substitution.cc:1666-1706) — 3 of 640 — and draws its real moves from
+  programmatic generators (substitution.cc:1786-1860).
+* **Compute rewrites** that change the compute graph itself (~112 rules).
+  These are real search moves on TPU too. The generic interpreter
+  (:mod:`.rule_interpreter`) matches their src graphlets against the
+  layer graph and instantiates the dst graphlets as
+  :class:`GraphRewrite` passes; the hand-written classes below cover the
+  highest-value families natively (plus Conv2D, which the 3-dim matmul
+  library does not express). All of them COMPETE in the same frontier
   DP as the original graph (search/unity.py):
 
   - :class:`LinearActivationFusion` — ``linear → relu/sigmoid/tanh/gelu``
@@ -318,13 +326,9 @@ def graph_variants(
 
     variants: List[Tuple[List[str], List[Layer]]] = [([], layers)]
     seen = {sig(layers)}
-    for rw in rewrites:
-        nl = rw.apply_all(list(layers), protected)
-        if sig(nl) not in seen:
-            seen.add(sig(nl))
-            variants.append(([rw.name], nl))
     # composed fixpoint over all kinds (e.g. merge parallel linears, then
-    # fuse the following activation into the merged GEMM)
+    # fuse the following activation into the merged GEMM) goes FIRST so a
+    # large interpreted-rule set cannot push it past the variant cap
     cur, applied = list(layers), []
     for _ in range(4):
         before = sig(cur)
@@ -338,6 +342,13 @@ def graph_variants(
     if sig(cur) not in seen:
         seen.add(sig(cur))
         variants.append((applied, cur))
+    for rw in rewrites:
+        if len(variants) >= max_variants:
+            break
+        nl = rw.apply_all(list(layers), protected)
+        if sig(nl) not in seen:
+            seen.add(sig(nl))
+            variants.append(([rw.name], nl))
     return variants[:max_variants]
 
 
@@ -451,28 +462,14 @@ def load_graphxfer_rules(path_or_data) -> RuleCollection:
 
 
 def rules_to_rewrites(collection: RuleCollection) -> List[GraphRewrite]:
-    """Map recognized structural rule shapes onto the built-in rewrite
-    kinds (the reference builds a GraphXfer per rule; here rule shapes that
-    express linear/conv merge moves activate the equivalent rewrite pass —
-    a documented translation, substitution.cc:596 semantics preserved)."""
-    out: Dict[str, GraphRewrite] = {}
-    for r in collection.rules:
-        if r.kind != "structural":
-            continue
-        src_types = [o.type for o in r.src_ops]
-        dst_types = [o.type for o in r.dst_ops]
-        compute_src = [t for t in src_types if t not in RESHARDING_OPS]
-        compute_dst = [t for t in dst_types if t not in RESHARDING_OPS]
-        if (sorted(compute_src) == ["OP_LINEAR", "OP_RELU"]
-                and compute_dst == ["OP_LINEAR"]):
-            out.setdefault("linear_activation_fusion",
-                           LinearActivationFusion())
-        elif ("OP_CONCAT" in compute_src
-              and compute_src.count("OP_LINEAR") >= 2
-              and compute_dst.count("OP_LINEAR") == 1):
-            out.setdefault("parallel_linear_merge", ParallelLinearMerge())
-        elif ("OP_CONCAT" in compute_src
-              and compute_src.count("OP_CONV2D") >= 2
-              and compute_dst.count("OP_CONV2D") == 1):
-            out.setdefault("parallel_conv_merge", ParallelConvMerge())
-    return list(out.values())
+    """Subsumed by the generic interpreter: every rule is normalized to
+    activation-dataflow graphlets and compute rewrites are instantiated
+    as generic :class:`~.rule_interpreter.JsonRuleRewrite` passes (the
+    reference builds a GraphXfer per rule, substitution.cc:596 — though
+    its own ``create_xfers`` filter keeps only 3 of the 640,
+    substitution.cc:1666-1706). Kept as the stable entry point; see
+    :func:`~.rule_interpreter.interpret_rules` for the audit report."""
+    from .rule_interpreter import interpret_rules
+
+    rewrites, _ = interpret_rules(collection)
+    return rewrites

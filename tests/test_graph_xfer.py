@@ -192,7 +192,7 @@ def test_reference_rule_schema_roundtrip(tmp_path):
                 "dstOp": [
                     {"type": "OP_LINEAR",
                      "input": [{"opId": -1, "tsId": 0}],
-                     "para": [{"key": "PM_ACTI", "value": 1}]},
+                     "para": [{"key": "PM_ACTI", "value": 2}]},
                 ],
                 "mappedOutput": [
                     {"srcOpId": 1, "srcTsId": 0, "dstOpId": 0, "dstTsId": 0}
@@ -231,8 +231,12 @@ def test_reference_rule_schema_roundtrip(tmp_path):
     coll = load_graphxfer_rules(str(p))
     assert coll.counts() == {"resharding": 1, "structural": 1,
                              "unsupported": 1}
+    # the generic interpreter (rule_interpreter.py) instantiates the
+    # linear+relu merge as a JSON-driven rewrite; motion/unsupported
+    # rules produce none
     rewrites = rules_to_rewrites(coll)
-    assert [r.name for r in rewrites] == ["linear_activation_fusion"]
+    assert [r.name for r in rewrites] == ["json:linear_relu_merge"]
+    assert rewrites[0].rule_names == ["linear_relu_merge"]
 
 
 @pytest.mark.skipif(not os.path.exists(REF_RULES),
@@ -263,7 +267,7 @@ def test_substitution_json_path_reference_schema(tmp_path):
             ],
             "dstOp": [
                 {"type": "OP_LINEAR", "input": [{"opId": -1, "tsId": 0}],
-                 "para": [{"key": "PM_ACTI", "value": 1}]},
+                 "para": [{"key": "PM_ACTI", "value": 2}]},
             ],
             "mappedOutput": [
                 {"srcOpId": 1, "srcTsId": 0, "dstOpId": 0, "dstTsId": 0}
