@@ -1169,10 +1169,11 @@ class FFModel:
         except Exception as e:  # a playoff failure must never kill training
             print(f"[playoff] skipped: {type(e).__name__}: {e}", flush=True)
             return
-        if cfg.profiling:
-            print(f"[playoff] searched {t_searched*1e3:.2f}ms/step vs "
-                  f"dp {t_dp*1e3:.2f}ms/step -> "
-                  f"{'dp' if t_dp < t_searched else 'searched'}", flush=True)
+        # always printed: the measured decision is part of the training
+        # record (the AE runner parses it into the artifact)
+        print(f"[playoff] searched {t_searched*1e3:.2f}ms/step vs "
+              f"dp {t_dp*1e3:.2f}ms/step -> "
+              f"{'dp' if t_dp < t_searched else 'searched'}", flush=True)
         if t_dp < t_searched:
             # measured loser is discarded: train data-parallel. The DP
             # candidate was compiled from the SAME (possibly rewritten)

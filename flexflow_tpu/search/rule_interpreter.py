@@ -432,22 +432,38 @@ class JsonRuleRewrite(GraphRewrite):
 
     def apply_all(self, layers: List[Layer],
                   protected: frozenset = frozenset()) -> List[Layer]:
-        """Unlike the built-in rewrites, a found site can still be
-        REJECTED at instantiation (width solve / shape verification): try
-        sites in order each round instead of stalling on sites[0]."""
+        """find() returns de-overlapped (layer-disjoint) sites, so ALL
+        accepted sites of one pass splice together before re-matching —
+        one isomorphism search per fixpoint round, not per site. A site
+        can still be REJECTED at instantiation (width solve / shape
+        verification); rejected sites are simply skipped."""
         for _ in range(len(layers) + 1):
-            nl = layers
-            for site in self.find(layers, protected):
-                nl = self.apply(layers, site)
-                if nl is not layers:
-                    break
-            if nl is layers:
+            sites = self.find(layers, protected)
+            splices = []  # (min_idx, drop_set, new_layers)
+            for site in sites:
+                sp = self._materialize(layers, site)
+                if sp is not None:
+                    splices.append(sp)
+            if not splices:
                 break
-            layers = nl
+            drop_all = set()
+            insert_at: Dict[int, List[Layer]] = {}
+            for first, drop, new_layers in splices:
+                drop_all |= drop
+                insert_at[first] = new_layers
+            out: List[Layer] = []
+            for i, l in enumerate(layers):
+                if i in insert_at:
+                    out.extend(insert_at[i])
+                if i not in drop_all:
+                    out.append(l)
+            layers = _stable_toposort(out)
         return layers
 
     # ---- instantiation ---- #
-    def apply(self, layers: List[Layer], site: Tuple) -> List[Layer]:
+    def _materialize(self, layers: List[Layer], site: Tuple):
+        """Build one site's replacement. Returns (first_idx, dropped
+        indices, new layers) or None when the site is rejected."""
         amap = dict(site)
         ext: Dict[int, "object"] = {}
         for pi, li in amap.items():
@@ -455,21 +471,23 @@ class JsonRuleRewrite(GraphRewrite):
             for ref, t in zip(node.inputs, layer.inputs):
                 if ref[0] == "ext":
                     ext[ref[1]] = t
-        # shapes of externals and src mapped outputs
-        def dims_of(t):
-            return tuple(t.dims)
-
         src_out_tensors = [layers[amap[ni]].outputs[ts]
                            for ni, ts in self.src.outputs]
-        widths = self._solve_widths(ext, [dims_of(t) for t in src_out_tensors])
+        widths = self._solve_widths(
+            ext, [tuple(t.dims) for t in src_out_tensors])
         if widths is None:
-            return layers  # underdetermined: reject the site
+            return None  # underdetermined: reject the site
         new_layers = self._build_dst(ext, widths, amap, layers,
                                      src_out_tensors)
         if new_layers is None:
+            return None
+        return min(amap.values()), set(amap.values()), new_layers
+
+    def apply(self, layers: List[Layer], site: Tuple) -> List[Layer]:
+        sp = self._materialize(layers, site)
+        if sp is None:
             return layers
-        drop = set(amap.values())
-        first = min(amap.values())
+        first, drop, new_layers = sp
         out: List[Layer] = []
         for i, l in enumerate(layers):
             if i == first:
@@ -662,11 +680,23 @@ def interpret_rules(collection: RuleCollection):
         "compute_rewrite": 0, "uninterpretable": 0, "kept_by_reference": 0,
     }
     groups: Dict[Tuple, JsonRuleRewrite] = {}
+    conv_merge = None
     for r in collection.rules:
         if len(r.src_ops) == 1 and len(r.dst_ops) > 1:
             report["kept_by_reference"] += 1
         cls, src, dst = classify_rule(r)
         report[cls] += 1
+        if cls == "uninterpretable" and conv_merge is None:
+            # Conv2D is outside the activation-graphlet op set (the 3-dim
+            # matmul library never uses it), but user rule files in the
+            # conv-merge shape keep activating the native rewrite
+            src_t = [o.type for o in r.src_ops]
+            dst_t = [o.type for o in r.dst_ops]
+            if ("OP_CONCAT" in src_t and src_t.count("OP_CONV2D") >= 2
+                    and dst_t.count("OP_CONV2D") == 1):
+                from .graph_xfer import ParallelConvMerge
+
+                conv_merge = ParallelConvMerge()
         if cls != "compute_rewrite":
             continue
         key = (src.signature(), dst.signature())
@@ -675,5 +705,7 @@ def interpret_rules(collection: RuleCollection):
         else:
             groups[key] = JsonRuleRewrite([r.name], src, dst)
     rewrites = list(groups.values())
+    if conv_merge is not None:
+        rewrites.append(conv_merge)
     report["distinct_rewrites"] = len(rewrites)
     return rewrites, report

@@ -109,6 +109,14 @@ def _hf_trace_patches(model, batch_size: int, seq_length: int):
                 # checkpoint would import silently wrong
                 raise ValueError(
                     "scale_attn_weights=False import unsupported")
+            if head_mask is not None:
+                raise ValueError("GPT-2 head_mask import is unsupported")
+            if self.training and getattr(self.attn_dropout, "p", 0.0) > 0:
+                # SDPA below runs with dropout_p=0: a checkpoint with
+                # attn_pdrop>0 imported for FINETUNING would silently
+                # diverge from torch (inference is exact either way)
+                raise ValueError(
+                    "attn_pdrop>0 in training mode import unsupported")
             q, k, v = self.c_attn(hidden_states).split(self.split_size,
                                                        dim=2)
             H, D = self.num_heads, self.head_dim

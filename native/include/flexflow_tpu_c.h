@@ -14,6 +14,14 @@
  *     (reference: src/dataloader/dataloader.cc SingleDataLoader)
  *
  * All functions are exported with C linkage for ctypes.
+ *
+ * MODEL-BUILDING SURFACE (libflexflow_tpu_capi.so): the reference's
+ * flat model API (flexflow_c.h:80-706 — model_create / create_tensor /
+ * dense / conv2d / compile / fit / eval / forward / get_weight) for
+ * non-Python hosts, backed by the embedded CPython runtime
+ * (native/src/model_capi.cc). Enum int arguments keep the reference's
+ * ffconst values (AC_MODE_NONE=10.., POOL_MAX=30.., LOSS_*=50..). Set
+ * PYTHONPATH so flexflow_tpu imports before fftpu_runtime_init().
  */
 
 #ifndef FLEXFLOW_TPU_C_H
@@ -133,6 +141,79 @@ void fftpu_batcher_submit(fftpu_batcher *, int64_t id);
 void fftpu_batcher_close(fftpu_batcher *);
 int64_t fftpu_batcher_pending(fftpu_batcher *);
 int64_t fftpu_batcher_next(fftpu_batcher *, int64_t *out_ids);
+
+/* ----------------------------------------------- model building & training
+ * (libflexflow_tpu_capi.so; reference: flexflow_c.h:80-706.) Opaque
+ * handles own interpreter references; NULL / -1 returns signal failure —
+ * read fftpu_last_error() for the message. */
+
+typedef void *fftpu_model;
+typedef void *fftpu_tensor;
+
+int fftpu_runtime_init(void);
+void fftpu_runtime_finalize(void);
+const char *fftpu_last_error(void);
+
+fftpu_model fftpu_model_create(int32_t batch_size, int32_t epochs,
+                               int32_t num_devices,
+                               int32_t only_data_parallel,
+                               int32_t search_budget);
+void fftpu_model_destroy(fftpu_model);
+void fftpu_tensor_destroy(fftpu_tensor);
+
+/* dtype: DataType ffconst value (0 => float32). */
+fftpu_tensor fftpu_model_create_tensor(fftpu_model, int32_t ndim,
+                                       const int64_t *dims, int32_t dtype);
+/* activation: AC_MODE_* (10=none, 11=relu, 12=sigmoid, 13=tanh, 14=gelu) */
+fftpu_tensor fftpu_model_dense(fftpu_model, fftpu_tensor, int32_t out_dim,
+                               int32_t activation, int32_t use_bias);
+fftpu_tensor fftpu_model_conv2d(fftpu_model, fftpu_tensor,
+                                int32_t out_channels, int32_t kh, int32_t kw,
+                                int32_t sh, int32_t sw, int32_t ph,
+                                int32_t pw, int32_t activation,
+                                int32_t groups, int32_t use_bias);
+/* pool_type: POOL_MAX=30, POOL_AVG=31 */
+fftpu_tensor fftpu_model_pool2d(fftpu_model, fftpu_tensor, int32_t kh,
+                                int32_t kw, int32_t sh, int32_t sw,
+                                int32_t ph, int32_t pw, int32_t pool_type,
+                                int32_t activation);
+fftpu_tensor fftpu_model_relu(fftpu_model, fftpu_tensor);
+fftpu_tensor fftpu_model_sigmoid(fftpu_model, fftpu_tensor);
+fftpu_tensor fftpu_model_tanh(fftpu_model, fftpu_tensor);
+fftpu_tensor fftpu_model_gelu(fftpu_model, fftpu_tensor);
+fftpu_tensor fftpu_model_flat(fftpu_model, fftpu_tensor);
+fftpu_tensor fftpu_model_softmax(fftpu_model, fftpu_tensor, int32_t axis);
+fftpu_tensor fftpu_model_concat(fftpu_model, int32_t n,
+                                const fftpu_tensor *ts, int32_t axis);
+fftpu_tensor fftpu_model_embedding(fftpu_model, fftpu_tensor,
+                                   int32_t num_entries, int32_t out_dim);
+int fftpu_tensor_ndim(fftpu_tensor, int64_t *dims_out, int32_t max_ndim);
+
+/* optimizer: "sgd" | "adam"; loss: "sparse_categorical_crossentropy" |
+ * "categorical_crossentropy" | "mean_squared_error"; metrics_csv e.g.
+ * "accuracy,sparse_categorical_crossentropy" (may be empty). */
+int fftpu_model_compile(fftpu_model, const char *optimizer, double lr,
+                        const char *loss, const char *metrics_csv);
+
+/* x inputs are float32 row-major buffers; y is float32 or int32
+ * (y_is_int). Blocking; returns 0 on success. */
+int fftpu_model_fit(fftpu_model, int32_t n_inputs,
+                    const float *const *xs, const int64_t *const *xdims,
+                    const int32_t *xndims, const void *y,
+                    const int64_t *ydims, int32_t yndim, int32_t y_is_int,
+                    int32_t epochs);
+int fftpu_model_eval(fftpu_model, int32_t n_inputs,
+                     const float *const *xs, const int64_t *const *xdims,
+                     const int32_t *xndims, const void *y,
+                     const int64_t *ydims, int32_t yndim, int32_t y_is_int,
+                     double *accuracy_out, double *loss_out);
+int fftpu_model_forward(fftpu_model, int32_t n_inputs,
+                        const float *const *xs, const int64_t *const *xdims,
+                        const int32_t *xndims, float *logits_out,
+                        int64_t logits_numel);
+int fftpu_model_get_weight(fftpu_model, const char *op_name,
+                           const char *weight_name, float *out,
+                           int64_t out_numel);
 
 #ifdef __cplusplus
 } /* extern "C" */

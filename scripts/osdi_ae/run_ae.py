@@ -76,13 +76,14 @@ def _env(devices: int):
 
 
 def run_one(script: str, extra, epochs, batch, devices=0,
-            repeats=1) -> list:
-    """Run one leg; returns the list of measured throughputs (one per
-    timed window — ``--timing-repeats`` windows in one process). The
-    first window is consistently cold (first full-epoch pass: cache
-    warm-in on top of the example's one-batch warmup fit), so when
-    several windows are requested one EXTRA is run and the first
-    discarded — both legs equally."""
+            repeats=1) -> tuple:
+    """Run one leg; returns ``(throughputs, playoff_kept)``: the list of
+    measured throughputs (one per timed window — ``--timing-repeats``
+    windows in one process) and which strategy the in-process playoff
+    kept ("searched"/"dp"/None). The first window is consistently cold
+    (first full-epoch pass: cache warm-in on top of the example's
+    one-batch warmup fit), so when several windows are requested one
+    EXTRA is run and the first discarded — both legs equally."""
     n_windows = repeats + 1 if repeats > 1 else repeats
     cmd = [sys.executable, script, "--epochs", str(epochs),
            "--batch-size", str(batch),
@@ -96,7 +97,9 @@ def run_one(script: str, extra, epochs, batch, devices=0,
             re.findall(r"THROUGHPUT = ([0-9.]+)", proc.stdout)]
     if not vals:
         raise RuntimeError(f"{script}: no THROUGHPUT line\n{proc.stdout[-800:]}")
-    return vals[1:] if len(vals) > repeats else vals
+    m = re.search(r"\[playoff\].*-> (\w+)", proc.stdout)
+    playoff = m.group(1) if m else None
+    return (vals[1:] if len(vals) > repeats else vals), playoff
 
 
 def _spread_rel(vals) -> float:
@@ -138,10 +141,11 @@ def main():
         if ns.playoff_steps:
             searched_flags += ["--playoff-steps", str(ns.playoff_steps)]
         try:
-            searched = run_one(script, searched_flags, ns.epochs,
-                               ns.batch_size, ns.devices, ns.repeats)
-            dp = run_one(script, ["--only-data-parallel"], ns.epochs,
-                         ns.batch_size, ns.devices, ns.repeats)
+            searched, playoff = run_one(script, searched_flags, ns.epochs,
+                                        ns.batch_size, ns.devices,
+                                        ns.repeats)
+            dp, _ = run_one(script, ["--only-data-parallel"], ns.epochs,
+                            ns.batch_size, ns.devices, ns.repeats)
         except RuntimeError as e:
             print(f"{c:12s} FAILED: {e}")
             results[c] = {"error": str(e)[:500]}
@@ -157,9 +161,13 @@ def main():
             "searched_throughput": s_med, "dp_throughput": d_med,
             "searched_runs": searched, "dp_runs": dp,
             "speedup": ratio, "spread_rel": spread, "verdict": verdict,
+            # which strategy the playoff kept in the searched leg (None =
+            # the search itself chose plain DP, so no race was needed)
+            "playoff_kept": playoff,
         }
         print(f"{c:12s} searched={s_med:10.2f}  dp={d_med:10.2f}  "
-              f"speedup={ratio:6.3f}x  spread={spread:5.1%}  [{verdict}]")
+              f"speedup={ratio:6.3f}x  spread={spread:5.1%}  [{verdict}]"
+              + (f" playoff->{playoff}" if playoff else ""))
     if ns.output:
         doc = {
             "protocol": "osdi22ae searched-vs-data-parallel "
