@@ -97,8 +97,12 @@ def run_one(script: str, extra, epochs, batch, devices=0,
             re.findall(r"THROUGHPUT = ([0-9.]+)", proc.stdout)]
     if not vals:
         raise RuntimeError(f"{script}: no THROUGHPUT line\n{proc.stdout[-800:]}")
-    m = re.search(r"\[playoff\].*-> (\w+)", proc.stdout)
-    playoff = m.group(1) if m else None
+    m = re.search(r"\[playoff\] searched ([0-9.]+)ms/step vs "
+                  r"dp ([0-9.]+)ms/step -> (\w+)", proc.stdout)
+    playoff = None
+    if m:
+        playoff = {"searched_ms": float(m.group(1)),
+                   "dp_ms": float(m.group(2)), "kept": m.group(3)}
     return (vals[1:] if len(vals) > repeats else vals), playoff
 
 
@@ -161,13 +165,15 @@ def main():
             "searched_throughput": s_med, "dp_throughput": d_med,
             "searched_runs": searched, "dp_runs": dp,
             "speedup": ratio, "spread_rel": spread, "verdict": verdict,
-            # which strategy the playoff kept in the searched leg (None =
+            # the in-process playoff record from the searched leg: the
+            # measured per-step times of the searched plan vs plain DP
+            # under identical conditions, and which one was kept (None =
             # the search itself chose plain DP, so no race was needed)
-            "playoff_kept": playoff,
+            "playoff": playoff,
         }
         print(f"{c:12s} searched={s_med:10.2f}  dp={d_med:10.2f}  "
               f"speedup={ratio:6.3f}x  spread={spread:5.1%}  [{verdict}]"
-              + (f" playoff->{playoff}" if playoff else ""))
+              + (f" playoff->{playoff['kept']}" if playoff else ""))
     if ns.output:
         doc = {
             "protocol": "osdi22ae searched-vs-data-parallel "
