@@ -31,7 +31,9 @@ def run_example(name, build, make_data, loss_type, metrics,
     ff = FFModel(config)
     built = build(ff, config.batch_size)
     ff.compile(optimizer=optimizer, loss_type=loss_type, metrics=metrics)
-    xs, y = make_data(max(256, config.batch_size * 4), config, built)
+    n = config.bench_samples or max(256, config.batch_size * 4)
+    n = max(n, config.batch_size)
+    xs, y = make_data(n, config, built)
     if not isinstance(xs, (list, tuple)):
         xs = [xs]
 

@@ -88,6 +88,9 @@ class FFConfig:
     # times and print one THROUGHPUT line each (median/spread recorded by
     # scripts/osdi_ae/run_ae.py)
     timing_repeats: int = 1
+    # samples per timed window in the examples (0 = the default 256);
+    # the AE runner lowers it for CPU-hour-heavy CNN workloads
+    bench_samples: int = 0
     substitution_json_path: Optional[str] = None
     machine_model_file: Optional[str] = None
     export_strategy_file: Optional[str] = None
@@ -187,6 +190,8 @@ class FFConfig:
                 cfg.playoff_steps = int(_next())
             elif a == "--timing-repeats":
                 cfg.timing_repeats = int(_next())
+            elif a == "--num-samples":
+                cfg.bench_samples = int(_next())
             elif a == "--substitution-json":
                 cfg.substitution_json_path = _next()
             elif a == "--machine-model-file":

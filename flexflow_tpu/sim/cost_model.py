@@ -197,24 +197,53 @@ class OpCostModel:
         parts = 1
         for deg in axis_deg.values():
             parts *= deg
-        # shared-host virtual meshes get NO compute credit from sharding
-        # (machine_model.effective_parallelism) — real chips divide fully
-        eff = self.machine.effective_parallelism(parts)
-        flops_per_dev = total_flops / eff
-        # same honesty for memory traffic: on a real chip each device
-        # streams only its shard; on a shared host every shard's bytes
-        # funnel through one memory system (parts/eff == 1 on real chips)
-        bytes_eff = (in_bytes + out_bytes + w_bytes) * (max(parts, 1) / eff)
+        # per-device cost model: each device computes its shard
+        # (total/parts) and streams its local bytes. On a REAL mesh that
+        # per-device cost IS wall-clock (devices run in parallel). On a
+        # shared-host virtual mesh every device-program time-slices ONE
+        # socket, so wall-clock is the per-device cost times the DEVICE
+        # COUNT — which also charges redundant compute honestly when an
+        # op is replicated across an idle mesh axis (parts < n_devices):
+        # those replicas each burn the socket for the same answer.
+        ser = self.machine.serialization_factor()
+        flops_eff = total_flops / max(parts, 1) * ser
+        bytes_eff = (in_bytes + out_bytes + w_bytes) * ser
 
-        fwd = self._forward_time(op, flops_per_dev, bytes_eff)
+        fwd = self._forward_time(op, flops_eff, bytes_eff)
         if op.op_type is OpType.EMBEDDING:
             # backward is a scatter-add over ONLY the gathered rows:
             # read grad (out_bytes) + read-modify-write the touched table
             # rows (~2 * out_bytes) + indices — bytes-bound, independent
-            # of the full table size the fwd roofline charges
-            bwd = self._forward_time(op, 0.0, in_bytes + 3 * out_bytes)
+            # of the full table size the fwd roofline charges. Row
+            # gathers/scatters run below streaming speed on hosts that
+            # loop rows (machine_model.gather_inefficiency; 1.0 on chip)
+            gi = self.machine.gather_inefficiency()
+            fwd *= gi
+            # same per-device-cost x serialization convention as fwd:
+            # every shard's scatter-add bytes funnel through the socket
+            # on a shared host
+            bwd = gi * self._forward_time(
+                op, 0.0, (in_bytes + 3 * out_bytes) * ser)
         else:
             bwd = self.bwd_factor(op) * fwd
+        # shared-host reality: per-shard programs for model/seq/expert-
+        # sharded ops run slower than the roofline says (fitted against
+        # the AE playoff's measured step times; 1.0 on real chips), and
+        # TINY sharded ops are overhead-dominated — a fixed per-direction
+        # floor the roofline's microsecond estimate misses entirely
+        non_data = {a for a in axis_deg if a != "data"}
+        shard_pen = self.machine.sharded_compute_penalty(non_data)
+        fwd *= shard_pen
+        bwd *= shard_pen
+        # (embeddings are exempt: they are gather-bound with ~zero FLOPs
+        # by construction, priced by bytes above, and measured neutral
+        # under vocab sharding — the floor is for overhead-dominated
+        # tiny GEMM/elementwise shards like per-expert MoE branches)
+        if (non_data and total_flops < 1e6
+                and op.op_type is not OpType.EMBEDDING):
+            tiny = self.machine.sharded_tiny_op_latency()
+            fwd += tiny
+            bwd += tiny
 
         # gradient sync: any weight replicated across an axis must be
         # all-reduced over that axis's degree (reference: nccl_update_task
@@ -224,9 +253,27 @@ class OpCostModel:
         for ps in op.weight_shapes.values():
             sharded_axes = {d.axis for d in ps.dims if d.is_partitioned}
             wb = _pshape_local_bytes(ps)
-            for axis, deg in axis_sizes.items():
-                if deg > 1 and axis not in sharded_axes:
+            if self.machine.combine_sync_axes():
+                # shared host: ONE allreduce over the COMBINED replica
+                # degree — a weight replicated across several mesh axes
+                # has prod(deg) copies funneling through the same memory
+                # system, so pricing each axis separately undercounts
+                # (three 2-way reduces are NOT cheaper than one 8-way
+                # reduce; the per-axis sum let idle-axis meshes arbitrage
+                # their grad-sync cost)
+                deg, axis = 1, ""
+                for a, d in axis_sizes.items():
+                    if d > 1 and a not in sharded_axes:
+                        deg *= d
+                        axis = a
+                if deg > 1:
                     sync += self.machine.allreduce_time(wb, deg, axis)
+            else:
+                # real machines: per-axis pricing — each axis rides its
+                # own fabric (a DCN axis must be charged at DCN rates)
+                for a, d in axis_sizes.items():
+                    if d > 1 and a not in sharded_axes:
+                        sync += self.machine.allreduce_time(wb, d, a)
         return CostMetrics(fwd, bwd, sync, in_bytes, out_bytes, w_bytes)
 
 

@@ -86,9 +86,16 @@ CHIP_PRESETS: Dict[str, TPUChipSpec] = {
         "cpu-host", 2e11, 2e10, 16 << 30, 5e9, 1,
         ici_latency=5e-6, dcn_bandwidth=1e9, dcn_latency=5e-5,
         mxu_efficiency=0.5, hbm_efficiency=0.5, kernel_overhead=5e-6,
-        # jitted-program dispatch from the Python host (~0.1 ms): what the
-        # host-driven pipeline engine pays per stage×microbatch
-        step_overhead=1e-4,
+        # per-PROGRAM overhead on the shared host. A no-op jitted
+        # dispatch is ~0.2 ms, but a real stage executable pays thread-
+        # pool fork/join + buffer setup per launch: the AE playoff
+        # measured the host-driven GPipe engine (2·M·P launches/step)
+        # ~100 ms slower than the single fused program on dlrm —
+        # ~6 ms per launch over 16 launches. Charged once per fused
+        # step (cancels when comparing single-program plans) and
+        # 2·M·P times for pipe plans (unity._pipe_adjusted), which is
+        # what makes host-driven pipelining honestly unattractive here.
+        step_overhead=5e-3,
     ),
 }
 
@@ -114,6 +121,38 @@ class MachineModel:
         sharding buys nothing (the cost model consults this so the search
         doesn't hallucinate speedups the platform can't deliver)."""
         return float(max(parts, 1))
+
+    def sharded_compute_penalty(self, non_data_axes) -> float:
+        """Compute multiplier for ops sharded beyond the batch dim (see
+        SimpleMachineModel: >1 only on shared-host virtual meshes)."""
+        return 1.0
+
+    def serialization_factor(self) -> float:
+        """How many device-programs' work funnels through one execution
+        resource. Real chips: 1 (each device runs its own program in
+        parallel — per-device cost IS wall-clock). Shared-host virtual
+        meshes: the device count — every program time-slices one socket,
+        so an op REPLICATED across an idle mesh axis is honestly charged
+        for each redundant replica."""
+        return 1.0
+
+    def sharded_tiny_op_latency(self) -> float:
+        """Fixed per-direction cost for a small sharded op (>0 only on
+        shared-host virtual meshes; see SimpleMachineModel)."""
+        return 0.0
+
+    def gather_inefficiency(self) -> float:
+        """Embedding gather/scatter multiplier (>1 only on shared-host
+        virtual meshes; real chips gather at memory speed)."""
+        return 1.0
+
+    def combine_sync_axes(self) -> bool:
+        """Whether grad-sync for a weight replicated over several mesh
+        axes is priced as ONE allreduce over the combined degree (true on
+        shared hosts, where any axis decomposition funnels through the
+        same memory system) or per-axis (real machines, where each axis
+        rides its own fabric — DCN vs ICI — and must be priced there)."""
+        return False
 
     # every cost takes per-participant payload bytes and the axis degree
     def allreduce_time(self, bytes_per_device: float, degree: int, axis: str = "") -> float:
@@ -152,6 +191,42 @@ class SimpleMachineModel(MachineModel):
         if self.shared_host:
             return 1.0
         return float(max(parts, 1))
+
+    def sharded_compute_penalty(self, non_data_axes) -> float:
+        """Shared-host compute multiplier for ops sharded beyond the
+        batch dim. Fitted against the AE playoff's measured step times
+        (scripts/fit_shared_host.py): XLA's per-shard programs for
+        model/seq-sharded ops ran ~1.6x their batch-sharded cost on the
+        one-core virtual mesh (masking + per-shard collectives the
+        roofline doesn't see), and the expert-parallel dispatch family
+        (capacity gathers/scatters per shard) another ~4.5x on top.
+        Real chips: 1.0 — each device genuinely owns its shard."""
+        if not self.shared_host or not non_data_axes:
+            return 1.0
+        penalty = 1.6
+        if "expert" in non_data_axes:
+            penalty *= 4.5
+        return penalty
+
+    def serialization_factor(self) -> float:
+        return float(self._n) if self.shared_host else 1.0
+
+    def sharded_tiny_op_latency(self) -> float:
+        """Fixed per-direction cost for a SMALL sharded op on the shared
+        host (fitted: the n-branch MoE's per-expert GEMMs are overhead-
+        dominated — per-shard program setup swamps their ~0.1 MFLOP of
+        compute, which the roofline prices at microseconds)."""
+        return 5e-4 if self.shared_host else 0.0
+
+    def gather_inefficiency(self) -> float:
+        """Embedding gather/scatter multiplier on the shared host: XLA
+        CPU executes row gathers as scalar loops, measured ~3x the
+        roofline's streaming estimate (dlrm/xdl DP legs). Real chips
+        gather at memory speed: 1.0."""
+        return 3.0 if self.shared_host else 1.0
+
+    def combine_sync_axes(self) -> bool:
+        return self.shared_host
 
     # ring formulas; ICI links are bidirectional so a ring all-gather can use
     # both directions → effective per-link bandwidth ×2.

@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""Fit the shared-host machine model against playoff-measured ratios.
+
+reference contract: the simulator replays costs measured on the device
+(simulator.cc:822; Op::inner_measure_operator_cost model.cu:17-53). The
+virtual CPU mesh is the always-present device here; the measurement is
+the execution playoff's per-step times (searched plan vs plain DP under
+identical conditions), recorded either in an AE artifact or supplied on
+the command line as NAME=searched_ms/dp_ms pairs.
+
+For each workload this prints the search's predicted speedup
+(est_dp / est_searched) next to the measured one (dp_ms / searched_ms)
+and the predicted/measured calibration ratio, under the CURRENT
+shared-host constants — run, adjust sim/machine_model.py cpu-host
+constants, re-run, until every ratio sits inside the 1.5x gate
+(tests/test_shared_host_calibration.py).
+
+Usage:
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python scripts/fit_shared_host.py [AE_r04.json | mlp=12.3/28.9 ...]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "examples", "python", "native"))
+
+BUILDERS = {
+    "mlp": "mnist_mlp",
+    "dlrm": "dlrm",
+    "xdl": "xdl",
+    "bert": "bert_proxy_native",
+    "moe": "moe",
+}
+
+
+def predicted(name: str, n_devices: int = 8, batch: int = 32,
+              budget: int = 10):
+    from flexflow_tpu import FFConfig, FFModel
+    from flexflow_tpu.search.unity import (data_parallel_input_pshapes,
+                                           full_search, graph_optimize)
+    from flexflow_tpu.sim import (OpCostModel, Simulator,
+                                  detect_machine_model)
+
+    mod = __import__(BUILDERS[name])
+    cfg = FFConfig(batch_size=batch)
+    cfg.search_budget = budget
+    cfg.playoff_steps = 3
+    ff = FFModel(cfg)
+    mod.build(ff, batch)
+    logits = ff._final_output()
+    machine = detect_machine_model(n_devices)
+    beam = max(cfg.base_optimize_threshold, 8)
+    best = full_search(ff.layers, ff._used_inputs(), machine, cfg,
+                       beam_width=beam,
+                       max_pipe=max(1, len(ff.layers) // 2),
+                       protected=frozenset({logits.tensor_id}))
+    sim = Simulator(machine, OpCostModel(machine))
+    dp = graph_optimize(
+        ff.layers,
+        data_parallel_input_pshapes(ff._used_inputs(),
+                                    {"data": n_devices}, True),
+        {"data": n_devices}, sim, cfg, beam_width=beam, dp_only=True)
+    return dp.est_step_time / best.est_step_time, best
+
+
+def main():
+    measured = {}
+    devices, batch, budget = 8, 32, 10
+    for arg in sys.argv[1:]:
+        if arg.endswith(".json"):
+            with open(arg) as f:
+                doc = json.load(f)
+            # predict under the SAME conditions the artifact measured
+            if isinstance(doc.get("devices"), int):
+                devices = doc["devices"]
+            batch = int(doc.get("batch_size", batch))
+            budget = int(doc.get("budget", budget))
+            for k, v in doc["results"].items():
+                po = v.get("playoff")
+                if isinstance(po, dict) and k in BUILDERS:
+                    measured[k] = po["dp_ms"] / po["searched_ms"]
+        elif "=" in arg:
+            k, v = arg.split("=")
+            s_ms, d_ms = (float(x) for x in v.split("/"))
+            measured[k] = d_ms / s_ms
+    if not measured:
+        print("no measurements given", file=sys.stderr)
+        return 1
+    print(f"{'config':12s} {'predicted':>10s} {'measured':>10s} "
+          f"{'pred/meas':>10s}  plan")
+    worst = 1.0
+    for k, m in measured.items():
+        p, best = predicted(k, n_devices=devices, batch=batch,
+                            budget=budget)
+        r = p / m
+        worst = max(worst, max(r, 1 / r))
+        print(f"{k:12s} {p:10.3f} {m:10.3f} {r:10.3f}  "
+              f"{best.mesh_shape} {best.rewrites or ''}")
+    print(f"worst calibration factor: {worst:.3f} (gate: 1.5)")
+    return 0 if worst <= 1.5 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

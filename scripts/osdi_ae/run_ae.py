@@ -61,6 +61,11 @@ CONFIGS = {
 
 ALL_CONFIGS = list(CONFIGS)
 
+# per-window sample counts for workloads where the default 256 costs CPU
+# hours on the virtual mesh (resnext runs ~1 sample/s there); both legs
+# of a config always use the same count, so the ratio is unaffected
+SAMPLES = {"alexnet": 128, "inception": 96, "resnext": 64}
+
 
 def _env(devices: int):
     """Virtual CPU mesh env for the workload subprocess (the same recipe
@@ -88,6 +93,9 @@ def run_one(script: str, extra, epochs, batch, devices=0,
     cmd = [sys.executable, script, "--epochs", str(epochs),
            "--batch-size", str(batch),
            "--timing-repeats", str(n_windows), *extra]
+    name = next((k for k, v in CONFIGS.items() if v == script), None)
+    if name in SAMPLES:
+        cmd += ["--num-samples", str(SAMPLES[name])]
     proc = subprocess.run(cmd, cwd=EXAMPLES, capture_output=True, text=True,
                           env=_env(devices))
     if proc.returncode != 0:

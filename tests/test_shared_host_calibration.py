@@ -25,13 +25,12 @@ EXAMPLES = os.path.join(ROOT, "examples", "python", "native")
 # |log(predicted/measured)| bound, as a multiplicative factor
 CALIBRATION_FACTOR = 1.5
 
-_BUILDERS = {
-    "mlp": "mnist_mlp",
-    "dlrm": "dlrm",
-    "xdl": "xdl",
-    "bert": "bert_proxy_native",
-    "moe": "moe",
-}
+# the prediction recipe is the FIT TOOL's — one implementation, so the
+# constants an operator fits with scripts/fit_shared_host.py are judged
+# by this gate under identical search parameters
+sys.path.insert(0, os.path.join(ROOT, "scripts"))
+from fit_shared_host import BUILDERS as _BUILDERS  # noqa: E402
+from fit_shared_host import predicted as _predicted_speedup  # noqa: E402
 
 
 def _artifact():
@@ -43,41 +42,6 @@ def _artifact():
                for v in doc["results"].values()):
             return doc
     return None
-
-
-def _predicted_speedup(config_name: str, batch_size: int, budget: int,
-                       n_devices: int):
-    """Re-run the search the AE's searched leg ran — SAME beam width and
-    pipe bound as FFModel._run_search — and price the pure-DP baseline on
-    the same machine model; returns est_dp / est_searched."""
-    from flexflow_tpu import FFConfig, FFModel
-    from flexflow_tpu.search.unity import (data_parallel_input_pshapes,
-                                           full_search, graph_optimize)
-    from flexflow_tpu.sim import OpCostModel, Simulator, detect_machine_model
-
-    sys.path.insert(0, EXAMPLES)
-    try:
-        mod = __import__(_BUILDERS[config_name])
-    finally:
-        sys.path.pop(0)
-    cfg = FFConfig(batch_size=batch_size)
-    cfg.search_budget = budget
-    cfg.playoff_steps = 3  # the AE leg's adoption margin (~1): mirror it
-    ff = FFModel(cfg)
-    mod.build(ff, batch_size)
-    logits = ff._final_output()
-    machine = detect_machine_model(n_devices)
-    beam = max(cfg.base_optimize_threshold, 8)
-    best = full_search(ff.layers, ff._used_inputs(), machine, cfg,
-                       beam_width=beam,
-                       max_pipe=max(1, len(ff.layers) // 2),
-                       protected=frozenset({logits.tensor_id}))
-    sim = Simulator(machine, OpCostModel(machine))
-    dp_pshapes = data_parallel_input_pshapes(
-        ff._used_inputs(), {"data": n_devices}, True)
-    dp = graph_optimize(ff.layers, dp_pshapes, {"data": n_devices}, sim,
-                        cfg, beam_width=beam, dp_only=True)
-    return dp.est_step_time / best.est_step_time, best
 
 
 def test_predicted_speedup_matches_playoff_measured():
@@ -96,7 +60,8 @@ def test_predicted_speedup_matches_playoff_measured():
         if name not in _BUILDERS or not isinstance(po, dict):
             continue
         measured = po["dp_ms"] / po["searched_ms"]
-        predicted, best = _predicted_speedup(name, batch, budget, devices)
+        predicted, best = _predicted_speedup(
+            name, n_devices=devices, batch=batch, budget=budget)
         checked += 1
         ratio = predicted / measured
         if not (1.0 / CALIBRATION_FACTOR <= ratio <= CALIBRATION_FACTOR):
