@@ -141,9 +141,24 @@ def candidate_strategies(
             ph, _ = layer.attrs.get("padding", (0, 0))
             sh, _ = layer.attrs.get("stride", (1, 1))
             out_h = (in_h + 2 * ph - kh) // sh + 1
+            # profitability gate (round 4): spatial partitioning is the
+            # small-batch/large-image tool — its upstream purpose
+            # (substitution.cc:87-95) is parallelizing convs whose batch
+            # dim cannot fill the machine. When the batch shards cleanly,
+            # batch parallelism gets the same activation split with NO
+            # halo exchange, and neither the calibrated cost model nor
+            # the measured AE runs (alexnet/inception, AE_r04) ever saw
+            # spatial win there — so those candidates only pad the search
+            # space. Offer spatial when batch sharding is exhausted
+            # (indivisible or absent) or the image is halo-negligibly
+            # tall (per-shard height >= 64 rows).
+            batch = layer.inputs[0].dims[0]
+            data_deg = max(axis_sizes.get("data", 1), 1)
             for a in model_axes:
                 n = axis_sizes[a]
-                if (in_h % n == 0 and out_h % n == 0
+                profitable = (batch % data_deg != 0 or data_deg == 1
+                              or in_h // n >= 64)
+                if (profitable and in_h % n == 0 and out_h % n == 0
                         and in_h // n > kh // 2):
                     cands.append({"spatial": a})
     elif t is OpType.GROUP_BY_STACKED and param_ok:

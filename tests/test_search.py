@@ -439,3 +439,33 @@ def test_networked_machine_model_drives_search(tmp_path):
     r = full_search(ff.layers, [x], machine, FFConfig(batch_size=32),
                     mesh_shapes=[{"data": 2, "model": 4}])
     assert r.est_step_time > 0 and r.strategies
+
+
+def test_spatial_candidate_profitability_gate():
+    """Spatial (H) conv partitioning is the small-batch/large-image tool
+    (reference: substitution.cc:87-95): when the batch dim shards
+    cleanly, batch parallelism gives the same activation split with no
+    halo exchange, and neither the calibrated cost model nor the
+    recorded AE runs ever saw spatial win — so the candidate is gated
+    to where it can pay (AE_r04 evidence + CALIBRATION.md)."""
+    from flexflow_tpu.search.substitution import candidate_strategies
+
+    def conv_layer(ff_batch, h):
+        ff = FFModel(FFConfig(batch_size=ff_batch))
+        x = ff.create_tensor((ff_batch, 8, h, h), DataType.FLOAT, name="im")
+        ff.conv2d(x, 16, 3, 3, 1, 1, 1, 1, name="c")
+        return ff.layers[0]
+
+    cfg = FFConfig(batch_size=32)
+    cfg.search_budget = 1
+    # batch 32 shards over data=2; image small: spatial is padding, gone
+    cands = candidate_strategies(conv_layer(32, 16),
+                                 {"data": 2, "model": 4}, cfg)
+    assert not any("spatial" in c for c in cands), cands
+    # batch cannot shard (model-only mesh): spatial is the conv's way in
+    cands = candidate_strategies(conv_layer(32, 16), {"model": 4}, cfg)
+    assert any(c.get("spatial") == "model" for c in cands), cands
+    # large image: halo is negligible, spatial competes again
+    cands = candidate_strategies(conv_layer(32, 256),
+                                 {"data": 2, "model": 4}, cfg)
+    assert any(c.get("spatial") == "model" for c in cands), cands
