@@ -38,6 +38,15 @@ def pallas_mode() -> str | None:
     return None
 
 
+def pallas_forced() -> bool:
+    """True when the operator EXPLICITLY forced compiled kernels on
+    (``FLEXFLOW_TPU_PALLAS=compiled``) — as opposed to ``pallas_mode()``
+    returning "compiled" merely because the backend is a TPU. The flash
+    win-or-off policy needs the distinction; the env contract lives here
+    so it is parsed in one module."""
+    return os.environ.get("FLEXFLOW_TPU_PALLAS") == "compiled"
+
+
 def interpret_flag() -> bool:
     return pallas_mode() == "interpret"
 

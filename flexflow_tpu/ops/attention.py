@@ -142,10 +142,14 @@ class MultiHeadAttention(Op):
                 dropout_rate=drop, rng=ctx.rng,
             )
         else:
-            from ..kernels import flash_attention as fa, pallas_mode
+            from ..kernels import flash_attention as fa
 
             ctxv = None
-            if drop == 0.0 and pallas_mode() is not None:
+            # win-or-off policy: on `auto` the kernel engages only at
+            # shapes where a recorded autotune beat XLA fused
+            # (fa.engaged; PARITY.md §flash-attention)
+            if drop == 0.0 and fa.engaged(
+                    qh.shape[1], kh.shape[1], qh.shape[-1], self.causal):
                 mesh = ctx.mesh
                 if mesh is None or mesh.size == 1:
                     if fa.supported(qh.shape, kh.shape, self.causal):

@@ -147,9 +147,9 @@ def candidate_strategies(
             # dim cannot fill the machine. When the batch shards cleanly,
             # batch parallelism gets the same activation split with NO
             # halo exchange, and neither the calibrated cost model nor
-            # the measured AE runs (alexnet/inception, AE_r04) ever saw
-            # spatial win there — so those candidates only pad the search
-            # space. Offer spatial when batch sharding is exhausted
+            # the committed AE artifact's CNN rows (alexnet/inception)
+            # ever saw spatial win there — so those candidates only pad
+            # the search space. Offer spatial when batch sharding is exhausted
             # (indivisible or absent) or the image is halo-negligibly
             # tall (per-shard height >= 64 rows).
             batch = layer.inputs[0].dims[0]

@@ -110,7 +110,11 @@ def run_one(script: str, extra, epochs, batch, devices=0,
     playoff = None
     if m:
         playoff = {"searched_ms": float(m.group(1)),
-                   "dp_ms": float(m.group(2)), "kept": m.group(3)}
+                   "dp_ms": float(m.group(2)), "kept": m.group(3),
+                   # contention probe fired before the race: the host was
+                   # loaded, so the measured decision is suspect and the
+                   # row must be re-run on an idle machine
+                   "tainted": "[playoff] contention:" in proc.stdout}
     return (vals[1:] if len(vals) > repeats else vals), playoff
 
 

@@ -385,15 +385,21 @@ def test_spatial_candidates_and_halo_priced():
     ff = FFModel(FFConfig(batch_size=8))
     x = _conv_stack(ff)
     conv = next(l for l in ff.layers if l.name == "c1")
-    cands = candidate_strategies(conv, {"data": 2, "model": 4})
+    # with no data axis to consume the batch, spatial is offered
+    cands = candidate_strategies(conv, {"model": 4})
     assert {"spatial": "model"} in cands
+    # profitability gate: when the batch shards cleanly over a data axis
+    # and the per-shard image is short, spatial is suppressed (batch
+    # parallelism gets the same split with no halo exchange)
+    assert not any("spatial" in c for c in
+                   candidate_strategies(conv, {"data": 2, "model": 4}))
     # a conv whose height does not divide gets no spatial candidate
     ff2 = FFModel(FFConfig(batch_size=8))
     y = ff2.create_tensor((8, 3, 15, 15), DataType.FLOAT, name="odd")
     ff2.conv2d(y, 8, 3, 3, 1, 1, 1, 1, name="codd")
     codd = ff2.layers[-1]
     assert not any("spatial" in c for c in
-                   candidate_strategies(codd, {"data": 2, "model": 4}))
+                   candidate_strategies(codd, {"model": 4}))
 
     ops, _ = build_ops(
         ff.layers,

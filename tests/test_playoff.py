@@ -160,3 +160,43 @@ def test_playoff_pipelined_model_restores_state(monkeypatch):
     hist = ff.fit(X, Y, epochs=1, batch_size=8, verbose=False)
     assert len(hist) == 1
     assert ff._playoff_done
+
+
+def test_playoff_actually_runs_and_records(capsys):
+    """VERDICT r4 weak #4a: `_maybe_playoff` guards with except-all, so an
+    API drift inside the race would silently revert the searched-never-
+    loses guarantee to analytic-model-only. This pins that a fit with
+    playoff_steps>0 and a nontrivial (explicitly supplied) strategy
+    actually RUNS the race and records the measured decision plus the
+    contention probe."""
+    cfg = FFConfig(batch_size=16, playoff_steps=2)
+    cfg.mesh_shape = {"data": 2, "model": 4}
+    ff = _mlp(cfg)
+    ff.compile(optimizer=SGDOptimizer(lr=0.1),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=[],
+               strategies={"h1": {"out": "model"}, "out": {"in": "model"}})
+    x, y = _fit_data()
+    ff.fit(x, y, epochs=1, verbose=False)
+    assert ff._playoff_done
+    rec = ff._playoff_record
+    assert rec is not None, "playoff silently skipped (except-all guard?)"
+    assert rec["kept"] in ("searched", "dp")
+    assert rec["searched_ms"] > 0 and rec["dp_ms"] > 0
+    assert {"floor_us", "median_us", "tainted"} <= set(rec["probe"])
+    assert "[playoff] searched" in capsys.readouterr().out
+
+
+def test_playoff_contention_probe_flags_load():
+    """The dispatch probe marks timings tainted when the median dispatch
+    is far off the floor (a loaded one-core host), and clean when the
+    distribution is tight or all-fast."""
+    probe = FFModel._dispatch_probe(n=10)
+    assert probe["floor_us"] > 0 and probe["median_us"] >= probe["floor_us"]
+    assert isinstance(probe["tainted"], bool)
+    # loaded host: median stalls well past the floor
+    assert FFModel._probe_taint(100e-6, 300e-6)
+    # idle host, tight distribution
+    assert not FFModel._probe_taint(100e-6, 110e-6)
+    # sub-100us timer jitter must not flag an idle machine even at 3x
+    assert not FFModel._probe_taint(20e-6, 60e-6)

@@ -447,7 +447,7 @@ def test_spatial_candidate_profitability_gate():
     cleanly, batch parallelism gives the same activation split with no
     halo exchange, and neither the calibrated cost model nor the
     recorded AE runs ever saw spatial win — so the candidate is gated
-    to where it can pay (AE_r04 evidence + CALIBRATION.md)."""
+    to where it can pay (committed AE artifact + CALIBRATION.md)."""
     from flexflow_tpu.search.substitution import candidate_strategies
 
     def conv_layer(ff_batch, h):
