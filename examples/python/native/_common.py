@@ -46,6 +46,15 @@ def run_example(name, build, make_data, loss_type, metrics,
     wb = config.batch_size
     ff.fit([a[:wb] for a in xs] if len(xs) > 1 else xs[0][:wb], y[:wb],
            epochs=1, shuffle=False, verbose=False)
+    # contention evidence for EVERY timed leg (not only playoff races —
+    # a search that concludes plain DP skips the race, and round-5's AE
+    # showed exactly that leg absorbing background load unflagged): the
+    # dispatch-latency probe prints its verdict so the AE runner can
+    # record taint and re-run the leg on an idle host
+    probe = FFModel._dispatch_probe()
+    print(f"[probe] floor_us={probe['floor_us']} "
+          f"median_us={probe['median_us']} "
+          f"tainted={'yes' if probe['tainted'] else 'no'}", flush=True)
     # --timing-repeats N repeats the timed window (same compiled step, N
     # independent measurements) so the AE runner can take a median and a
     # spread instead of trusting one wall-clock sample

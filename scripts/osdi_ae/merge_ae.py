@@ -1,0 +1,47 @@
+#!/usr/bin/env python
+"""Merge re-measured config rows into an AE artifact.
+
+A multi-hour AE run occasionally needs individual configs re-measured on
+an idle host (contention-tainted legs, or XLA CPU's flaky collective
+rendezvous abort); the re-run writes a small artifact with just those
+configs, and this tool folds the fresh rows into the main artifact so
+the evidence gates (tests/test_ae_protocol.py) judge one complete
+document. Rows NOT present in the fix artifact are kept as-is; meta
+fields must agree (same protocol parameters) or the merge refuses.
+
+Usage: python scripts/osdi_ae/merge_ae.py AE_r05.json AE_r05_fix.json
+"""
+
+import json
+import sys
+
+
+def main(base_path: str, fix_path: str) -> int:
+    with open(base_path) as f:
+        base = json.load(f)
+    with open(fix_path) as f:
+        fix = json.load(f)
+    for key in ("devices", "budget", "epochs", "batch_size", "repeats",
+                "playoff_steps"):
+        if base.get(key) != fix.get(key):
+            print(f"refusing to merge: {key} differs "
+                  f"({base.get(key)!r} vs {fix.get(key)!r})")
+            return 1
+    for name, row in fix["results"].items():
+        if "error" in row and "error" not in base["results"].get(name, {}):
+            print(f"refusing to replace a good row with an error: {name}")
+            return 1
+        prev = base["results"].get(name)
+        base["results"][name] = row
+        print(f"merged {name}: "
+              f"{'error' if 'error' in row else round(row['speedup'], 3)}"
+              f" (was {'absent' if prev is None else 'error' if 'error' in prev else round(prev['speedup'], 3)})")
+    base["merged_from"] = sorted(set(base.get("merged_from", []) + [fix_path]))
+    with open(base_path, "w") as f:
+        json.dump(base, f, indent=1)
+    print(f"# wrote {base_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1], sys.argv[2]))

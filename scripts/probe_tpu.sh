@@ -1,0 +1,12 @@
+#!/bin/sh
+# Liveness probe for the axon TPU tunnel: exits 0 and prints PROBE_OK if a
+# device round-trip completes within the deadline, non-zero otherwise.
+# The backend wedges by HANGING at init (not erroring), so the probe runs
+# jax in a throwaway subprocess under a hard timeout — the same pattern
+# bench.py's orchestrator uses (_probe_device_backend).
+timeout "${1:-90}" python -c "
+import jax, jax.numpy as jnp
+x = jnp.ones((128, 128))
+(x @ x).block_until_ready()
+print('PROBE_OK', jax.devices()[0].device_kind)
+" 2>/dev/null
