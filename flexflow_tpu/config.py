@@ -91,6 +91,25 @@ class FFConfig:
     # samples per timed window in the examples (0 = the default 256);
     # the AE runner lowers it for CPU-hour-heavy CNN workloads
     bench_samples: int = 0
+    # parallel candidate evaluation in full_search: independent
+    # (graph-variant x mesh-shape) work items run on a forked worker pool.
+    # 0 = auto (min(os.cpu_count(), candidates); stays serial below 4
+    # candidates where pool overhead beats the win), 1 = the historical
+    # serial path, N = exactly N workers. Selection is bit-identical to
+    # serial at any setting (deterministic candidate-index tie-break).
+    search_num_workers: int = 0
+    # bound-based mesh pruning: skip the inner DP for candidates whose
+    # compute-only lower bound already exceeds the incumbent x adoption
+    # margin. Selection-neutral by construction (search/unity.py
+    # _shape_lower_bound); pruned counts surface in the profiling export.
+    search_prune: bool = True
+    # persistent strategy cache (the reference's --import-strategy made
+    # automatic, model.cc:3609-3618): "on" consults
+    # <search_cache_dir>/<sha256-key>.json before any search and stores
+    # fresh results; "refresh" re-runs the search and overwrites the
+    # entry; "off" (default) bypasses the cache entirely.
+    search_cache: str = "off"
+    search_cache_dir: str = ".ffcache/strategies"
     substitution_json_path: Optional[str] = None
     machine_model_file: Optional[str] = None
     export_strategy_file: Optional[str] = None
@@ -192,6 +211,14 @@ class FFConfig:
                 cfg.timing_repeats = int(_next())
             elif a == "--num-samples":
                 cfg.bench_samples = int(_next())
+            elif a == "--search-workers":
+                cfg.search_num_workers = int(_next())
+            elif a == "--disable-search-prune":
+                cfg.search_prune = False
+            elif a == "--search-cache":
+                cfg.search_cache = _next()
+            elif a == "--search-cache-dir":
+                cfg.search_cache_dir = _next()
             elif a == "--substitution-json":
                 cfg.substitution_json_path = _next()
             elif a == "--machine-model-file":

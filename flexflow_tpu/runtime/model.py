@@ -14,6 +14,7 @@ drive it.
 
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
@@ -60,6 +61,9 @@ class FFModel:
         self.compiled: Optional[CompiledModel] = None
         self.pipelined = None  # PipelinedModel when compile(pipeline=...)
         self.search_result = None  # GraphSearchResult from the last search
+        # timing/coverage/cache counters from the last _run_search (see
+        # _finish_search); surfaced by runtime/profiling.py exports
+        self.search_profile = None
         self._search_strategies: Dict[str, Dict[str, str]] = {}
         self.iter_config = FFIterationConfig()
         self._param_index: Dict[int, Tuple[str, str]] = {}  # tensor_id -> (op, weight)
@@ -859,7 +863,7 @@ class FFModel:
         from ..search.mcmc import mcmc_optimize
         from ..search.unity import (_memory_budget,
                                     data_parallel_input_pshapes, full_search,
-                                    graph_optimize, memory_aware_search)
+                                    graph_optimize)
         from ..sim import (OpCostModel, Simulator, detect_machine_model,
                            load_machine_model)
         from ..core.machine import mesh_axis_sizes
@@ -915,7 +919,44 @@ class FFModel:
             from ..ops.fused import apply_fusion
 
             n_effective = len(apply_fusion(self.layers, set(protected)))
-        if mesh is not None or cfg.mesh_shape:
+        t_search = time.perf_counter()
+        pinned = mesh is not None or bool(cfg.mesh_shape)
+        if pinned and mesh is None:
+            mesh = make_mesh(cfg.mesh_shape)
+        machine = make_machine(mesh.devices.size if pinned else None)
+        # persistent strategy cache (reference: --import-strategy
+        # model.cc:3609 made automatic): consulted BEFORE any search —
+        # a hit reconstructs the stored result and compiles with zero
+        # cost-model/simulator work
+        cache_mode = getattr(cfg, "search_cache", "off") or "off"
+        if cache_mode not in ("on", "off", "refresh"):
+            # a typo ('onn', 'true', 'ON') must not silently disable the
+            # cache and re-pay every search
+            raise ValueError(
+                f"search_cache={cache_mode!r}: expected 'on', 'off' or "
+                "'refresh'")
+        cache_key = None
+        cache_dir = getattr(cfg, "search_cache_dir", ".ffcache/strategies")
+        if cache_mode in ("on", "refresh") and not use_mcmc:
+            from ..search.cache import (load_payload, result_from_payload,
+                                        strategy_cache_key)
+
+            cache_key = strategy_cache_key(
+                self.layers, inputs, machine, cfg,
+                mesh_axes=mesh_axis_sizes(mesh) if pinned else None,
+                protected=protected)
+            if cache_mode == "on":
+                payload = load_payload(cache_dir, cache_key)
+                if payload is not None:
+                    result = result_from_payload(payload, self.layers, cfg,
+                                                 protected)
+                    if result is not None:
+                        if not pinned:
+                            self.config.mesh_shape = result.mesh_shape
+                            mesh = make_mesh(result.mesh_shape)
+                        return self._finish_search(result, mesh, t_search,
+                                                   "hit")
+        if pinned:
             # mesh pinned by the user: search strategies on it only. A
             # pipe axis (user-pinned or persisted from a previous search)
             # is handled like full_search does: the inner DP runs on the
@@ -923,20 +964,17 @@ class FFModel:
             # and the GPipe bubble model adjusts the result.
             from ..search.unity import _pipe_adjusted
 
-            if mesh is None:
-                mesh = make_mesh(cfg.mesh_shape)
             full_axis_sizes = mesh_axis_sizes(mesh)
             pipe = full_axis_sizes.get("pipe", 1)
             axis_sizes = {a: s for a, s in full_axis_sizes.items()
                           if a != "pipe"}
-            machine = make_machine(mesh.devices.size)
             cap = machine.chip.hbm_capacity * pipe
-            sim = Simulator(
-                machine, OpCostModel(machine),
-                overlap_grad_sync=cfg.search_overlap_backward_update)
             input_pshapes = data_parallel_input_pshapes(
                 inputs, axis_sizes, cfg.enable_sample_parallel)
             if use_mcmc:
+                sim = Simulator(
+                    machine, OpCostModel(machine),
+                    overlap_grad_sync=cfg.search_overlap_backward_update)
                 result = mcmc_optimize(
                     self.layers, input_pshapes, axis_sizes, sim, cfg,
                     seed=cfg.seed,
@@ -946,12 +984,18 @@ class FFModel:
                                             machine, cfg.batch_size,
                                             fused=cfg.perform_fusion)
             else:
-                # structural variants compete on the pinned mesh too
+                # structural variants compete on the pinned mesh too —
+                # each evaluated by the SAME candidate body full_search
+                # uses (unity._evaluate_candidate: memory-aware budget,
+                # ZeRO optimizer-state sharding, GPipe adjustment)
                 from ..search.graph_xfer import graph_variants
-                from ..search.unity import _effective_layer_count
+                from ..search.unity import (_effective_layer_count,
+                                            _evaluate_candidate)
 
                 result = None
-                first_err = None
+                errs: list = []
+                n_cand = 0
+                shared_cm = OpCostModel(machine)
                 for rewrites, vlayers in graph_variants(
                         self.layers, cfg,
                         rewrites=getattr(cfg, "_graphxfer_rewrites", None),
@@ -965,28 +1009,13 @@ class FFModel:
                         vlayers, cfg.perform_fusion, protected)
                     if pipe > 1 and n_var < pipe and n_effective >= pipe:
                         continue
-                    try:
-                        if cfg.perform_memory_search:
-                            r = memory_aware_search(
-                                vlayers, input_pshapes, axis_sizes, sim,
-                                cfg, beam_width=beam,
-                                memory_budget=_memory_budget(cfg, machine)
-                                * pipe,
-                                memory_cap=cap,
-                            )
-                        else:
-                            r = graph_optimize(
-                                vlayers, input_pshapes, axis_sizes, sim,
-                                cfg, beam_width=beam, memory_cap=cap,
-                            )
-                    except RuntimeError as e:
-                        if first_err is None:
-                            first_err = e  # original graph's diagnostic
+                    n_cand += 1
+                    r = _evaluate_candidate(
+                        vlayers, full_axis_sizes, inputs, machine, cfg,
+                        beam, shared_cm, _memory_budget(cfg, machine),
+                        err_sink=errs, strict_budget=False)
+                    if r is None:
                         continue
-                    if pipe > 1:
-                        r = _pipe_adjusted(r, vlayers, pipe, machine,
-                                           cfg.batch_size,
-                                           fused=cfg.perform_fusion)
                     if rewrites:
                         r.rewrites, r.layers = list(rewrites), vlayers
                     if result is None or r.est_step_time < result.est_step_time:
@@ -994,7 +1023,7 @@ class FFModel:
                 if result is None:
                     raise RuntimeError(
                         "no feasible strategy on the pinned mesh"
-                    ) from first_err
+                    ) from (errs[0] if errs else None)
                 # adoption margin on the pinned mesh too: sharding over
                 # the pinned axes must beat leaving them idle (pure DP)
                 # by more than the cost model's error bar
@@ -1002,9 +1031,20 @@ class FFModel:
                                             adoption_margin, graph_optimize)
 
                 if _is_sharded_result(result):
+                    # the DP fallback must be priced under the SAME
+                    # accounting the candidates just used: reuse the
+                    # loop's memoized cost model, and with ZeRO the
+                    # optimizer state is sharded over the data axis for
+                    # DP exactly as it was for every candidate
+                    dp_mult = (2.0 / axis_sizes.get("data", 1)
+                               if cfg.zero_optimizer else 2.0)
+                    dp_sim = Simulator(
+                        machine, shared_cm,
+                        overlap_grad_sync=cfg.search_overlap_backward_update,
+                        optimizer_state_mult=dp_mult)
                     try:
                         dp_r = graph_optimize(
-                            self.layers, input_pshapes, axis_sizes, sim,
+                            self.layers, input_pshapes, axis_sizes, dp_sim,
                             cfg, beam, memory_cap=cap, dp_only=True)
                         # the memory-aware search's budget binds the DP
                         # fallback too: never demote to a plan that
@@ -1025,22 +1065,65 @@ class FFModel:
                             * adoption_margin(cfg, machine)
                             > dp_r.est_step_time):
                         result = dp_r
+                result.candidates = n_cand
+                result.workers = 1  # the pinned variant loop is serial
         else:
-            machine = make_machine()
             result = full_search(
                 self.layers, inputs, machine, cfg, beam_width=beam,
                 max_pipe=max(1, n_effective // 2), protected=protected,
             )
             self.config.mesh_shape = result.mesh_shape
             mesh = make_mesh(result.mesh_shape)
+        if cache_key is not None:
+            from ..search.cache import store_result, strategy_cache_key
+
+            store_result(cache_dir, cache_key, result)
+            if not pinned:
+                # the first compile pins config.mesh_shape to the searched
+                # mesh, so a recompile keys the cache with the mesh PINNED
+                # — store under that key too so the warm path still hits
+                key2 = strategy_cache_key(self.layers, inputs, machine, cfg,
+                                          mesh_axes=result.mesh_shape,
+                                          protected=protected)
+                if key2 != cache_key:
+                    store_result(cache_dir, key2, result)
+        # cache_key None = the cache never engaged (off, or mcmc bypass):
+        # the label must say so even when cache_mode asked for "refresh"
+        return self._finish_search(
+            result, mesh, t_search,
+            "off" if cache_key is None else
+            ("refresh" if cache_mode == "refresh" else "miss"))
+
+    def _finish_search(self, result, mesh, t_start, cache_label: str):
+        """Shared tail of _run_search for searched AND cache-hit results:
+        records the result + the search profile (timing / coverage /
+        cache-hit counters surfaced by runtime/profiling.py), honors the
+        profiling print and --export-strategy, and hands compile() the
+        (strategies, mesh) pair."""
         self.search_result = result
         # a structural rewrite won: compile() builds the rewritten graph
         self._search_layers = getattr(result, "layers", None)
+        self.search_profile = {
+            "search_time_s": time.perf_counter() - t_start,
+            "cache": cache_label,
+            "candidates": getattr(result, "candidates", 0),
+            "pruned": getattr(result, "pruned", 0),
+            "states_explored": result.states_explored,
+            # what the evaluation ACTUALLY used (1 = serial incl. pool
+            # fallback; 0 = no evaluation ran, e.g. a cache hit) — the
+            # config knob alone can't distinguish these
+            "workers": getattr(result, "workers", 0),
+            "mesh_shape": dict(result.mesh_shape),
+            "est_step_time": result.est_step_time,
+        }
         if self.config.profiling:
             rw = getattr(result, "rewrites", None)
+            p = self.search_profile
             print(
                 f"[search] mesh={result.mesh_shape} est_step={result.est_step_time*1e3:.3f}ms "
                 f"mem={result.est_memory/2**20:.1f}MiB states={result.states_explored}"
+                f" cand={p['candidates']} pruned={p['pruned']}"
+                f" cache={cache_label} t={p['search_time_s']:.3f}s"
                 + (f" rewrites={rw}" if rw else ""),
                 flush=True,
             )

@@ -15,6 +15,10 @@ TPU-native equivalents of the reference's observability surface
 * ``--taskgraph`` (``export_strategy_task_graph_file``, model.cc:3666) →
   :func:`export_task_graph`: dot/JSON of the simulator's SimTask graph,
   transitively reduced (via the native graph library when built).
+* search observability → :func:`search_report`: the last search's timing,
+  cache-hit, candidate-coverage, and pruned-candidate counters (recorded
+  by ``FFModel._finish_search``); included in the JSON task-graph export
+  so bound-based pruning is never a silent truncation.
 """
 
 from __future__ import annotations
@@ -119,6 +123,17 @@ def profile_ops(ffmodel, iters: int = 10, warmup: int = 2) -> List[Dict]:
     return records
 
 
+# -------------------------------------------------------- search observability
+def search_report(ffmodel) -> Optional[Dict]:
+    """The last auto-parallelization search's counters, or None when no
+    search ran this compile: ``search_time_s``, ``cache``
+    ("hit"/"miss"/"refresh"/"off"), ``candidates`` (total variant x mesh
+    work items), ``pruned`` (skipped by the lower-bound prune — reported
+    so coverage is never silently truncated), ``states_explored``,
+    ``workers``, the chosen ``mesh_shape`` and ``est_step_time``."""
+    return getattr(ffmodel, "search_profile", None)
+
+
 # ----------------------------------------------------------------- dot export
 def export_computation_graph(ffmodel, path: str,
                              include_costs: bool = False) -> None:
@@ -188,6 +203,9 @@ def export_task_graph(ffmodel, path: str, fmt: str = "dot") -> None:
             ],
             "edges": [list(e) for e in edges],
         }
+        search = search_report(ffmodel)
+        if search is not None:
+            payload["search"] = search
         with open(path, "w") as f:
             json.dump(payload, f, indent=2)
         return

@@ -18,7 +18,10 @@ from its search task.
 """
 
 from .substitution import candidate_strategies, load_substitution_json
-from .unity import GraphSearchResult, enumerate_mesh_shapes, graph_optimize
+from .unity import (GraphSearchResult, enumerate_mesh_shapes, full_search,
+                    graph_optimize)
+from .cache import (load_payload, result_from_payload, store_result,
+                    strategy_cache_key)
 from .mcmc import mcmc_optimize
 
 __all__ = [
@@ -26,6 +29,11 @@ __all__ = [
     "load_substitution_json",
     "GraphSearchResult",
     "enumerate_mesh_shapes",
+    "full_search",
     "graph_optimize",
     "mcmc_optimize",
+    "strategy_cache_key",
+    "store_result",
+    "load_payload",
+    "result_from_payload",
 ]

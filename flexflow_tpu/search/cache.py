@@ -1,0 +1,265 @@
+"""Persistent strategy cache.
+
+The reference ships ``--export-strategy`` / ``--import-strategy``
+(model.cc:3609-3618) precisely because users refuse to pay the search
+twice; this module is that workflow made automatic. ``FFModel._run_search``
+consults the cache before any search runs: on a hit the stored
+:class:`~.unity.GraphSearchResult` is rehydrated and the compile proceeds
+with ZERO simulator/cost-model queries (tests assert this via the
+cost-model call counter).
+
+Key = SHA-256 over three signatures:
+
+* **graph** — the layer toposort with op types, attrs, and input/output
+  tensor shapes+dtypes, with tensor/layer ids remapped to dense local
+  indices (the builder's itertools counters are process-global, so two
+  identical models built in different processes — or twice in one — must
+  still collide on the same key);
+* **machine** — the :class:`~..sim.machine_model.MachineModel` class,
+  device count, full chip spec, and topology attributes;
+* **config** — every knob that can change what the search SELECTS
+  (`_SEARCH_KNOBS` below, plus the pinned mesh and the content hash of a
+  ``--substitution-json`` file and any process-global JSON rules).
+  Performance-only knobs (worker count, pruning, the cache mode itself)
+  are deliberately excluded: they never change the selection, so results
+  transfer across them.
+
+Values are JSON files under ``<cache_dir>/<key>.json`` (default
+``.ffcache/strategies/``), written atomically. A result that won on a
+structurally rewritten graph stores only the rewrite NAMES; rehydration
+re-derives the variant through :func:`~.graph_xfer.rehydrate_variant` and
+treats any mismatch (renamed layers, changed rule set) as a miss — the
+cache can go stale, never wrong.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import time
+from typing import Dict, List, Optional, Sequence
+
+from .unity import GraphSearchResult
+
+CACHE_VERSION = 1
+
+# config knobs that can change what the search selects (NOT how fast it
+# runs) — the adoption margin depends on playoff_steps, the beam on
+# base_optimize_threshold, pipe microbatching on batch_size, ...
+_SEARCH_KNOBS = (
+    "batch_size",
+    "search_method",
+    "search_budget",
+    "search_alpha",
+    "search_overlap_backward_update",
+    "only_data_parallel",
+    "enable_sample_parallel",
+    "enable_parameter_parallel",
+    "enable_attribute_parallel",
+    "perform_fusion",
+    "enable_graph_rewrites",
+    "perform_memory_search",
+    "memory_threshold_mb",
+    "search_adoption_margin",
+    "playoff_steps",
+    "base_optimize_threshold",
+    "zero_optimizer",
+    "compute_dtype",
+)
+
+
+def _attr_sig(v):
+    """JSON-stable attribute value: scalars pass through, containers
+    recurse, everything else (initializer objects, ...) collapses to its
+    class name — object reprs carry memory addresses that would make the
+    key process-local."""
+    if isinstance(v, (int, float, str, bool, type(None))):
+        return v
+    if isinstance(v, (tuple, list)):
+        return [_attr_sig(x) for x in v]
+    if isinstance(v, dict):
+        return sorted((str(k), _attr_sig(x)) for k, x in v.items())
+    if hasattr(v, "value") and hasattr(v, "name"):  # enum
+        return f"{v.__class__.__name__}.{v.name}"
+    return v.__class__.__name__
+
+
+def graph_signature(layers: Sequence, input_tensors: Sequence,
+                    protected: Optional[frozenset] = None) -> List:
+    """Layer toposort with tensor ids remapped to dense local indices.
+    ``protected`` (tensor ids that must survive as graph outputs — the
+    logits choice) is part of the signature: it changes rewrite legality
+    and the pipe-stage bound, so two compiles of the same graph with
+    different ``logits_tensor=`` overrides must not share an entry."""
+    tid_local: Dict[int, int] = {}
+
+    def tref(t) -> List:
+        if t.tensor_id not in tid_local:
+            tid_local[t.tensor_id] = len(tid_local)
+        return [tid_local[t.tensor_id], list(t.dims), str(t.dtype)]
+
+    sig: List = [["inputs", [tref(t) for t in input_tensors]]]
+    for layer in layers:
+        attrs = sorted(
+            (k, _attr_sig(v)) for k, v in layer.attrs.items()
+            if not k.startswith("_")
+        )
+        sig.append([
+            layer.name,
+            str(layer.op_type),
+            attrs,
+            [tref(t) for t in layer.inputs],
+            [tref(t) for t in layer.outputs],
+        ])
+    sig.append(["protected",
+                sorted(tid_local.get(tid, -1) for tid in (protected or ()))])
+    return sig
+
+
+def machine_signature(machine) -> Dict:
+    """Everything the cost/comm models read off the machine."""
+    sig: Dict = {
+        "class": machine.__class__.__name__,
+        "n": machine.num_devices(),
+        "chip": dataclasses.asdict(machine.chip),
+    }
+    for a in ("shared_host", "axis_degrees", "axis_links", "wraparound",
+              "dcn_axes", "device_order"):
+        v = getattr(machine, a, None)
+        if v is not None:
+            sig[a] = _attr_sig(v)
+    topo = getattr(machine, "topology", None)
+    if topo is not None:
+        sig["topology"] = _attr_sig(getattr(topo, "__dict__", str(topo)))
+    return sig
+
+
+def config_signature(config, mesh_axes: Optional[Dict[str, int]]) -> Dict:
+    sig: Dict = {"mesh_axes": sorted((mesh_axes or {}).items())}
+    for k in _SEARCH_KNOBS:
+        sig[k] = _attr_sig(getattr(config, k, None))
+    # extra substitution rules change the candidate set: hash the file
+    # content (not the path — same rules from another path must hit) and
+    # any process-global rule table loaded via load_substitution_json
+    path = getattr(config, "substitution_json_path", None)
+    if path:
+        try:
+            with open(path, "rb") as f:
+                sig["substitution_json"] = hashlib.sha256(
+                    f.read()).hexdigest()
+        except OSError:
+            sig["substitution_json"] = f"unreadable:{path}"
+    from .substitution import _JSON_RULES
+
+    if _JSON_RULES:
+        sig["global_rules"] = _attr_sig(_JSON_RULES)
+    return sig
+
+
+def strategy_cache_key(layers, input_tensors, machine, config,
+                       mesh_axes: Optional[Dict[str, int]] = None,
+                       protected: Optional[frozenset] = None) -> str:
+    from ..sim.cost_model import COST_MODEL_VERSION
+
+    doc = {
+        "version": CACHE_VERSION,
+        # plans are only as good as the pricing that selected them: a
+        # retuned cost model (bumped COST_MODEL_VERSION) re-searches
+        # instead of serving plans chosen under the old model forever
+        "cost_model": COST_MODEL_VERSION,
+        "graph": graph_signature(layers, input_tensors, protected),
+        "machine": machine_signature(machine),
+        "config": config_signature(config, mesh_axes),
+    }
+    blob = json.dumps(doc, sort_keys=True, default=str).encode()
+    return hashlib.sha256(blob).hexdigest()
+
+
+# ------------------------------------------------------------------ storage
+def cache_path(cache_dir: str, key: str) -> str:
+    return os.path.join(cache_dir, f"{key}.json")
+
+
+def result_to_payload(result: GraphSearchResult) -> Dict:
+    return {
+        "strategies": result.strategies,
+        "mesh_shape": result.mesh_shape,
+        "est_step_time": result.est_step_time,
+        "est_memory": result.est_memory,
+        "states_explored": result.states_explored,
+        "mem_lambda": result.mem_lambda,
+        "rewrites": list(result.rewrites),
+        "candidates": result.candidates,
+        "pruned": result.pruned,
+    }
+
+
+def store_result(cache_dir: str, key: str,
+                 result: GraphSearchResult) -> Optional[str]:
+    """Atomic write; returns the path, or None when the cache dir is
+    unwritable (caching must never fail a compile)."""
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        path = cache_path(cache_dir, key)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({
+                "version": CACHE_VERSION,
+                "key": key,
+                "created_at": time.time(),
+                "result": result_to_payload(result),
+            }, f, indent=1)
+        os.replace(tmp, path)
+        return path
+    except OSError:
+        return None
+
+
+def load_payload(cache_dir: str, key: str) -> Optional[Dict]:
+    try:
+        with open(cache_path(cache_dir, key)) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if doc.get("version") != CACHE_VERSION or doc.get("key") != key:
+        return None
+    return doc.get("result")
+
+
+def result_from_payload(payload: Dict, layers, config=None,
+                        protected: Optional[frozenset] = None
+                        ) -> Optional[GraphSearchResult]:
+    """Rehydrate a stored result against THIS process's layer graph.
+
+    Returns None (a miss) when the stored rewrites no longer reproduce a
+    variant of this graph or the stored strategies don't cover its layer
+    names — the stale-entry safety net."""
+    from .graph_xfer import rehydrate_variant
+
+    try:
+        rewrites = list(payload.get("rewrites", []))
+        vlayers = rehydrate_variant(layers, rewrites, config, protected)
+        if vlayers is None:
+            return None
+        names = {l.name for l in vlayers}
+        strategies = {
+            k: dict(v) for k, v in payload["strategies"].items()
+        }
+        if not set(strategies).issubset(names):
+            return None
+        return GraphSearchResult(
+            strategies,
+            {str(a): int(s) for a, s in payload["mesh_shape"].items()},
+            float(payload["est_step_time"]),
+            int(payload["est_memory"]),
+            int(payload.get("states_explored", 0)),
+            float(payload.get("mem_lambda", 0.0)),
+            rewrites=rewrites,
+            layers=vlayers if rewrites else None,
+            candidates=int(payload.get("candidates", 0)),
+            pruned=int(payload.get("pruned", 0)),
+        )
+    except (KeyError, TypeError, ValueError):
+        return None

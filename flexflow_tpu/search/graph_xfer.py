@@ -352,6 +352,31 @@ def graph_variants(
     return variants[:max_variants]
 
 
+def rehydrate_variant(
+    layers: List[Layer],
+    rewrites: Sequence[str],
+    config=None,
+    protected: Optional[frozenset] = None,
+) -> Optional[List[Layer]]:
+    """Re-derive the layer list a stored rewrite signature referred to, by
+    replaying the SAME bounded variant enumeration the search ran
+    (search/cache.py stores only rewrite names — Layer objects never leave
+    the process). Returns None when no current variant carries that
+    signature: the rule set or the graph changed, and the caller must
+    treat the stored result as a cache miss."""
+    rewrites = list(rewrites)
+    if not rewrites:
+        return list(layers)
+    for applied, vlayers in graph_variants(
+            layers, config,
+            rewrites=getattr(config, "_graphxfer_rewrites", None)
+            if config is not None else None,
+            protected=protected):
+        if list(applied) == rewrites:
+            return vlayers
+    return None
+
+
 # ------------------------------------------------- reference JSON rule file
 
 RESHARDING_OPS = {

@@ -31,6 +31,7 @@ Translation of the algorithm, not the code:
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..config import FFConfig
@@ -58,6 +59,15 @@ class GraphSearchResult:
     # — reference: GraphXfer-derived best_graph (substitution.cc:1898)
     rewrites: List[str] = dataclasses.field(default_factory=list)
     layers: Optional[List[Layer]] = None
+    # search coverage accounting (filled by full_search on the winning
+    # result): total (variant x mesh) candidates enumerated, how many the
+    # lower-bound prune skipped — surfaced in the profiling export so
+    # coverage is never silently truncated — and the worker count the
+    # evaluation ACTUALLY used (1 = serial, incl. pool-failure fallback;
+    # not persisted by the strategy cache, it is run-specific)
+    candidates: int = 0
+    pruned: int = 0
+    workers: int = 0
 
 
 def _ps_sig(ps: ParallelTensorShape) -> Tuple:
@@ -369,6 +379,238 @@ def _is_sharded_result(r: GraphSearchResult) -> bool:
             or any(v for v in r.strategies.values()))
 
 
+def _evaluate_candidate(
+    vlayers: List[Layer],
+    shape: Dict[str, int],
+    input_tensors: Sequence[Tensor],
+    machine: MachineModel,
+    config: Optional[FFConfig],
+    beam_width: int,
+    cost_model: OpCostModel,
+    budget: float,
+    err_sink: Optional[List] = None,
+    strict_budget: bool = True,
+) -> Optional[GraphSearchResult]:
+    """One (graph-variant, mesh-shape) candidate: the inner DP plus the
+    GPipe adjustment for pipe-prefixed shapes. Returns None when the
+    candidate is infeasible (search dead-end or memory budget); the
+    dead-end RuntimeError is appended to ``err_sink`` when given (the
+    pinned-mesh path chains the first one into its own diagnostic). The
+    caller owns attaching rewrites/layers — a parallel worker must not
+    ship Layer objects back across the process boundary.
+
+    This is the exact body of the historical full_search inner loop; the
+    serial path and every pool worker run the same function, which is what
+    makes parallel selection bit-identical to serial (results depend only
+    on (vlayers, shape, machine, config), never on memo state or
+    completion order)."""
+    sample_parallel = config is None or config.enable_sample_parallel
+    memory_search = config is not None and config.perform_memory_search
+    overlap = config is None or config.search_overlap_backward_update
+    zero = config is not None and config.zero_optimizer
+    fusion = config is not None and config.perform_fusion
+    pipe = shape.get("pipe", 1)
+    axis_sizes = {a: s for a, s in shape.items() if a != "pipe"}
+    # ZeRO-1 shards optimizer state over the data axis: the per-device
+    # footprint the memory prune charges shrinks by the data degree
+    opt_mult = 2.0 / shape.get("data", 1) if zero else 2.0
+    sim = Simulator(machine, cost_model, overlap_grad_sync=overlap,
+                    optimizer_state_mult=opt_mult)
+    input_pshapes = data_parallel_input_pshapes(
+        input_tensors, axis_sizes, sample_parallel)
+    # each pipe stage holds only ~1/P of the model, so both the hard HBM
+    # prune and the memory budget scale by the stage count — pipelining's
+    # primary use case is exactly the model that does NOT fit unsplit
+    cap = machine.chip.hbm_capacity * pipe
+    try:
+        if memory_search:
+            r = memory_aware_search(
+                vlayers, input_pshapes, axis_sizes, sim, config,
+                beam_width, memory_budget=budget * pipe, memory_cap=cap)
+            # over-budget: full_search skips the mesh (others exist);
+            # the pinned-mesh path has ONE mesh and keeps the reference's
+            # report-the-trade-off behavior (graph.cc:2134-2157) instead
+            if strict_budget and r.est_memory > budget * pipe:
+                return None
+        else:
+            r = graph_optimize(
+                vlayers, input_pshapes, axis_sizes, sim, config,
+                beam_width, memory_cap=cap,
+            )
+    except RuntimeError as e:
+        if err_sink is not None:
+            err_sink.append(e)
+        return None
+    if pipe > 1:
+        r = _pipe_adjusted(r, vlayers, pipe, machine,
+                           config.batch_size if config else None,
+                           fused=fusion)
+    return r
+
+
+def _variant_profile(layers: List[Layer]) -> Optional[List[Tuple[float, float, bool]]]:
+    """Per-layer (total_flops, total_bytes, is_embedding) of a graph
+    variant at UNSHARDED shapes — the mesh-independent half of the
+    optimistic lower bound. None when the graph cannot be materialized
+    (then that variant is never pruned)."""
+    from ..sim.cost_model import _pshape_local_bytes
+
+    try:
+        pshapes: Dict[int, ParallelTensorShape] = {}
+        prof: List[Tuple[float, float, bool]] = []
+        for layer in layers:
+            in_shapes = []
+            for t in layer.inputs:
+                if t.tensor_id not in pshapes:
+                    pshapes[t.tensor_id] = ParallelTensorShape(
+                        tuple(ParallelDim(s) for s in t.dims), t.dtype)
+                in_shapes.append(pshapes[t.tensor_id])
+            op = create_op(layer, in_shapes)
+            outs, weights = op.propagate(in_shapes, {"_axis_sizes": {}})
+            op.output_shapes = outs
+            op.weight_shapes = weights
+            for t, ps in zip(layer.outputs, outs):
+                pshapes[t.tensor_id] = ps
+            by = sum(_pshape_local_bytes(p)
+                     for p in list(in_shapes) + list(outs)
+                     + list(weights.values()))
+            prof.append((float(op.flops()), float(by),
+                         layer.op_type is OpType.EMBEDDING))
+        return prof
+    except Exception:
+        return None
+
+
+def _shape_lower_bound(
+    profile: Optional[List[Tuple[float, float, bool]]],
+    shape: Dict[str, int],
+    machine: MachineModel,
+    batch_size: Optional[int],
+) -> Optional[float]:
+    """Optimistic per-candidate lower bound: compute/bytes only, ZERO
+    communication, every layer split over EVERY non-pipe mesh axis.
+
+    Soundness (bound <= the candidate's true est_step_time): the cost
+    model's per-layer forward is max(flops_eff/peak, bytes_eff/bw) plus
+    only-ever-positive terms (kernel overhead, shard penalties, tiny-op
+    floors), with flops_eff >= total/parts * serialization and local bytes
+    >= total/parts — ``parts`` here is the product of ALL non-pipe axis
+    degrees, an upper bound on any real partitioning. Backward is >= 1x
+    forward for every family except embedding (bytes-bound scatter,
+    counted as >= 0); sync and comm are >= 0. Pipe shapes multiply the
+    inner estimate by the GPipe bubble (>= the factor used here) and ADD
+    boundary comm. So skipping a candidate whose bound exceeds the
+    incumbent can never skip the winner."""
+    if profile is None:
+        return None
+    pipe = shape.get("pipe", 1)
+    parts = 1
+    for a, s in shape.items():
+        if a != "pipe":
+            parts *= s
+    chip = machine.chip
+    ser = machine.serialization_factor()
+    t = 0.0
+    for fl, by, emb in profile:
+        comp = fl / (chip.peak_bf16_flops * chip.mxu_efficiency)
+        mem = by / (chip.hbm_bandwidth * chip.hbm_efficiency)
+        fwd = max(comp, mem) / max(parts, 1) * ser
+        t += fwd if emb else 2.0 * fwd
+    if pipe > 1 and machine.effective_parallelism(pipe) > 1.0:
+        M = pipe_microbatches(batch_size)
+        t *= (M + pipe - 1) / (M * pipe)
+    return t
+
+
+def _resolve_workers(config: Optional[FFConfig], n_candidates: int) -> int:
+    """config.search_num_workers: 0 = auto (min(cpu_count, candidates),
+    serial below 4 candidates where pool overhead beats the win),
+    1 = the historical serial path, N = exactly N workers."""
+    w = getattr(config, "search_num_workers", 0) if config is not None else 0
+    if not w:
+        if n_candidates < 4:
+            return 1
+        w = min(os.cpu_count() or 1, n_candidates)
+    return max(1, int(w))
+
+
+# fork-inherited context for pool workers: the parent stores the wave's
+# work items + merged memo here right before creating each wave's Pool;
+# forked children read it from their copy-on-write memory image, so no
+# Layer/Tensor/FFModel object is ever pickled (Tensors hold a backref to
+# the whole FFModel). Only candidate indices go down and only
+# (index, result-sans-layers, memo-delta) comes back.
+_FORK_CTX: Optional[dict] = None
+# flipped after any pool failure (missing fork, crash, deadlock timeout):
+# every later search in this process stays serial instead of re-paying
+# the failure
+_PARALLEL_BROKEN = False
+
+
+# the worker's own persistent OpCostModel (one per pool process): created
+# on its first task from the fork-time memo, then grown by the per-task
+# deltas — so the parent ships every memo entry AT MOST ONCE per pool
+# instead of re-pickling the whole since-fork history for every task
+_WORKER_CM: Optional[OpCostModel] = None
+
+
+def _pool_eval(args):
+    """Worker body: evaluate ONE candidate on this worker's persistent
+    OpCostModel (seeded fork-time memo + the parent's incremental deltas),
+    and ship the entries THIS evaluation added back for the parent to
+    merge. A worker that missed an earlier wave's delta only recomputes —
+    memo entries are a pure function of their key, never a correctness
+    input."""
+    global _WORKER_CM
+    idx, delta = args
+    ctx = _FORK_CTX
+    item = ctx["items"][idx]
+    if _WORKER_CM is None:
+        _WORKER_CM = OpCostModel(ctx["machine"])
+        _WORKER_CM.merge_memo(ctx["memo"])
+    _WORKER_CM.merge_memo(delta)
+    baseline = set(_WORKER_CM._cache)
+    r = _evaluate_candidate(
+        item["vlayers"], item["shape"], ctx["input_tensors"],
+        ctx["machine"], ctx["config"], ctx["beam_width"], _WORKER_CM,
+        ctx["budget"])
+    return idx, r, _WORKER_CM.memo_delta(baseline)
+
+
+def _make_pool(items, memo, machine, config, beam_width, input_tensors,
+               budget, workers):
+    """Fork ONE worker pool for the whole search. The work context
+    (items, machine, memo-at-fork, ...) travels into the children through
+    fork's copy-on-write memory image — no Layer/Tensor/FFModel object is
+    ever pickled (Tensors hold a backref to the whole FFModel); tasks
+    carry only (candidate-index, memo-delta-since-fork) down and
+    (index, result-sans-layers, memo-delta) back. Returns None when fork
+    is unavailable or pool creation fails."""
+    global _FORK_CTX
+    import multiprocessing as mp
+    import warnings
+
+    if "fork" not in mp.get_all_start_methods():
+        return None
+    _FORK_CTX = dict(items=items, memo=memo, machine=machine, config=config,
+                     beam_width=beam_width, input_tensors=list(input_tensors),
+                     budget=budget)
+    try:
+        with warnings.catch_warnings():
+            # jax warns on os.fork(); the children run only the pure-
+            # Python cost model, never XLA, and a worker deadlock is
+            # bounded by the per-wave get() timeout (then: serial
+            # fallback)
+            warnings.simplefilter("ignore", RuntimeWarning)
+            return mp.get_context("fork").Pool(workers)
+    except Exception:
+        return None
+    finally:
+        # children captured the context at fork; the parent drops it so a
+        # failed/finished search never pins model graphs alive
+        _FORK_CTX = None
+
+
 def full_search(
     layers: List[Layer],
     input_tensors: Sequence[Tensor],
@@ -378,6 +620,8 @@ def full_search(
     mesh_shapes: Optional[List[Dict[str, int]]] = None,
     max_pipe: Optional[int] = None,
     protected: Optional[frozenset] = None,
+    num_workers: Optional[int] = None,
+    prune: Optional[bool] = None,
 ) -> GraphSearchResult:
     """Outer loop over mesh shapes × inner DP (reference: the top-level
     try_one_lambda / machine-mapping enumeration in graph_optimize_task).
@@ -390,26 +634,50 @@ def full_search(
     bounded graph variant runs the same mesh × DP enumeration, so a
     rewritten graph wins exactly when its simulated step time is lower —
     the reference's best-first search over GraphXfer-derived graphs
-    (substitution.cc:1898) collapsed onto the variant loop."""
+    (substitution.cc:1898) collapsed onto the variant loop.
+
+    The (variant × mesh-shape) candidates are independent work items:
+
+    * ``num_workers`` > 1 (default: ``config.search_num_workers``, auto =
+      ``min(os.cpu_count(), candidates)``) evaluates them on a forked
+      process pool in waves; each worker runs its own :class:`OpCostModel`
+      seeded with the parent's memo and ships its memo delta back, so
+      later waves reuse earlier waves' per-op costs. Selection folds
+      results in CANDIDATE-INDEX order with strict ``<`` comparisons —
+      bit-identical to the serial path by construction, never dependent
+      on completion order.
+    * ``prune`` (default: ``config.search_prune``) evaluates the pure-DP
+      baseline first and skips the inner DP for any candidate whose
+      optimistic lower bound (:func:`_shape_lower_bound` — compute only,
+      zero comm) already exceeds the incumbent × adoption margin. The
+      margin slack makes pruning provably selection-neutral (see the
+      bound's docstring); pruned counts are reported on the result so
+      coverage is never silently truncated.
+    """
     from ..ffconst import OpType
     from .graph_xfer import graph_variants
 
+    global _PARALLEL_BROKEN
     n = machine.num_devices()
     sample_parallel = config is None or config.enable_sample_parallel
-    memory_search = config is not None and config.perform_memory_search
     budget = _memory_budget(config, machine)
     overlap = config is None or config.search_overlap_backward_update
     # ONE memoized cost model across every mesh shape AND graph variant
     # (the reference keeps a single hash_to_operator_cost across the whole
     # optimize, simulator.h:750) — the memo key includes the full sharding
-    # signature, and shared subgraphs between variants hit the same entries
+    # signature, and shared subgraphs between variants hit the same entries.
+    # Pool workers seed their own model from this memo and their deltas are
+    # merged back between waves.
     cost_model = OpCostModel(machine)
     zero = config is not None and config.zero_optimizer
-    best: Optional[GraphSearchResult] = None
-    dp_best: Optional[GraphSearchResult] = None  # pure-DP baseline price
     xrewrites = getattr(config, "_graphxfer_rewrites", None) if config else None
     fusion = config is not None and config.perform_fusion
     n_orig_eff = _effective_layer_count(layers, fusion, protected)
+
+    # ---- candidate enumeration: identical order to the historical nested
+    # variant x mesh loop (selection ties break toward the LOWER index)
+    items: List[dict] = []
+    profiles: List[Optional[List[Tuple[float, float, bool]]]] = []
     for rewrites, vlayers in graph_variants(layers, config,
                                             rewrites=xrewrites,
                                             protected=protected):
@@ -434,6 +702,8 @@ def full_search(
                                                  min(n, vmax_pipe))
         else:
             vmesh_shapes = mesh_shapes
+        vprofile_idx = len(profiles)
+        profiles.append(None)  # computed lazily, only if pruning wants it
         for shape in vmesh_shapes:
             pipe = shape.get("pipe", 1)
             # caller-pinned shapes skip the auto-enumeration's pipe bound:
@@ -445,48 +715,158 @@ def full_search(
             if (mesh_shapes is not None and pipe > 1 and n_var_eff < pipe
                     and n_orig_eff >= pipe):
                 continue
-            axis_sizes = {a: s for a, s in shape.items() if a != "pipe"}
-            # ZeRO-1 shards optimizer state over the data axis: the
-            # per-device footprint the memory prune charges shrinks by the
-            # data degree
-            opt_mult = 2.0 / shape.get("data", 1) if zero else 2.0
-            sim = Simulator(machine, cost_model, overlap_grad_sync=overlap,
-                            optimizer_state_mult=opt_mult)
-            input_pshapes = data_parallel_input_pshapes(
-                input_tensors, axis_sizes, sample_parallel)
-            # each pipe stage holds only ~1/P of the model, so both the
-            # hard HBM prune and the memory budget scale by the stage
-            # count — pipelining's primary use case is exactly the model
-            # that does NOT fit unsplit
-            cap = machine.chip.hbm_capacity * pipe
-            try:
-                if memory_search:
-                    r = memory_aware_search(
-                        vlayers, input_pshapes, axis_sizes, sim, config,
-                        beam_width, memory_budget=budget * pipe,
-                        memory_cap=cap)
-                    if r.est_memory > budget * pipe:
-                        continue
+            items.append(dict(rewrites=rewrites, vlayers=vlayers, shape=shape,
+                              profile_idx=vprofile_idx))
+
+    do_prune = prune if prune is not None else (
+        config is None or getattr(config, "search_prune", True))
+    margin = adoption_margin(config, machine)
+    incumbent: Optional[float] = None
+    pruned_count = 0
+    memory_search = config is not None and config.perform_memory_search
+    if do_prune and mesh_shapes is None and not memory_search:
+        # pure-DP baseline first (cheap: ONE candidate per layer) — it
+        # seeds the memo and prices the incumbent the per-shape lower
+        # bounds prune against. Only sound when the {data: n} mesh is
+        # genuinely in the candidate set (auto enumeration always includes
+        # it; a caller-pinned mesh list may not) and no memory budget can
+        # reject candidates this baseline never checked — otherwise the
+        # incumbent starts at None and builds from folded results, which
+        # are real candidates by definition.
+        try:
+            sim0 = Simulator(machine, cost_model, overlap_grad_sync=overlap,
+                             optimizer_state_mult=(2.0 / n if zero else 2.0))
+            base_ps = data_parallel_input_pshapes(
+                input_tensors, {"data": n}, sample_parallel)
+            r0 = graph_optimize(layers, base_ps, {"data": n}, sim0, config,
+                                beam_width,
+                                memory_cap=machine.chip.hbm_capacity,
+                                dp_only=True)
+            incumbent = r0.est_step_time
+        except RuntimeError:
+            incumbent = None
+    prof_cache_done = [False] * len(profiles)
+
+    best: Optional[GraphSearchResult] = None
+    dp_best: Optional[GraphSearchResult] = None  # pure-DP baseline price
+
+    def fold(idx: int, r: Optional[GraphSearchResult]) -> None:
+        """Selection, in candidate-index order — the historical loop body."""
+        nonlocal best, dp_best, incumbent
+        if r is None:
+            return
+        item = items[idx]
+        if item["rewrites"]:
+            r.rewrites = list(item["rewrites"])
+            r.layers = item["vlayers"]
+        if not _is_sharded_result(r) and (
+                dp_best is None
+                or r.est_step_time < dp_best.est_step_time):
+            dp_best = r
+        if best is None or r.est_step_time < best.est_step_time:
+            best = r
+        if incumbent is None or r.est_step_time < incumbent:
+            incumbent = r.est_step_time
+
+    def should_prune(item: dict) -> bool:
+        if not do_prune or incumbent is None:
+            return False
+        pi = item["profile_idx"]
+        if not prof_cache_done[pi]:
+            profiles[pi] = _variant_profile(item["vlayers"])
+            prof_cache_done[pi] = True
+        b = _shape_lower_bound(profiles[pi], item["shape"], machine,
+                               config.batch_size if config else None)
+        # the margin slack keeps pruning selection-neutral: a skipped
+        # candidate's true cost exceeds incumbent*margin, so it can be
+        # neither the winner nor the DP baseline an adoption-margin
+        # demotion would ship
+        return b is not None and b > incumbent * margin
+
+    workers = (max(1, int(num_workers)) if num_workers
+               else _resolve_workers(config, len(items)))
+    if _PARALLEL_BROKEN:
+        workers = 1
+    import multiprocessing as mp
+
+    pool = None
+    # memo keys already delivered to the pool (at fork or in an earlier
+    # wave's delta): each entry ships at most once per pool
+    sent_keys: set = set()
+    workers_used = 1  # what the evaluation actually ran with (observability)
+    if workers > 1 and len(items) > 1:
+        sent_keys = set(cost_model._cache)
+        pool = _make_pool(items, cost_model.export_memo(), machine, config,
+                          beam_width, input_tensors, budget, workers)
+        if pool is None:
+            _PARALLEL_BROKEN = True
+            workers = 1
+        else:
+            workers_used = workers
+
+    def eval_serial(j: int) -> None:
+        fold(j, _evaluate_candidate(
+            items[j]["vlayers"], items[j]["shape"], input_tensors,
+            machine, config, beam_width, cost_model, budget))
+
+    try:
+        i = 0
+        while i < len(items):
+            if pool is not None:
+                # one WAVE of candidates per pool round-trip: results fold
+                # in index order between waves, so pruning sees a fresh
+                # incumbent and every wave reuses all earlier per-op costs
+                wave: List[int] = []
+                while i < len(items) and len(wave) < workers:
+                    if should_prune(items[i]):
+                        pruned_count += 1
+                    else:
+                        wave.append(i)
+                    i += 1
+                if not wave:
+                    continue
+                # incremental delta: only entries not yet shipped to the
+                # pool (each worker's persistent model accumulates them)
+                delta = cost_model.memo_delta(sent_keys)
+                try:
+                    out = pool.map_async(
+                        _pool_eval, [(j, delta) for j in wave]
+                    ).get(timeout=60.0 + 30.0 * len(wave))
+                except Exception as e:
+                    # pool failed: finish serially — correctness never
+                    # depends on the pool. A TIMEOUT may just be a wave
+                    # slower than the (wave-scaled) allowance, so it
+                    # disables the pool for THIS search only; structural
+                    # failures (crash, unpicklable result) poison the
+                    # process-wide flag so later searches skip the pool
+                    pool.terminate()
+                    pool.join()
+                    pool = None
+                    workers_used = 1
+                    if not isinstance(e, mp.TimeoutError):
+                        _PARALLEL_BROKEN = True
+                    if config is not None and getattr(config, "profiling",
+                                                      False):
+                        print("[search] worker pool failed "
+                              f"({type(e).__name__}); continuing serial",
+                              flush=True)
+                    for j in wave:
+                        eval_serial(j)
                 else:
-                    r = graph_optimize(
-                        vlayers, input_pshapes, axis_sizes, sim, config,
-                        beam_width, memory_cap=cap,
-                    )
-            except RuntimeError:
-                continue
-            if pipe > 1:
-                r = _pipe_adjusted(r, vlayers, pipe, machine,
-                                   config.batch_size if config else None,
-                                   fused=fusion)
-            if rewrites:
-                r.rewrites = list(rewrites)
-                r.layers = vlayers
-            if not _is_sharded_result(r) and (
-                    dp_best is None
-                    or r.est_step_time < dp_best.est_step_time):
-                dp_best = r
-            if best is None or r.est_step_time < best.est_step_time:
-                best = r
+                    sent_keys.update(delta)
+                    for j, r, d in sorted(out, key=lambda t: t[0]):
+                        cost_model.merge_memo(d)
+                        fold(j, r)
+            else:
+                if should_prune(items[i]):
+                    pruned_count += 1
+                else:
+                    eval_serial(i)
+                i += 1
+    finally:
+        if pool is not None:
+            pool.terminate()
+            pool.join()
     if best is None:
         raise RuntimeError("no feasible mesh/strategy found")
     # adoption margin: a non-DP winner must beat the DP baseline by more
@@ -498,6 +878,9 @@ def full_search(
             and best.est_step_time * adoption_margin(config, machine)
             > dp_best.est_step_time):
         best = dp_best
+    best.candidates = len(items)
+    best.pruned = pruned_count
+    best.workers = workers_used
     return best
 
 

@@ -117,12 +117,35 @@ BWD_FACTORS: Dict[OpType, float] = {
 }
 
 
+# process-wide measure() counter: the strategy-cache tests assert a warm
+# recompile runs the search with ZERO cost-model queries (the honest
+# definition of "the search was skipped"); tools/search_bench.py reads it
+# for its report. Reset by assigning 0.
+MEASURE_CALLS = 0
+
+# cost-model fingerprint folded into the persistent strategy-cache key
+# (search/cache.py): BUMP THIS whenever the pricing here or in
+# sim/simulator.py changes (BWD_FACTORS, roofline terms, collective
+# costs, ...) so cached plans selected under the old model re-search
+# instead of being served forever.
+COST_MODEL_VERSION = 1
+
+
 class OpCostModel:
     """Analytic roofline cost, memoized.
 
     Backward time is forward time scaled by a per-op-family factor
     (``BWD_FACTORS``); unlisted ops default to 2x when they carry weights
     (dgrad + wgrad) and 1x when weightless (one elementwise pass).
+
+    The memo is exportable/mergeable (:meth:`export_memo` /
+    :meth:`merge_memo`): parallel search workers each run their own
+    OpCostModel and ship their memo *delta* back to the parent, which
+    merges it so later search waves reuse earlier waves' per-op costs
+    (reference: the single hash_to_operator_cost shared across the whole
+    optimize, simulator.h:750 — here shared across processes by exchange
+    instead of by pointer). Merging never changes results — entries are a
+    pure function of their key — only how much work is recomputed.
     """
 
     BWD_FACTOR = 2.0  # legacy default for unlisted weighted ops
@@ -130,6 +153,7 @@ class OpCostModel:
     def __init__(self, machine: MachineModel):
         self.machine = machine
         self._cache: Dict[Tuple, CostMetrics] = {}
+        self.calls = 0  # measure() invocations on THIS instance
 
     def bwd_factor(self, op: Op) -> float:
         f = BWD_FACTORS.get(op.op_type)
@@ -138,6 +162,9 @@ class OpCostModel:
         return self.BWD_FACTOR if op.weight_shapes else 1.0
 
     def measure(self, op: Op) -> CostMetrics:
+        global MEASURE_CALLS
+        MEASURE_CALLS += 1
+        self.calls += 1
         key = _op_strategy_key(op)
         hit = self._cache.get(key)
         if hit is not None:
@@ -145,6 +172,23 @@ class OpCostModel:
         cm = self._measure_uncached(op)
         self._cache[key] = cm
         return cm
+
+    # -- memo exchange (parallel search workers <-> parent) ------------------
+    def export_memo(self) -> Dict[Tuple, CostMetrics]:
+        """Snapshot of the memo (shallow copy; CostMetrics are treated as
+        immutable by every consumer)."""
+        return dict(self._cache)
+
+    def memo_delta(self, baseline_keys) -> Dict[Tuple, CostMetrics]:
+        """Entries added since ``baseline_keys`` (a set of memo keys) —
+        what a search worker ships back to the parent."""
+        return {k: v for k, v in self._cache.items() if k not in baseline_keys}
+
+    def merge_memo(self, delta: Dict[Tuple, CostMetrics]) -> None:
+        """Adopt entries computed elsewhere (keys are self-describing: op
+        type + attrs + full sharding signature, so entries transfer between
+        instances built over the SAME machine model)."""
+        self._cache.update(delta)
 
     # -- hooks a subclass can override ---------------------------------------
     def _forward_time(self, op: Op, flops_per_dev: float, bytes_per_dev: float) -> float:

@@ -80,6 +80,12 @@ def _collective_axes(op: Op) -> Tuple[List[Tuple[str, int, str]], int]:
     return [("%s" % a, deg, "allreduce") for a, deg in found.items()], out_bytes
 
 
+# process-wide simulate_runtime counter (companion to
+# cost_model.MEASURE_CALLS): the strategy-cache tests assert a warm
+# recompile runs ZERO full-step simulations. Reset by assigning 0.
+SIM_RUNS = 0
+
+
 class Simulator:
     """Estimates one training-step time for an op graph + strategy.
 
@@ -307,6 +313,8 @@ class Simulator:
         native event engine (native/src/sim_engine.cc, the reference's
         event-driven TaskManager loop) when built, with compute and
         network on separate lanes; pure-Python fallback otherwise."""
+        global SIM_RUNS
+        SIM_RUNS += 1
         tasks = self.build_task_graph(ops)
         self._last_tasks = tasks  # exposed for --taskgraph export
         bwd_total = sum(t.run_time for t in tasks if t.kind == "bwd")

@@ -18,10 +18,15 @@ EOF
   sleep 60
 done
 python scripts/osdi_ae/merge_ae.py AE_r05.json AE_r05_fix.json || exit 1
+# gate on pytest's exit code, not a grepped pass-count: the old
+# `grep -q "3 passed"` failed OPEN once the file count grew (matching
+# "13 passed" with failures present) and could not tell a skipped
+# calibration test from a pass. Exit code 0 + zero skips is the real gate.
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
   python -m pytest tests/test_ae_protocol.py \
-  tests/test_shared_host_calibration.py -q >/tmp/ae_gate_result.txt 2>&1
-grep -q "3 passed" /tmp/ae_gate_result.txt || exit 1
+  tests/test_shared_host_calibration.py -q -rs >/tmp/ae_gate_result.txt 2>&1 \
+  || exit 1
+grep -qE "[0-9]+ skipped" /tmp/ae_gate_result.txt && exit 1
 git ls-files --error-unmatch AE_r05.json >/dev/null 2>&1 && exit 0
 git add AE_r05.json CALIBRATION.md tests/test_shared_host_calibration.py \
   scripts/fit_shared_host.py scripts/osdi_ae/finalize_r05.sh

@@ -12,26 +12,49 @@ fields must agree (same protocol parameters) or the merge refuses.
 Usage: python scripts/osdi_ae/merge_ae.py AE_r05.json AE_r05_fix.json
 """
 
+import datetime
 import json
 import sys
 
 
 def main(base_path: str, fix_path: str) -> int:
-    with open(base_path) as f:
-        base = json.load(f)
-    with open(fix_path) as f:
-        fix = json.load(f)
+    def load(path):
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"refusing to merge: cannot read {path} as JSON ({e})")
+            return None
+
+    base = load(base_path)
+    fix = load(fix_path)
+    if base is None or fix is None:
+        return 1
+    # a truncated / hand-edited artifact without a results table must be
+    # refused with a diagnosis, not a KeyError traceback
+    for label, doc, path in (("base", base, base_path),
+                             ("fix", fix, fix_path)):
+        if not isinstance(doc.get("results"), dict):
+            print(f"refusing to merge: {label} artifact {path} has no "
+                  f"'results' table (not a run_ae.py output?)")
+            return 1
     for key in ("devices", "budget", "epochs", "batch_size", "repeats",
                 "playoff_steps"):
         if base.get(key) != fix.get(key):
             print(f"refusing to merge: {key} differs "
                   f"({base.get(key)!r} vs {fix.get(key)!r})")
             return 1
+    merged_at = datetime.datetime.now(datetime.timezone.utc).isoformat(
+        timespec="seconds")
     for name, row in fix["results"].items():
         if "error" in row and "error" not in base["results"].get(name, {}):
             print(f"refusing to replace a good row with an error: {name}")
             return 1
         prev = base["results"].get(name)
+        # stamp when THIS row was folded in, so a merged artifact records
+        # which legs are re-measurements and from when
+        row = dict(row)
+        row["merged_at"] = merged_at
         base["results"][name] = row
         print(f"merged {name}: "
               f"{'error' if 'error' in row else round(row['speedup'], 3)}"

@@ -441,6 +441,134 @@ def test_networked_machine_model_drives_search(tmp_path):
     assert r.est_step_time > 0 and r.strategies
 
 
+def _bit_identical(r1, r2):
+    return (r1.strategies == r2.strategies
+            and r1.mesh_shape == r2.mesh_shape
+            and r1.est_step_time == r2.est_step_time
+            and r1.rewrites == r2.rewrites)
+
+
+def test_parallel_full_search_bit_identical_mlp_dlrm():
+    """workers=4 must pick the identical strategy + mesh + est_step_time
+    as the serial path on mlp and dlrm (deterministic candidate-index
+    tie-break, never completion order)."""
+    from flexflow_tpu.models.dlrm import DLRMConfig, build_dlrm
+    from flexflow_tpu.models.mlp import build_mlp
+
+    machine = SimpleMachineModel(CHIP_PRESETS["test"], 8)
+    cfg = FFConfig(batch_size=64, search_budget=1)
+
+    ff = FFModel(FFConfig(batch_size=64))
+    build_mlp(ff, 64)
+    inputs = [ff.layers[0].inputs[0]]
+    r1 = full_search(ff.layers, inputs, machine, cfg, num_workers=1)
+    r4 = full_search(ff.layers, inputs, machine, cfg, num_workers=4)
+    assert _bit_identical(r1, r4), (r1.mesh_shape, r4.mesh_shape)
+
+    ff = FFModel(FFConfig(batch_size=64))
+    build_dlrm(ff, 64, DLRMConfig(embedding_size=[1000] * 4))
+    inputs = [t for l in ff.layers for t in l.inputs
+              if t.owner_layer is None]
+    seen, uniq = set(), []
+    for t in inputs:
+        if t.tensor_id not in seen:
+            seen.add(t.tensor_id)
+            uniq.append(t)
+    r1 = full_search(ff.layers, uniq, machine, cfg, num_workers=1)
+    r4 = full_search(ff.layers, uniq, machine, cfg, num_workers=4)
+    assert _bit_identical(r1, r4), (r1.mesh_shape, r4.mesh_shape)
+
+
+def test_parallel_full_search_bit_identical_rewritten_graph():
+    """Same guarantee on a model whose search space includes graph-xfer
+    rewritten variants (separate dense->relu chains fuse)."""
+    cfg = FFConfig(batch_size=32, search_budget=1)
+    ff = FFModel(cfg)
+    x = ff.create_tensor((32, 256), DataType.FLOAT, name="x")
+    h = x
+    for i in range(3):
+        h = ff.dense(h, 256, name=f"fc{i}")
+        h = ff.relu(h, name=f"relu{i}")
+    ff.dense(h, 8, name="head")
+    from flexflow_tpu.search.graph_xfer import graph_variants
+
+    assert len(graph_variants(ff.layers, cfg)) > 1  # a rewrite exists
+    machine = SimpleMachineModel(CHIP_PRESETS["test"], 8)
+    r1 = full_search(ff.layers, [x], machine, cfg, num_workers=1)
+    r4 = full_search(ff.layers, [x], machine, cfg, num_workers=4)
+    assert _bit_identical(r1, r4)
+
+
+def test_bound_pruning_is_selection_neutral_and_counted():
+    """Bound-based mesh pruning must never change the selected strategy
+    (margin-slack proof in unity._shape_lower_bound) and its counts must
+    land on the result for the profiling export."""
+    from flexflow_tpu.models.mlp import build_mlp
+
+    machine = SimpleMachineModel(CHIP_PRESETS["test"], 8)
+    cfg = FFConfig(batch_size=256, search_budget=1)
+    ff = FFModel(FFConfig(batch_size=256))
+    # deep chain: pipe-8 candidates exist and their compute-only bound
+    # exceeds the DP incumbent, so the prune genuinely fires
+    build_mlp(ff, 256, hidden_dims=(1024,) * 16)
+    inputs = [ff.layers[0].inputs[0]]
+    r_p = full_search(ff.layers, inputs, machine, cfg, prune=True,
+                      num_workers=1)
+    r_n = full_search(ff.layers, inputs, machine, cfg, prune=False,
+                      num_workers=1)
+    assert _bit_identical(r_p, r_n)
+    assert r_p.candidates == r_n.candidates > 0
+    assert r_p.pruned >= 1, r_p.pruned  # coverage accounting, never silent
+    assert r_n.pruned == 0
+
+    # neutrality on an AE-set workload shape (dlrm: embedding towers +
+    # interaction MLPs — the parameter-parallel family)
+    from flexflow_tpu.models.dlrm import DLRMConfig, build_dlrm
+
+    ff = FFModel(FFConfig(batch_size=64))
+    build_dlrm(ff, 64, DLRMConfig(embedding_size=[1000] * 4))
+    seen, uniq = set(), []
+    for l in ff.layers:
+        for t in l.inputs:
+            if t.owner_layer is None and t.tensor_id not in seen:
+                seen.add(t.tensor_id)
+                uniq.append(t)
+    cfg = FFConfig(batch_size=64, search_budget=1)
+    r_p = full_search(ff.layers, uniq, machine, cfg, prune=True,
+                      num_workers=1)
+    r_n = full_search(ff.layers, uniq, machine, cfg, prune=False,
+                      num_workers=1)
+    assert _bit_identical(r_p, r_n)
+
+
+def test_search_profile_records_counters(tmp_path):
+    """FFModel.compile records the search profile and the JSON task-graph
+    export carries it (pruned counts are part of the observability
+    surface, not just a log line)."""
+    import json
+
+    cfg = FFConfig(batch_size=32, search_budget=1,
+                   mesh_shape={"data": 2, "model": 4})
+    ff = FFModel(cfg)
+    x = ff.create_tensor((32, 64), DataType.FLOAT, name="x")
+    h = ff.dense(x, 128, name="fc1")
+    ff.dense(h, 8, name="fc2")
+    ff.compile(SGDOptimizer(ff, 0.05),
+               LossType.SPARSE_CATEGORICAL_CROSSENTROPY, [])
+    prof = ff.search_profile
+    assert prof is not None
+    assert prof["cache"] == "off"
+    assert prof["candidates"] >= 1
+    assert prof["pruned"] >= 0
+    assert prof["search_time_s"] > 0
+    path = tmp_path / "tasks.json"
+    ff.export_task_graph(str(path), fmt="json")
+    payload = json.loads(path.read_text())
+    assert "search" in payload
+    assert payload["search"]["pruned"] == prof["pruned"]
+    assert payload["search"]["candidates"] == prof["candidates"]
+
+
 def test_spatial_candidate_profitability_gate():
     """Spatial (H) conv partitioning is the small-batch/large-image tool
     (reference: substitution.cc:87-95): when the batch dim shards

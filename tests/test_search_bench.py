@@ -1,0 +1,32 @@
+"""tools/search_bench.py smoke: the tier-1 invocation (tiny model,
+workers=2) runs in-process and emits every field of its one-line JSON
+contract. The bench itself asserts parallel-vs-serial bit-identity and
+the zero-cost-model-calls warm-cache property before reporting."""
+
+import importlib.util
+import os
+
+_TOOL = os.path.join(os.path.dirname(__file__), os.pardir, "tools",
+                     "search_bench.py")
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location("search_bench", _TOOL)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_search_bench_smoke():
+    sb = _load()
+    out = sb.run_bench(workers=2, towers=2, depth=2, dim=128, batch=32)
+    for key in ("serial_s", "parallel_s", "cached_s", "candidates",
+                "pruned", "workers", "speedup"):
+        assert key in out, key
+    assert out["candidates"] > 0
+    assert out["pruned"] >= 0
+    assert out["serial_s"] > 0 and out["parallel_s"] > 0
+    # a warm cache load must not touch the cost model at all, and must be
+    # far cheaper than the search it replaces
+    assert out["measure_calls_cached"] == 0
+    assert out["cached_s"] < out["serial_s"]

@@ -1,0 +1,183 @@
+"""Persistent strategy cache (search/cache.py): hit/miss/refresh flow
+through FFModel.compile, key invalidation on graph/machine/knob changes,
+and the zero-cost-model-queries guarantee on a warm recompile."""
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import (DataType, FFConfig, FFModel, LossType,
+                         SGDOptimizer)
+from flexflow_tpu.search.cache import (load_payload, result_from_payload,
+                                       store_result, strategy_cache_key)
+from flexflow_tpu.sim import CHIP_PRESETS, SimpleMachineModel
+from flexflow_tpu.sim import cost_model as cost_model_mod
+from flexflow_tpu.sim import simulator as simulator_mod
+
+
+def _build(cfg, out_dim=128):
+    ff = FFModel(cfg)
+    x = ff.create_tensor((32, 64), DataType.FLOAT, name="x")
+    h = ff.dense(x, out_dim, name="fc1")
+    h = ff.relu(h, name="act")
+    ff.dense(h, 8, name="fc2")
+    return ff
+
+
+def _cfg(tmp_path, mode="on"):
+    return FFConfig(batch_size=32, search_budget=1,
+                    mesh_shape={"data": 2, "model": 4},
+                    search_cache=mode,
+                    search_cache_dir=str(tmp_path / "strategies"))
+
+
+def _compile(ff):
+    ff.compile(SGDOptimizer(ff, 0.05),
+               LossType.SPARSE_CATEGORICAL_CROSSENTROPY, [])
+
+
+def test_cache_miss_then_hit_zero_cost_model_calls(tmp_path):
+    """First compile misses and stores; a recompile of the SAME model hits
+    and runs the search with ZERO cost-model queries (the acceptance
+    criterion's definition of a free recompile)."""
+    cfg = _cfg(tmp_path)
+    ff = _build(cfg)
+    _compile(ff)
+    assert ff.search_profile["cache"] == "miss"
+    first = dict(ff.search_result.strategies)
+    files = os.listdir(cfg.search_cache_dir)
+    assert len(files) == 1 and files[0].endswith(".json")
+
+    cost_model_mod.MEASURE_CALLS = 0
+    simulator_mod.SIM_RUNS = 0
+    _compile(ff)  # warm recompile: same FFModel, same config
+    assert ff.search_profile["cache"] == "hit"
+    assert cost_model_mod.MEASURE_CALLS == 0  # zero per-op cost queries
+    assert simulator_mod.SIM_RUNS == 0        # zero full-step simulations
+    assert ff.search_result.strategies == first
+    # the hit result still trains
+    X = np.random.default_rng(0).normal(size=(32, 64)).astype(np.float32)
+    Y = np.random.default_rng(1).integers(0, 8, size=(32, 1)).astype(np.int32)
+    assert len(ff.fit(X, Y, epochs=1, verbose=False)) == 1
+
+
+def test_cache_refresh_reruns_search_and_overwrites(tmp_path):
+    cfg = _cfg(tmp_path)
+    ff = _build(cfg)
+    _compile(ff)
+    path = os.path.join(cfg.search_cache_dir,
+                        os.listdir(cfg.search_cache_dir)[0])
+    before = os.stat(path).st_mtime_ns
+
+    cfg.search_cache = "refresh"
+    cost_model_mod.MEASURE_CALLS = 0
+    _compile(ff)
+    assert ff.search_profile["cache"] == "refresh"
+    assert cost_model_mod.MEASURE_CALLS > 0  # the search really re-ran
+    assert os.stat(path).st_mtime_ns >= before
+
+
+def test_cache_off_never_touches_disk(tmp_path):
+    cfg = _cfg(tmp_path, mode="off")
+    ff = _build(cfg)
+    _compile(ff)
+    assert ff.search_profile["cache"] == "off"
+    assert not os.path.exists(cfg.search_cache_dir)
+
+
+def test_key_invalidation_layer_attr_machine_and_knob():
+    """The SHA-256 key must move when a layer attr, the machine, or a
+    search-relevant config knob changes — and must NOT move on
+    performance-only knobs (workers / prune / cache mode)."""
+    machine = SimpleMachineModel(CHIP_PRESETS["test"], 8)
+    cfg = FFConfig(batch_size=32, search_budget=1)
+
+    def key(ff=None, m=machine, c=cfg):
+        ff = ff or _build(cfg)
+        x = ff.layers[0].inputs[0]
+        return strategy_cache_key(ff.layers, [x], m, c)
+
+    base = key(_build(cfg))
+    # deterministic across rebuilds of the same graph (tensor/layer ids
+    # are remapped to dense local indices)
+    assert key(_build(cfg)) == base
+    # layer attr change
+    assert key(_build(cfg, out_dim=256)) != base
+    # machine change: different chip, and different device count
+    assert key(m=SimpleMachineModel(CHIP_PRESETS["v4"], 8)) != base
+    assert key(m=SimpleMachineModel(CHIP_PRESETS["test"], 4)) != base
+    # search-relevant knob change
+    c2 = dataclasses.replace(cfg, enable_sample_parallel=False)
+    assert key(c=c2) != base
+    c3 = dataclasses.replace(cfg, batch_size=64)
+    assert key(c=c3) != base
+    # performance-only knobs do NOT invalidate (results transfer)
+    c4 = dataclasses.replace(cfg, search_num_workers=7, search_prune=False,
+                             search_cache="refresh")
+    assert key(c=c4) == base
+    # a different protected/logits choice is a different search problem
+    ff = _build(cfg)
+    x = ff.layers[0].inputs[0]
+    k_head = strategy_cache_key(
+        ff.layers, [x], machine, cfg,
+        protected=frozenset({ff.layers[-1].outputs[0].tensor_id}))
+    k_mid = strategy_cache_key(
+        ff.layers, [x], machine, cfg,
+        protected=frozenset({ff.layers[0].outputs[0].tensor_id}))
+    assert k_head != k_mid
+
+
+def test_store_load_roundtrip_and_stale_rejection(tmp_path):
+    """result_from_payload rehydrates a stored result against the current
+    graph and rejects strategies that no longer cover its layer names."""
+    from flexflow_tpu.search.unity import full_search
+
+    cfg = FFConfig(batch_size=32, search_budget=1)
+    ff = _build(cfg)
+    x = ff.layers[0].inputs[0]
+    machine = SimpleMachineModel(CHIP_PRESETS["test"], 8)
+    r = full_search(ff.layers, [x], machine, cfg, num_workers=1)
+    key = strategy_cache_key(ff.layers, [x], machine, cfg)
+    store_result(str(tmp_path), key, r)
+
+    payload = load_payload(str(tmp_path), key)
+    assert payload is not None
+    back = result_from_payload(payload, ff.layers, cfg)
+    assert back is not None
+    assert back.strategies == r.strategies
+    assert back.mesh_shape == r.mesh_shape
+    assert back.est_step_time == r.est_step_time
+
+    # stale payload: strategies name a layer this graph doesn't have
+    stale = dict(payload)
+    stale["strategies"] = {"no_such_layer": {"out": "model"}}
+    assert result_from_payload(stale, ff.layers, cfg) is None
+
+    # corrupt file and wrong key are clean misses, not crashes
+    path = os.path.join(str(tmp_path), f"{key}.json")
+    with open(path, "w") as f:
+        f.write("{not json")
+    assert load_payload(str(tmp_path), key) is None
+    assert load_payload(str(tmp_path), "0" * 64) is None
+
+
+def test_auto_mesh_search_hits_after_mesh_pinned(tmp_path):
+    """The auto-mesh path stores under BOTH the pre-search key and the
+    post-search (mesh-pinned) key: the first compile sets
+    config.mesh_shape, so the recompile keys the cache with the mesh
+    pinned and must still hit."""
+    cfg = FFConfig(batch_size=32, search_budget=1, search_cache="on",
+                   search_cache_dir=str(tmp_path / "strategies"))
+    assert cfg.mesh_shape is None
+    ff = _build(cfg)
+    _compile(ff)
+    assert ff.search_profile["cache"] == "miss"
+    assert cfg.mesh_shape is not None  # search pinned the mesh
+
+    cost_model_mod.MEASURE_CALLS = 0
+    _compile(ff)
+    assert ff.search_profile["cache"] == "hit"
+    assert cost_model_mod.MEASURE_CALLS == 0
