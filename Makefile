@@ -6,11 +6,13 @@
 #   make test     — full suite on the virtual 8-device CPU mesh
 #   make dryrun   — compile+run one training step per parallelism mode
 #   make bench    — the benchmark (real chip when present, CPU fallback)
+#   make bench-fit — step-loop overlap bench (prefetch / dispatch-ahead /
+#                    multi-step dispatch) on the e2e MLP; one JSON line
 
 PY ?= python
 CPU_MESH = JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-.PHONY: ci native native-check test dryrun bench
+.PHONY: ci native native-check test dryrun bench bench-fit
 
 ci: native native-check test dryrun
 
@@ -29,3 +31,6 @@ dryrun:
 
 bench:
 	$(PY) bench.py
+
+bench-fit:
+	$(CPU_MESH) $(PY) tools/fit_bench.py

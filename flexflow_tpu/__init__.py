@@ -62,7 +62,7 @@ from .runtime.initializer import (
     UniformInitializer,
     ZeroInitializer,
 )
-from .runtime.dataloader import DataLoaderGroup, SingleDataLoader
+from .runtime.dataloader import DataLoaderGroup, Prefetcher, SingleDataLoader
 from .runtime.guard import DivergenceError, TrainingGuard
 from .runtime.metrics import PerfMetrics
 

@@ -139,6 +139,33 @@ class FFConfig:
     # (lax.scan), and applies a single optimizer update — K x the
     # effective batch at 1/K the activation memory. No reference analog.
     grad_accum_steps: int = 1
+    # --- async input pipeline + dispatch-ahead step loop ------------------
+    # bounded background batch queue (runtime/dataloader.py Prefetcher): a
+    # worker thread assembles the next batches (shuffle-perm gather, cast,
+    # super-batch stacking) ahead of time, so host input work for step
+    # i+1 overlaps device compute for step i (the reference's
+    # ahead-of-compute copy tasks, dataloader.cc:232); placement stays on
+    # the dispatch thread, where the runtime's asynchronous device_put
+    # overlaps the transfer with compute on its own.
+    # 0 (default) = off — serial assembly on the critical path, the
+    # historical behavior; N>0 = queue depth (2 = double-buffered). Batch
+    # order and fit outputs are bit-identical to serial at any depth, so
+    # turning it on is purely a throughput decision: a win whenever host
+    # cores are free while the device computes (real accelerators), a
+    # loss on an oversubscribed CPU host where the worker thread and
+    # XLA's compute pool fight for the same cores — hence opt-in.
+    prefetch_depth: int = 0
+    # dispatch-ahead bound: fit/eval keep at most this many steps in
+    # flight before blocking on the oldest result (jax async dispatch does
+    # the overlap; the bound keeps dispatch queues and host memory sane).
+    max_inflight_steps: int = 2
+    # opt-in multi-step executable (runtime/compiler.py train_k_steps):
+    # fit() groups K consecutive batches into one stacked super-batch and
+    # runs them in ONE dispatch via lax.scan, amortizing per-dispatch
+    # overhead for small models. 1 = off. Requires no per-step hooks —
+    # fit falls back to K=1 when a recompile_state or the pipeline engine
+    # needs step granularity.
+    steps_per_dispatch: int = 1
     seed: int = 0
     # mesh description: axis names and sizes; None => 1-D data mesh over all
     # visible devices (reference analog: register_all_machine_views'
@@ -245,6 +272,12 @@ class FFConfig:
                 cfg.zero_optimizer = True
             elif a == "--grad-accum-steps":
                 cfg.grad_accum_steps = int(_next())
+            elif a == "--prefetch-depth":
+                cfg.prefetch_depth = int(_next())
+            elif a == "--max-inflight-steps":
+                cfg.max_inflight_steps = int(_next())
+            elif a == "--steps-per-dispatch":
+                cfg.steps_per_dispatch = int(_next())
             # unknown flags are ignored, matching the reference's tolerance
             i += 1
         return cfg

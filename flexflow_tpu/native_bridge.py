@@ -256,6 +256,11 @@ class NativeLoader:
     Shuffle permutations come from ``np.random.default_rng(seed)`` on the
     Python side (pushed via ``fftpu_loader_reset_with_perm``), so a run is
     bit-identical whether or not the native library is in use.
+
+    Single-consumer thread-safe: ``runtime/dataloader.py``'s Prefetcher
+    drives ``next_batch`` from its worker thread (the C++ side already
+    assembles one batch ahead on its own thread; the Python queue stacks
+    the ahead-of-compute device_put on top).
     """
 
     def __init__(self, arrays: Sequence[np.ndarray], batch_size: int,
@@ -291,6 +296,12 @@ class NativeLoader:
     @property
     def num_batches(self) -> int:
         return int(self._lib.fftpu_loader_num_batches(self._h))
+
+    @property
+    def batch_nbytes(self) -> int:
+        """Host bytes one batch gathers across all tensors (throughput
+        accounting — mirrors SingleDataLoader.batch_nbytes)."""
+        return sum(self._row_bytes) * self.batch_size
 
     def reset(self, reshuffle: bool = True) -> None:
         if self.shuffle and reshuffle:

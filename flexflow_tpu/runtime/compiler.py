@@ -64,6 +64,8 @@ class CompiledModel:
     input_shardings: List[NamedSharding]
     label_sharding: Optional[NamedSharding]
     train_step: Any
+    train_k_steps: Any  # multi-step executable (lax.scan super-batch);
+    #                     None when the model has no train step
     eval_step: Any
     forward_fn: Any
     grad_step: Any
@@ -504,6 +506,30 @@ def compile_model(
                                    new_params[opn][wn].dtype)}
         return new_params, new_opt_state, loss, batch_metrics
 
+    # ---- multi-step executable (dispatch-ahead amortization) ---------------
+    # K train steps in ONE dispatch: lax.scan of the step body over a
+    # stacked (k, batch, ...) super-batch + a (k,) rng-key vector. Each
+    # scan iteration is EXACTLY one train_step application (same params ->
+    # grads -> update chain), so K scanned steps are numerically
+    # equivalent to K serial dispatches; per-dispatch host/infeed overhead
+    # is paid once instead of K times (the small-step regime where
+    # dispatch dominates — Kaufman et al. 2020). Per-step losses AND
+    # per-step batch metrics come back stacked (k, ...) — the fit loop
+    # accumulates the metric slices in step order, so the reduction
+    # order (hence the reported trajectory, bit for bit) matches k
+    # serial dispatches.
+    def train_k_steps(seq_length, hyper, params, opt_state, rngs, *stacked):
+        def body(carry, per_step):
+            params_i, opt_i = carry
+            rng_i, batch_i = per_step[0], per_step[1:]
+            params_i, opt_i, loss_i, bm_i = train_step(
+                seq_length, hyper, params_i, opt_i, rng_i, *batch_i)
+            return (params_i, opt_i), (loss_i, bm_i)
+
+        (params, opt_state), (losses, bms) = jax.lax.scan(
+            body, (params, opt_state), (rngs,) + stacked)
+        return params, opt_state, losses, bms
+
     # ---- standalone grad step (for the manual backward() verb) ------------
     def grad_step(seq_length, params, rng, *batch):
         xs = batch[:n_inputs]
@@ -552,10 +578,16 @@ def compile_model(
         return call
 
     jit_train = None
+    jit_train_k = None
     jit_grad = None
     if optimizer is not None and loss_type is not None:
         jit_train = _wrap_train(
             jax.jit(train_step, static_argnums=0, donate_argnums=(2, 3)))
+        # one executable per distinct super size (the leading dim is part
+        # of the trace shape) — the Prefetcher's plan only uses power-of-
+        # two sizes up to k, so at most log2(k) entries compile
+        jit_train_k = _wrap_train(
+            jax.jit(train_k_steps, static_argnums=0, donate_argnums=(2, 3)))
         jit_grad = _wrap(jax.jit(grad_step, static_argnums=0))
     jit_eval = _wrap(jax.jit(eval_step, static_argnums=0))
     _jit_fwd = jax.jit(forward_fn, static_argnames=("seq_length",))
@@ -580,6 +612,7 @@ def compile_model(
         input_shardings=input_shardings,
         label_sharding=label_sharding,
         train_step=jit_train,
+        train_k_steps=jit_train_k,
         eval_step=jit_eval,
         forward_fn=jit_forward,
         grad_step=jit_grad,

@@ -10,16 +10,29 @@ Here the full dataset stays in host numpy (the zero-copy-DRAM analog);
 batch NamedSharding, so each device receives exactly its shard — the same
 per-device slicing the reference's copy tasks perform, but driven by the
 sharding instead of a task launch per device.
+
+:class:`Prefetcher` moves that host work off the device's critical path:
+a bounded background queue assembles the next batches (shuffle-perm
+gather, dtype cast, super-batch stacking) ahead of time, so host input
+work for step *i+1* overlaps compute for step *i* — the reference's
+ahead-of-compute Legion copy tasks (dataloader.cc:232); placement stays
+on the dispatch thread, whose asynchronous ``device_put`` overlaps the
+transfer with compute on its own. Batch ORDER is bit-identical to the
+serial loader at any depth: the worker is the group's only consumer and
+pulls batches in exactly the sequence the serial path would.
 """
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence
+import queue
+import threading
+import time
+from typing import Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 import jax
-from jax.sharding import NamedSharding
+from jax.sharding import NamedSharding, PartitionSpec
 
 
 def _put(batch: np.ndarray, sharding: Optional[NamedSharding]) -> jax.Array:
@@ -31,6 +44,15 @@ def _put(batch: np.ndarray, sharding: Optional[NamedSharding]) -> jax.Array:
     from ..parallel.multihost import process_local_batch
 
     return process_local_batch(batch, sharding)
+
+
+def _super_sharding(sharding: Optional[NamedSharding]) -> Optional[NamedSharding]:
+    """Sharding for a (k, batch, ...) super-batch: the per-step sharding
+    shifted one dim right, the stacked step dim replicated."""
+    if sharding is None:
+        return None
+    return NamedSharding(sharding.mesh,
+                         PartitionSpec(None, *tuple(sharding.spec)))
 
 
 class SingleDataLoader:
@@ -61,12 +83,20 @@ class SingleDataLoader:
     def num_batches(self) -> int:
         return self.num_samples // self.batch_size
 
+    @property
+    def batch_nbytes(self) -> int:
+        """Host bytes one batch moves (throughput accounting)."""
+        row = self.data.nbytes // max(1, self.num_samples)
+        return row * min(self.batch_size, self.num_samples)
+
     def reset(self) -> None:
         """reference: SingleDataLoader::reset."""
         self.next_index = 0
 
-    def next_batch(self) -> jax.Array:
-        """reference: next_batch_xd_launcher (dataloader.cc:232)."""
+    def next_batch_host(self) -> np.ndarray:
+        """Host-side batch assembly only (shuffle-perm gather); the
+        device_put half lives in :meth:`next_batch` so the Prefetcher can
+        stage both off the critical path."""
         i = self.next_index
         if i + self.batch_size > self.num_samples:
             i = 0
@@ -76,7 +106,11 @@ class SingleDataLoader:
         else:
             batch = self.data[i : i + self.batch_size]
         self.next_index = i + self.batch_size
-        return _put(batch, self.sharding)
+        return batch
+
+    def next_batch(self) -> jax.Array:
+        """reference: next_batch_xd_launcher (dataloader.cc:232)."""
+        return _put(self.next_batch_host(), self.sharding)
 
 
 class DataLoaderGroup:
@@ -88,7 +122,8 @@ class DataLoaderGroup:
     one-batch-ahead prefetch run on a C++ worker thread
     (native/src/dataloader.cc), overlapping host batch assembly with device
     step time — the reference's ahead-of-compute copy-task pattern. The
-    pure-numpy path below is the fallback.
+    pure-numpy path below is the fallback; :class:`Prefetcher` adds the
+    Python-level ahead-of-time queue over either.
     """
 
     def __init__(self, loaders: List[SingleDataLoader], seed: int = 0, shuffle: bool = False):
@@ -119,6 +154,12 @@ class DataLoaderGroup:
     def num_batches(self) -> int:
         return self.loaders[0].num_batches
 
+    @property
+    def batch_nbytes(self) -> int:
+        if self._native is not None:
+            return self._native.batch_nbytes
+        return sum(l.batch_nbytes for l in self.loaders)
+
     def reset(self, reshuffle: bool = True) -> None:
         if self._native is not None:
             self._native.reset(reshuffle)
@@ -130,14 +171,179 @@ class DataLoaderGroup:
             for l in self.loaders:
                 l.perm = perm
 
-    def next_batch(self) -> List[jax.Array]:
+    def next_batch_host(self) -> List[np.ndarray]:
+        """One batch per loader, still on host (numpy)."""
         if self._native is not None:
             rows = self._native.next_batch()
             if rows is None:  # epoch end: wrap like SingleDataLoader does
                 self._native.reset(reshuffle=False)
                 rows = self._native.next_batch()
-            return [
-                _put(np.asarray(r), l.sharding)
-                for r, l in zip(rows, self.loaders)
-            ]
-        return [l.next_batch() for l in self.loaders]
+            return [np.asarray(r) for r in rows]
+        return [l.next_batch_host() for l in self.loaders]
+
+    def assemble_host(self, k: int) -> List[np.ndarray]:
+        """Host half of a (super-)batch: gather ``k`` consecutive batches
+        and stack them on a leading step dim (k=1: no stack). This is
+        the work the Prefetcher's thread runs ahead of compute."""
+        if k <= 1:
+            return self.next_batch_host()
+        host = [self.next_batch_host() for _ in range(k)]
+        return [np.stack([h[i] for h in host])
+                for i in range(len(self.loaders))]
+
+    def place(self, host: List[np.ndarray], k: int) -> List[jax.Array]:
+        """Device half: one device_put per tensor, with the per-step
+        sharding shifted right for a stacked super-batch. device_put is
+        asynchronous on accelerator runtimes, so issuing it from the
+        dispatch thread already overlaps the transfer with compute —
+        and keeps it off the worker thread, where a concurrent transfer
+        contends with XLA's CPU execution locks."""
+        if k > 1:
+            return [_put(a, _super_sharding(l.sharding))
+                    for a, l in zip(host, self.loaders)]
+        return [_put(a, l.sharding) for a, l in zip(host, self.loaders)]
+
+    def next_batch(self) -> List[jax.Array]:
+        return self.place(self.next_batch_host(), 1)
+
+    def next_super_batch(self, k: int) -> List[jax.Array]:
+        """``k`` consecutive batches stacked on a new leading step dim —
+        the input of the multi-step executable (compiler.train_k_steps)."""
+        return self.place(self.assemble_host(k), k)
+
+
+# ------------------------------------------------------------- prefetching
+class _WorkerError:
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+_DONE = object()
+
+
+class Prefetcher:
+    """Bounded ahead-of-compute batch pipeline over a DataLoaderGroup.
+
+    ``depth == 0``: serial passthrough — assembly + placement inline on
+    the caller's thread, the historical fit-loop behavior. ``depth > 0``:
+    a daemon worker thread pulls HOST batches from the group (numpy OR
+    native path — shuffle-perm gather, dtype cast, super-batch stacking)
+    and parks up to ``depth`` assembled batches in a queue, so host input
+    work for step i+1 overlaps device compute for step i (double-buffered
+    at depth>=2). The consumer issues the ``device_put`` at dispatch —
+    asynchronous on accelerator runtimes, so the transfer still overlaps
+    compute, without the worker contending with XLA's execution locks.
+    Order and values are bit-identical to serial: one worker, one group,
+    same pull sequence, and placement is value-preserving.
+
+    ``steps_per_item > 1`` groups that many consecutive batches into one
+    stacked super-batch per queue item (for ``train_k_steps``), ramping
+    the super size up from 1 at epoch start so the cold queue never
+    stalls the device for k assemblies; the epoch remainder rides as a
+    smaller super.
+
+    ``stats`` (profiling.EpochThroughput, optional) receives
+    host-input-wait seconds and a queue-depth sample per batch.
+    """
+
+    def __init__(self, group: DataLoaderGroup, depth: int,
+                 steps_per_item: int = 1, stats=None):
+        self.group = group
+        self.depth = max(0, int(depth))
+        self.k = max(1, int(steps_per_item))
+        self.stats = stats
+
+    def _plan(self) -> List[int]:
+        """Per-epoch item sizes. k>1 groups batches into supers; with a
+        background queue the sizes RAMP (1, 2, 4, ..., k) so the first
+        dispatch waits on one batch, not k — the queue is cold at every
+        epoch start and a full-k first item would stall the device for
+        k assemblies. Super sizes are only ever powers of two up to k
+        and the epoch remainder rides as SINGLE batches (the plain
+        train_step), so the scan executable compiles for at most
+        log2(k) distinct sizes, never for transient remainders.
+        Grouping never changes batch order or per-step metric order."""
+        nb = self.group.num_batches
+        if self.k <= 1:
+            return [1] * nb
+        plan: List[int] = []
+        emitted = {1}  # sizes whose executables the plan already implies
+        rem = nb
+        size = 1 if self.depth > 0 else self.k
+        while rem > 0:
+            if size < self.k and rem >= size:  # warm-up ramp: 1, 2, 4, ...
+                s = size
+                size *= 2
+            elif size >= self.k and rem >= self.k:
+                s = self.k
+            else:
+                # tail: step down through sizes the plan already emitted
+                # (largest fitting one), so the remainder costs as few
+                # dispatches as possible without compiling a new size
+                s = max((e for e in emitted if e <= rem), default=1)
+            emitted.add(s)
+            plan.append(s)
+            rem -= s
+        return plan
+
+    def epoch(self, reshuffle: bool = True) -> Iterator[Tuple[int, list]]:
+        """Reset the group and yield one epoch of ``(n_steps, batch)``
+        items (placed device arrays); ``batch`` is a stacked super-batch
+        when ``n_steps > 1``."""
+        self.group.reset(reshuffle)
+        plan = self._plan()
+        if self.depth == 0:
+            for k in plan:
+                t0 = time.perf_counter()
+                host = self.group.assemble_host(k)
+                if self.stats is not None:
+                    # serial mode: the whole inline assembly IS the wait
+                    self.stats.record_wait(time.perf_counter() - t0)
+                    self.stats.record_depth(0)
+                yield k, self.group.place(host, k)
+            return
+        q: queue.Queue = queue.Queue(maxsize=self.depth)
+        stop = threading.Event()
+
+        def _offer(item) -> bool:
+            # bounded put that stays responsive to consumer abandonment
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.05)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def _work():
+            try:
+                for k in plan:
+                    if not _offer((k, self.group.assemble_host(k))):
+                        return
+                _offer(_DONE)
+            except BaseException as e:  # surfaced on the consumer side
+                _offer(_WorkerError(e))
+
+        worker = threading.Thread(target=_work, daemon=True,
+                                  name="ff-prefetch")
+        worker.start()
+        try:
+            while True:
+                depth_sample = q.qsize()
+                t0 = time.perf_counter()
+                item = q.get()
+                wait = time.perf_counter() - t0
+                if item is _DONE:
+                    return
+                if isinstance(item, _WorkerError):
+                    raise item.exc
+                if self.stats is not None:
+                    # real batches only (the end-of-epoch sentinel is not
+                    # an input wait)
+                    self.stats.record_depth(depth_sample)
+                    self.stats.record_wait(wait)
+                k, host = item
+                yield k, self.group.place(host, k)
+        finally:
+            stop.set()
+            worker.join()

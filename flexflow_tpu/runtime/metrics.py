@@ -40,20 +40,66 @@ class PerfMetrics:
                 setattr(self, k, getattr(self, k) + float(batch[k]))
 
     # -- device-side accumulation (fit/eval loops) ------------------------ #
-    # Per-batch metrics stay on device across an epoch (tiny eager adds,
-    # no host sync per step — the reference chains PerfMetrics through
-    # futures for the same reason, model.cc:2880); flush() converts once.
+    # Per-batch metrics stay on device across an epoch: accumulate() only
+    # PARKS the per-step dicts (no host sync, not even an eager add on
+    # the step loop's critical path — the reference chains PerfMetrics
+    # through futures for the same reason, model.cc:2880); flush() folds
+    # them in arrival order and converts once at the epoch boundary.
+    # Parked entries are compacted into a running device accumulator
+    # every _PENDING_CAP entries, so a million-step epoch holds a
+    # bounded number of device scalars, never an unbounded list.
+    _PENDING_CAP = 256
+
+    def _park(self, batch: Dict, n) -> None:
+        pending = getattr(self, "_dev_pending", None)
+        if pending is None:
+            pending = self._dev_pending = []
+        pending.append((batch, n))
+        if len(pending) >= self._PENDING_CAP:
+            self._compact()
+
     def accumulate(self, batch: Dict) -> None:
+        self._park(batch, None)
+
+    def accumulate_stacked(self, batch: Dict, n: int) -> None:
+        """Park a dict of (n, ...)-stacked per-step metrics (the
+        multi-step executable's output); the fold consumes the n slices
+        in step order, so the reduction sequence — and therefore the
+        reported totals, bit for bit — matches n serial accumulates."""
+        self._park(batch, n)
+
+    def _compact(self) -> None:
+        """Fold parked entries (in arrival order, stacked slices in step
+        order) into the running device accumulator."""
         acc = getattr(self, "_dev_acc", None)
-        self._dev_acc = batch if acc is None else {
-            k: acc[k] + v for k, v in batch.items()
+        for batch, n in getattr(self, "_dev_pending", None) or []:
+            if n is None:
+                acc = self._fold(acc, batch)
+            else:
+                for i in range(n):
+                    acc = self._fold(acc, {k: v[i] for k, v in batch.items()})
+        self._dev_acc = acc
+        self._dev_pending = []
+
+    def _fold(self, acc, batch: Dict):
+        if acc is None:
+            return dict(batch)
+        # merge over the UNION of keys: a key present in only one side
+        # (metrics sets can differ across steps, e.g. after a recompile)
+        # must survive, not be silently dropped
+        return {
+            k: (acc[k] + batch[k]) if k in acc and k in batch
+            else (acc[k] if k in acc else batch[k])
+            for k in set(acc) | set(batch)
         }
 
     def flush(self) -> None:
+        self._compact()
         acc = getattr(self, "_dev_acc", None)
         if acc:
             self.update({k: float(v) for k, v in acc.items()})
         self._dev_acc = None
+        self._dev_pending = None
 
     @property
     def accuracy(self) -> float:

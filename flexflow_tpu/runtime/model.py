@@ -14,6 +14,7 @@ drive it.
 
 from __future__ import annotations
 
+import collections
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
@@ -37,9 +38,10 @@ from ..core.layer import Layer
 from ..core.machine import make_mesh
 from ..core.tensor import Parameter, Tensor
 from .compiler import CompiledModel, compile_model
-from .dataloader import DataLoaderGroup, SingleDataLoader
+from .dataloader import DataLoaderGroup, Prefetcher, SingleDataLoader
 from .loss import loss_from_string
 from .metrics import PerfMetrics
+from .profiling import EpochThroughput
 from .optimizer import Optimizer, SGDOptimizer
 
 _METRICS_FROM_STRING = {
@@ -64,6 +66,11 @@ class FFModel:
         # timing/coverage/cache counters from the last _run_search (see
         # _finish_search); surfaced by runtime/profiling.py exports
         self.search_profile = None
+        # step-loop throughput counters from the last fit()/eval() (per-
+        # epoch steps/s, host-input-wait, queue-depth histogram, dispatch-
+        # ahead occupancy); surfaced by runtime/profiling.fit_report
+        self.fit_profile = None
+        self.eval_profile = None
         self._search_strategies: Dict[str, Dict[str, str]] = {}
         self.iter_config = FFIterationConfig()
         self._param_index: Dict[int, Tuple[str, str]] = {}  # tensor_id -> (op, weight)
@@ -1355,6 +1362,64 @@ class FFModel:
         return jax.random.fold_in(jax.random.key(self.config.seed), self._rng_counter)
 
     # ---- high-level fit/eval (reference: flexflow_cffi.py:2062-2105) ----- #
+    def _make_loader_group(self, xs, y, bs: int, cm,
+                           shuffle: bool) -> DataLoaderGroup:
+        """The shared loader stack of fit() and eval(): one
+        SingleDataLoader per input with its compiled sharding, plus the
+        label loader (sparse-CE labels reshaped/cast once, host-side)."""
+        loaders = [
+            SingleDataLoader(np.asarray(a), bs, sh)
+            for a, sh in zip(xs, cm.input_shardings)
+        ]
+        y_arr = np.asarray(y)
+        if cm.loss_type is LossType.SPARSE_CATEGORICAL_CROSSENTROPY:
+            y_arr = y_arr.reshape(y_arr.shape[0], -1).astype(np.int32)
+        loaders.append(SingleDataLoader(y_arr, bs, cm.label_sharding))
+        return DataLoaderGroup(loaders, seed=self.config.seed,
+                               shuffle=shuffle)
+
+    def _step_loop_knobs(self, cm, recompile_state=None):
+        """(prefetch_depth, max_inflight, steps_per_dispatch) for the
+        async step loop. Multi-step dispatch needs a scannable train step
+        and no per-step hooks: the pipeline engine and recompile-on-
+        condition both require step granularity, so they force k=1."""
+        cfg = self.config
+        depth = max(0, int(getattr(cfg, "prefetch_depth", 0)))
+        max_inflight = max(1, int(getattr(cfg, "max_inflight_steps", 2)))
+        k = max(1, int(getattr(cfg, "steps_per_dispatch", 1)))
+        if (self.pipelined is not None or recompile_state is not None
+                or cm.train_k_steps is None):
+            k = 1
+        return depth, max_inflight, k
+
+    @staticmethod
+    def _advance_window(stats, inflight, result, n_steps: int,
+                        nbytes: int, max_inflight: int) -> None:
+        """The dispatch-ahead window shared by fit and eval: record the
+        occupancy sample, push the just-dispatched step's result, and
+        block on the oldest once more than ``max_inflight`` are
+        outstanding (jax async dispatch overlaps them; the bound keeps
+        dispatch queues and host memory sane)."""
+        stats.record_inflight(len(inflight))
+        stats.record_steps(n_steps, nbytes)
+        inflight.append(result)
+        while len(inflight) > max_inflight:
+            jax.block_until_ready(inflight.popleft())
+
+    @staticmethod
+    def _step_loop_profile(epoch_records, depth, max_inflight, k) -> dict:
+        """The throughput record fit/eval publish (profiling.fit_report)."""
+        total_steps = sum(r["steps"] for r in epoch_records)
+        total_wall = sum(r["wall_s"] for r in epoch_records)
+        return {
+            "epochs": epoch_records,
+            "steps_per_s": (round(total_steps / total_wall, 3)
+                            if total_wall > 0 else 0.0),
+            "prefetch_depth": depth,
+            "max_inflight_steps": max_inflight,
+            "steps_per_dispatch": k,
+        }
+
     def fit(
         self,
         x: Union[np.ndarray, List[np.ndarray]],
@@ -1369,7 +1434,16 @@ class FFModel:
         """``guard``: a :class:`runtime.guard.TrainingGuard` — non-finite
         epoch losses roll back to the last healthy snapshot with lr
         backoff instead of poisoning the run (no reference equivalent:
-        SURVEY.md §5 lists failure detection as absent upstream)."""
+        SURVEY.md §5 lists failure detection as absent upstream).
+
+        The step loop is asynchronous end to end: a Prefetcher assembles
+        and device_puts batches ahead of compute (config.prefetch_depth),
+        at most config.max_inflight_steps dispatched steps stay in flight,
+        metric/guard accumulation stays device-side, and the host syncs
+        only at epoch boundaries (and guard checks). With
+        ``config.steps_per_dispatch`` k>1, k batches run per dispatch via
+        the lax.scan multi-step executable. Per-epoch throughput counters
+        land in ``self.fit_profile``."""
         assert self.compiled is not None, "call compile() first"
         if guard is not None and self.pipelined is not None:
             raise ValueError("TrainingGuard does not support pipelined "
@@ -1391,51 +1465,92 @@ class FFModel:
                     f"{mb} microbatches (set when the model was compiled "
                     f"for the pipe mesh); pass a compatible batch_size or "
                     f"recompile with pipeline=PipelineConfig(...)")
-        loaders = [
-            SingleDataLoader(np.asarray(a), bs, sh)
-            for a, sh in zip(xs, cm.input_shardings)
-        ]
-        y_arr = np.asarray(y)
-        if cm.loss_type is LossType.SPARSE_CATEGORICAL_CROSSENTROPY:
-            y_arr = y_arr.reshape(y_arr.shape[0], -1).astype(np.int32)
-        loaders.append(SingleDataLoader(y_arr, bs, cm.label_sharding))
-        group = DataLoaderGroup(loaders, seed=self.config.seed, shuffle=shuffle)
+        group = self._make_loader_group(xs, y, bs, cm, shuffle)
+        depth, max_inflight, k = self._step_loop_knobs(cm, recompile_state)
+        batch_nbytes = group.batch_nbytes
         history: List[PerfMetrics] = []
+        epoch_records: List[dict] = []
+        # the most recent step's READY loss, carried ACROSS epochs: the
+        # recompile trigger reads it with a persistent one-step lag, so
+        # every step's loss — including each epoch's final batch —
+        # reaches last_metric at some check point
+        prev_loss = None
         if guard is not None:
             guard.ensure_snapshot(self)  # epoch-0 divergence rolls back too
         for epoch in range(epochs):
-            group.reset()
+            stats = EpochThroughput()
+            pf = Prefetcher(group, depth, steps_per_item=k, stats=stats)
             pm = PerfMetrics()
             last_loss = None
             loss_accum = None  # device-side; NaN/inf in ANY batch survives
-            for it in range(group.num_batches):
-                batch = group.next_batch()
+            inflight = collections.deque()
+            for nk, batch in pf.epoch():
                 if self.pipelined is not None:
                     loss, bm = self.pipelined.train_step(
                         self._next_rng(), batch[:-1], batch[-1]
                     )
+                    guard_add = loss
+                elif nk > 1:
+                    # multi-step executable: nk batches in ONE dispatch;
+                    # the rng sequence advances exactly as nk serial
+                    # steps would
+                    rngs = jnp.stack(
+                        [self._next_rng() for _ in range(nk)])
+                    cm.params, cm.opt_state, losses, bms = cm.train_k_steps(
+                        cm.params, cm.opt_state, rngs, *batch,
+                        seq_length=self.iter_config.seq_length,
+                    )
+                    loss = losses[-1]
+                    # park the stacked per-step metrics; flush folds them
+                    # IN STEP ORDER, so the reported epoch metrics match
+                    # nk serial steps bit for bit
+                    bm = None
+                    pm.accumulate_stacked(bms, nk)
+                    guard_add = losses.sum() if guard is not None else None
                 else:
                     cm.params, cm.opt_state, loss, bm = cm.train_step(
                         cm.params, cm.opt_state, self._next_rng(), *batch,
                         seq_length=self.iter_config.seq_length,
                     )
-                pm.accumulate(bm)
+                    guard_add = loss
+                if bm is not None:  # k>1 accumulated per-step above
+                    pm.accumulate(bm)
                 last_loss = loss
                 if guard is not None:
                     # sum, not last value: a mid-epoch NaN/inf must not be
                     # masked by a finite final batch (clipped CE losses
                     # stay finite on garbage params)
-                    loss_accum = loss if loss_accum is None else loss_accum + loss
-                cm._iteration += 1
+                    loss_accum = (guard_add if loss_accum is None
+                                  else loss_accum + guard_add)
+                self._advance_window(stats, inflight, loss, nk,
+                                     batch_nbytes * nk, max_inflight)
+                cm._iteration += nk
                 if recompile_state is not None:
                     # reference: recompile_on_condition evaluated per
-                    # iteration inside the train loop (model.cc:2422)
+                    # iteration inside the train loop (model.cc:2422).
+                    # The device->host metric read is throttled to the
+                    # state's check_interval and fed the most recent
+                    # READY loss (the previous step's, already
+                    # materialized while this step dispatched) so it
+                    # does not stall the async pipeline every iteration.
                     from .recompile import recompile_on_condition
 
-                    recompile_state.last_metric = float(loss)
+                    ci = max(1, getattr(recompile_state,
+                                        "check_interval", 1))
+                    if (recompile_state.iteration + 1) % ci == 0:
+                        src = prev_loss if prev_loss is not None else loss
+                        recompile_state.last_metric = float(src)
                     if recompile_on_condition(self, recompile_state):
                         cm = self.compiled
-            pm.flush()
+                prev_loss = loss
+            pm.flush()  # the epoch-boundary host sync (device-side accum)
+            epoch_records.append(stats.finish())
+            if self.config.profiling:
+                r = epoch_records[-1]
+                print(f"[fit] epoch {epoch}: {r['steps_per_s']:.1f} steps/s"
+                      f" input_wait {r['input_wait_s']*1e3:.1f}ms"
+                      f" occupancy {r['dispatch_ahead_occupancy']:.2f}"
+                      f" depth_hist {r['queue_depth_hist']}", flush=True)
             if guard is not None:
                 # a zero-batch epoch (loss_accum None) ran nothing: healthy
                 accum = (float(loss_accum) if loss_accum is not None
@@ -1458,6 +1573,8 @@ class FFModel:
                     flush=True,
                 )
             history.append(pm)
+        self.fit_profile = self._step_loop_profile(
+            epoch_records, depth, max_inflight, k)
         if self.pipelined is not None:
             # keep the CompiledModel view current so checkpoint/eval/
             # get_weights after a pipelined fit see trained weights
@@ -1465,29 +1582,36 @@ class FFModel:
         return history
 
     def eval(self, x, y, batch_size: Optional[int] = None, verbose: bool = True) -> PerfMetrics:
-        """reference: flexflow_cffi.py:2106."""
+        """reference: flexflow_cffi.py:2106. Shares fit()'s async step
+        loop: prefetched input pipeline, bounded dispatch-ahead window,
+        device-side metric accumulation with one sync at the end; the
+        throughput record lands in ``self.eval_profile``."""
         assert self.compiled is not None
         cm = self.compiled
         xs = x if isinstance(x, (list, tuple)) else [x]
         bs = batch_size or self.config.batch_size
-        loaders = [
-            SingleDataLoader(np.asarray(a), bs, sh)
-            for a, sh in zip(xs, cm.input_shardings)
-        ]
-        y_arr = np.asarray(y)
-        if cm.loss_type is LossType.SPARSE_CATEGORICAL_CROSSENTROPY:
-            y_arr = y_arr.reshape(y_arr.shape[0], -1).astype(np.int32)
-        loaders.append(SingleDataLoader(y_arr, bs, cm.label_sharding))
-        group = DataLoaderGroup(loaders, shuffle=False)
-        group.reset()
+        group = self._make_loader_group(xs, y, bs, cm, shuffle=False)
+        depth, max_inflight, _ = self._step_loop_knobs(cm)
+        batch_nbytes = group.batch_nbytes
+        stats = EpochThroughput()
+        pf = Prefetcher(group, depth, stats=stats)
         pm = PerfMetrics()
-        for _ in range(group.num_batches):
-            batch = group.next_batch()
+        inflight = collections.deque()
+        for _nk, batch in pf.epoch(reshuffle=False):
             loss, logits, bm = cm.eval_step(
                 cm.params, *batch,
                 seq_length=self.iter_config.seq_length)
             pm.accumulate(bm)
+            self._advance_window(stats, inflight, loss, 1, batch_nbytes,
+                                 max_inflight)
         pm.flush()
+        self.eval_profile = self._step_loop_profile(
+            [stats.finish()], depth, max_inflight, 1)
+        if self.config.profiling:
+            rec = self.eval_profile["epochs"][0]
+            print(f"[eval] {rec['steps_per_s']:.1f} steps/s input_wait "
+                  f"{rec['input_wait_s']*1e3:.1f}ms occupancy "
+                  f"{rec['dispatch_ahead_occupancy']:.2f}", flush=True)
         if verbose:
             print(f"eval: {pm.report(cm.metrics)}", flush=True)
         return pm

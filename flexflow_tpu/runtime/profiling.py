@@ -19,6 +19,11 @@ TPU-native equivalents of the reference's observability surface
   cache-hit, candidate-coverage, and pruned-candidate counters (recorded
   by ``FFModel._finish_search``); included in the JSON task-graph export
   so bound-based pruning is never a silent truncation.
+* step-loop observability → :class:`EpochThroughput` / :func:`fit_report`:
+  per-epoch throughput counters of the async input pipeline + dispatch-
+  ahead train loop (steps/s, host-input-wait seconds, prefetch queue-depth
+  histogram, dispatch-ahead occupancy), recorded by ``FFModel.fit``/
+  ``eval`` into ``FFModel.fit_profile``/``eval_profile``.
 """
 
 from __future__ import annotations
@@ -121,6 +126,71 @@ def profile_ops(ffmodel, iters: int = 10, warmup: int = 2) -> List[Dict]:
             "gflops_per_s": (fl / (ms * 1e-3)) / 1e9 if ms > 0 else 0.0,
         })
     return records
+
+
+# ----------------------------------------------------- step-loop observability
+class EpochThroughput:
+    """Per-epoch counters of the fit/eval step loop (the observability
+    half of the async input pipeline): how fast steps dispatched, how long
+    the loop sat waiting for host input, how full the prefetch queue ran,
+    and how deep the dispatch-ahead window actually was.
+
+    The fit loop drives it; :class:`~.dataloader.Prefetcher` feeds the
+    wait/depth counters. ``finish()`` renders one JSON-able record.
+    """
+
+    def __init__(self):
+        self.steps = 0
+        self.input_wait_s = 0.0
+        self.depth_hist: Dict[int, int] = {}
+        self._inflight_sum = 0
+        self._inflight_obs = 0
+        self.input_bytes = 0
+        self._t0 = time.perf_counter()
+
+    def record_wait(self, seconds: float) -> None:
+        """Time the consumer spent blocked on host batch assembly/transfer
+        (serial mode: the whole inline assembly; prefetch mode: queue-get
+        block time — ~0 when the pipeline keeps up)."""
+        self.input_wait_s += seconds
+
+    def record_depth(self, depth: int) -> None:
+        """Prefetch queue depth sampled at each batch request."""
+        self.depth_hist[depth] = self.depth_hist.get(depth, 0) + 1
+
+    def record_inflight(self, n: int) -> None:
+        """Dispatch-ahead window size observed when a step was issued."""
+        self._inflight_sum += n
+        self._inflight_obs += 1
+
+    def record_steps(self, n: int, nbytes: int = 0) -> None:
+        self.steps += n
+        self.input_bytes += nbytes
+
+    def finish(self) -> Dict:
+        wall = time.perf_counter() - self._t0
+        occ = (self._inflight_sum / self._inflight_obs
+               if self._inflight_obs else 0.0)
+        return {
+            "steps": self.steps,
+            "wall_s": round(wall, 6),
+            "steps_per_s": round(self.steps / wall, 3) if wall > 0 else 0.0,
+            "input_wait_s": round(self.input_wait_s, 6),
+            "input_mb_per_s": round(
+                self.input_bytes / wall / 2**20, 3) if wall > 0 else 0.0,
+            "queue_depth_hist": dict(sorted(self.depth_hist.items())),
+            "dispatch_ahead_occupancy": round(occ, 3),
+        }
+
+
+def fit_report(ffmodel) -> Optional[Dict]:
+    """The last ``fit``'s step-loop throughput profile, or None when no
+    fit has run: ``{"epochs": [per-epoch records], "steps_per_s",
+    "prefetch_depth", "max_inflight_steps", "steps_per_dispatch"}``. Each
+    epoch record carries ``steps``, ``wall_s``, ``steps_per_s``,
+    ``input_wait_s`` (host time on the critical path), ``input_mb_per_s``,
+    ``queue_depth_hist`` and ``dispatch_ahead_occupancy``."""
+    return getattr(ffmodel, "fit_profile", None)
 
 
 # -------------------------------------------------------- search observability

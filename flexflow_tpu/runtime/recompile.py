@@ -29,15 +29,25 @@ class RecompileState:
         trigger_func: Callable[["RecompileState"], bool],
         alter_func: Callable[["RecompileState"], None],
         ffmodel,
+        check_interval: int = 1,
     ):
         self.trigger_func = trigger_func
         self.alter_func = alter_func
         self.ffmodel = ffmodel
         self.recompilations = 0
         # scratch for user trigger logic (the reference's moe.cc uses the
-        # last iteration's score/metric)
+        # last iteration's score/metric). The fit loop feeds it the most
+        # recent step's READY loss — reading a just-dispatched loss would
+        # stall the async pipeline every iteration.
         self.last_metric: Optional[float] = None
         self.iteration = 0
+        # how often (in iterations) the fit loop materializes last_metric
+        # for the trigger; a trigger that only fires every N iterations
+        # should set N here so the other N-1 steps pay no host sync.
+        # The trigger itself still runs every iteration (iteration
+        # counting is unchanged) — only the device->host metric read is
+        # throttled.
+        self.check_interval = max(1, int(check_interval))
 
     def trigger(self) -> bool:
         return bool(self.trigger_func(self))

@@ -1,0 +1,41 @@
+"""tools/fit_bench.py smoke: the tier-1 invocation (tiny e2e MLP) runs
+in-process and emits every field of its one-line JSON throughput record.
+The bench itself asserts prefetch-vs-serial loss/param bit-identity
+before reporting, so a green smoke also covers the overlap layers'
+correctness contract on the bench workload."""
+
+import importlib.util
+import json
+import os
+
+_TOOL = os.path.join(os.path.dirname(__file__), os.pardir, "tools",
+                     "fit_bench.py")
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location("fit_bench", _TOOL)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_fit_bench_smoke():
+    fb = _load()
+    out = fb.run_bench(samples=256, dim=64, hidden=32, classes=4,
+                       batch=64, trials=2, depth=2, k=2)
+    for key in ("steps_per_s_serial", "steps_per_s_pipeline", "speedup",
+                "serial_trials", "pipeline_trials",
+                "input_wait_serial_s", "input_wait_pipeline_s",
+                "dispatch_ahead_occupancy", "losses_bit_identical",
+                "steps", "trials", "batch", "prefetch_depth",
+                "steps_per_dispatch"):
+        assert key in out, key
+    assert out["losses_bit_identical"] is True
+    assert out["steps_per_s_serial"] > 0
+    assert out["steps_per_s_pipeline"] > 0
+    assert out["steps"] == 4  # 256 samples / batch 64, per epoch
+    assert len(out["serial_trials"]) == 2
+    # the one-line record is the BENCH contract: it must survive a JSON
+    # round-trip exactly as main() prints it
+    rt = json.loads(json.dumps(out))
+    assert rt["prefetch_depth"] == 2 and rt["steps_per_dispatch"] == 2

@@ -1,0 +1,255 @@
+"""Async input pipeline + dispatch-ahead step loop (runtime/dataloader.py
+Prefetcher, runtime/compiler.py train_k_steps, FFModel.fit/eval rework):
+determinism vs the serial loader, bit-identical fit trajectories, k-step
+dispatch equivalence, throughput profile surface, metric-accumulator
+union merge, and the recompile check-interval throttle."""
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import (
+    ActiMode,
+    DataType,
+    FFConfig,
+    FFModel,
+    LossType,
+    MetricsType,
+    SGDOptimizer,
+)
+from flexflow_tpu.runtime.dataloader import (
+    DataLoaderGroup,
+    Prefetcher,
+    SingleDataLoader,
+)
+from flexflow_tpu.runtime.metrics import PerfMetrics
+
+
+def _toy(n=512, d=16, c=4, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=(d, c)).astype(np.float32)
+    y = np.argmax(x @ w, axis=1).astype(np.int32).reshape(n, 1)
+    return x, y
+
+
+def _mlp(cfg, d=16, c=4):
+    """Explicit layer names: weight init keys on the op name, so models
+    built twice in one process draw identical weights."""
+    ff = FFModel(cfg)
+    x = ff.create_tensor((cfg.batch_size, d), DataType.FLOAT, name="x")
+    t = ff.dense(x, 32, ActiMode.RELU, name="pf_fc1")
+    t = ff.dense(t, c, name="pf_fc2")
+    ff.softmax(t, name="pf_sm")
+    ff.compile(
+        optimizer=SGDOptimizer(lr=0.1),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.ACCURACY,
+                 MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY],
+    )
+    return ff
+
+
+def _collect(group_args, depth, epochs, reshuffles=None, k=1):
+    """Materialize every batch a Prefetcher yields over ``epochs``."""
+    arrays, bs, seed, shuffle = group_args
+    group = DataLoaderGroup(
+        [SingleDataLoader(a, bs) for a in arrays], seed=seed, shuffle=shuffle)
+    pf = Prefetcher(group, depth, steps_per_item=k)
+    out = []
+    for e in range(epochs):
+        resh = True if reshuffles is None else reshuffles[e]
+        for nk, batch in pf.epoch(reshuffle=resh):
+            out.append((nk, [np.asarray(b) for b in batch]))
+    return out
+
+
+def _assert_same_stream(a, b):
+    assert len(a) == len(b)
+    for (ka, ba), (kb, bb) in zip(a, b):
+        assert ka == kb
+        assert len(ba) == len(bb)
+        for x, y in zip(ba, bb):
+            assert np.array_equal(x, y)
+
+
+@pytest.mark.parametrize("seed", [0, 7])
+@pytest.mark.parametrize("depth", [1, 3])
+def test_prefetcher_matches_serial_loader(seed, depth):
+    """Identical batch sequence vs the serial loader across seeds, epochs
+    and reshuffles — the bit-identity contract of the background queue."""
+    x, y = _toy(n=320, seed=seed)
+    args = ([x, y], 64, seed, True)
+    serial = _collect(args, 0, epochs=3, reshuffles=[True, True, False])
+    pre = _collect(args, depth, epochs=3, reshuffles=[True, True, False])
+    _assert_same_stream(serial, pre)
+
+
+def test_prefetcher_non_divisible_and_wraparound():
+    """The epoch truncates to whole batches (n=100, bs=64 -> 1 batch) and
+    the <1-batch wrap-around path (n < bs) behaves exactly like the
+    serial loader: next_batch wraps to index 0 and returns the short
+    batch every call."""
+    x, y = _toy(n=100)
+    args = ([x, y], 64, 0, True)
+    _assert_same_stream(_collect(args, 0, epochs=4),
+                        _collect(args, 2, epochs=4))
+    # n < batch_size: the wrap path returns all n rows, repeatedly
+    small = SingleDataLoader(x[:40], 64)
+    b1 = np.asarray(small.next_batch())
+    b2 = np.asarray(small.next_batch())
+    assert b1.shape[0] == 40 and np.array_equal(b1, b2)
+    assert np.array_equal(b1, x[:40])
+
+
+def test_prefetcher_super_batches_and_tail():
+    """steps_per_item=k stacks consecutive batches into supers — ramped
+    from 1 when a background queue must warm up — and the epoch tail
+    rides as a smaller super, covering the whole epoch in serial order."""
+    x, y = _toy(n=448)  # 7 batches of 64 -> ramp 1, then supers of 2
+    serial = _collect(([x, y], 64, 0, False), 0, epochs=1)
+    sup = _collect(([x, y], 64, 0, False), 2, epochs=1, k=2)
+    assert [nk for nk, _ in sup] == [1, 2, 2, 2]
+    flat = []
+    for nk, batch in sup:
+        if nk > 1:
+            for i in range(nk):
+                flat.append((1, [b[i] for b in batch]))
+        else:
+            flat.append((nk, batch))
+    _assert_same_stream(serial, flat)
+
+
+def test_prefetcher_propagates_worker_errors():
+    class Boom(Exception):
+        pass
+
+    x, y = _toy(n=128)
+    group = DataLoaderGroup([SingleDataLoader(x, 64),
+                             SingleDataLoader(y, 64)], seed=0, shuffle=False)
+
+    def explode():
+        raise Boom("host assembly failed")
+
+    group.next_batch_host = explode
+    pf = Prefetcher(group, depth=2)
+    with pytest.raises(Boom):
+        list(pf.epoch())
+
+
+def _fit_run(depth, k, epochs=3, seed=0, max_inflight=2):
+    cfg = FFConfig(batch_size=64, epochs=epochs, seed=seed,
+                   prefetch_depth=depth, steps_per_dispatch=k,
+                   max_inflight_steps=max_inflight)
+    ff = _mlp(cfg)
+    x, y = _toy(seed=seed)
+    hist = ff.fit(x, y, verbose=False)
+    params = {(o, w): np.asarray(v)
+              for o, ws in ff.compiled.params.items()
+              for w, v in ws.items()}
+    traj = [(pm.sparse_cce_loss, pm.train_correct, pm.train_all)
+            for pm in hist]
+    return params, traj, ff
+
+
+def test_fit_with_prefetch_bit_identical():
+    """Loss trajectory AND final params of fit-with-prefetch equal the
+    serial path bit for bit (fixed seed, shuffling on)."""
+    p0, t0, _ = _fit_run(depth=0, k=1)
+    p1, t1, _ = _fit_run(depth=3, k=1)
+    assert t0 == t1
+    assert set(p0) == set(p1)
+    for key in p0:
+        assert np.array_equal(p0[key], p1[key]), key
+
+
+def test_fit_multi_step_dispatch_equivalent():
+    """steps_per_dispatch>1 (lax.scan multi-step executable) is
+    numerically equivalent to k serial steps — including a non-divisible
+    epoch tail routed through the single-step path."""
+    p0, t0, _ = _fit_run(depth=0, k=1)
+    p2, t2, ff2 = _fit_run(depth=2, k=3)  # 8 batches -> ramped supers 1,2,3,2
+    assert ff2.fit_profile["steps_per_dispatch"] == 3
+    assert ff2.fit_profile["epochs"][0]["steps"] == 8
+    for key in p0:
+        np.testing.assert_allclose(p0[key], p2[key], rtol=5e-5, atol=1e-6,
+                                   err_msg=str(key))
+    # accuracy counts are integers: they must match exactly
+    assert [t[1] for t in t0] == [t[1] for t in t2]
+    assert [t[2] for t in t0] == [t[2] for t in t2]
+
+
+def test_fit_profile_fields():
+    _, _, ff = _fit_run(depth=2, k=1, epochs=2)
+    prof = ff.fit_profile
+    assert prof["prefetch_depth"] == 2
+    assert prof["max_inflight_steps"] == 2
+    assert prof["steps_per_dispatch"] == 1
+    assert prof["steps_per_s"] > 0
+    assert len(prof["epochs"]) == 2
+    for rec in prof["epochs"]:
+        for field in ("steps", "wall_s", "steps_per_s", "input_wait_s",
+                      "input_mb_per_s", "queue_depth_hist",
+                      "dispatch_ahead_occupancy"):
+            assert field in rec, field
+        assert rec["steps"] == 8
+        assert sum(rec["queue_depth_hist"].values()) == 8
+    from flexflow_tpu.runtime.profiling import fit_report
+
+    assert fit_report(ff) is prof
+
+
+def test_eval_shares_prefetch_loop():
+    """eval() runs the same prefetch + dispatch-ahead loop as fit() and
+    its metrics are independent of the pipeline knobs."""
+    x, y = _toy(seed=3)
+    cfg0 = FFConfig(batch_size=64, seed=3, prefetch_depth=0)
+    ff0 = _mlp(cfg0)
+    pm0 = ff0.eval(x, y, verbose=False)
+    cfg1 = FFConfig(batch_size=64, seed=3, prefetch_depth=3)
+    ff1 = _mlp(cfg1)
+    pm1 = ff1.eval(x, y, verbose=False)
+    assert pm0.train_all == pm1.train_all
+    assert pm0.train_correct == pm1.train_correct
+    assert pm0.sparse_cce_loss == pm1.sparse_cce_loss
+    prof = ff1.eval_profile
+    assert prof["prefetch_depth"] == 3 and prof["epochs"][0]["steps"] == 8
+
+
+def test_metrics_accumulate_union_merge():
+    """A key present in the accumulator but missing from one batch (or
+    vice versa) must survive accumulation, not be silently dropped."""
+    pm = PerfMetrics()
+    pm.accumulate({"count": 4, "cce_loss": 1.0})
+    pm.accumulate({"count": 4, "cce_loss": 2.0, "correct": 3})
+    pm.accumulate({"count": 4})  # drops neither cce_loss nor correct
+    pm.flush()
+    assert pm.train_all == 12
+    assert pm.train_correct == 3
+    assert pm.cce_loss == pytest.approx(3.0)
+
+
+def test_recompile_check_interval_throttles_metric_sync():
+    """The fit loop materializes last_metric only every check_interval
+    iterations (the per-step device sync fix); the trigger still runs —
+    and iteration counts — every step, and multi-step dispatch falls
+    back to step granularity when a recompile_state is present."""
+    from flexflow_tpu.runtime.recompile import RecompileState
+
+    x, y = _toy()
+    cfg = FFConfig(batch_size=64, epochs=1, seed=0,
+                   prefetch_depth=2, steps_per_dispatch=4)
+    ff = _mlp(cfg)
+    seen = []
+
+    def trigger(rs):
+        seen.append((rs.iteration, rs.last_metric))
+        return False
+
+    rs = RecompileState(trigger, lambda rs: None, ff, check_interval=3)
+    ff.fit(x, y, verbose=False, recompile_state=rs)
+    assert len(seen) == 8  # trigger ran every iteration despite k=4 ask
+    # metric materialized only on the 3rd/6th checks (iteration pre-
+    # increment 2 and 5); None before the first check point
+    assert [m is None for _, m in seen[:2]] == [True, True]
+    assert seen[2][1] is not None and seen[5][1] is not None
+    assert seen[3][1] == seen[2][1] and seen[4][1] == seen[2][1]

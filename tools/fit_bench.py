@@ -1,0 +1,221 @@
+#!/usr/bin/env python
+"""Benchmark the fit step loop's overlap layers on the e2e MLP workload.
+
+Times the SAME training run two ways — the historical serial loop
+(``prefetch_depth=0``, ``steps_per_dispatch=1``: host batch assembly +
+device_put on the device's critical path, one dispatch per step) against
+the async pipeline (``prefetch_depth>0``: the Prefetcher's worker thread
+assembles and transfers batches ahead of compute, plus
+``steps_per_dispatch=k`` batches per dispatch through the lax.scan
+multi-step executable) — and prints ONE JSON line::
+
+    {"steps_per_s_serial": ..., "steps_per_s_pipeline": ...,
+     "speedup": ..., "input_wait_serial_s": ..., "input_wait_pipeline_s": ...,
+     "losses_bit_identical": true, "steps": N, ...}
+
+Honesty props:
+
+* loss trajectories (every epoch's metric sums) and final params are
+  asserted BIT-IDENTICAL between the two modes before the line prints —
+  the multi-step executable applies exactly the serial step chain and
+  the fit loop folds its per-step metrics in serial order;
+* the two modes run INTERLEAVED in pairs with alternating order, and
+  ``speedup`` is the MEDIAN OF PER-PAIR RATIOS — adjacent-in-time pairs
+  see the same host state, so shared-host speed drift cancels out of the
+  ratio instead of biasing whichever mode ran second;
+* on a CPU host the bench pins device compute to one eigen thread per
+  device so the input pipeline has the host cores a real accelerator
+  would leave free (applied identically to both modes; override via
+  XLA_FLAGS to see the fully-oversubscribed behavior).
+
+Usage::
+
+    python tools/fit_bench.py                  # default: input-bound MLP
+    python tools/fit_bench.py --dim 2048 --batch 512 --trials 6
+    python tools/fit_bench.py --smoke          # tier-1: tiny + fast
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# hermetic multi-device CPU mesh when launched standalone (mirrors
+# tests/conftest.py; a real TPU/GPU environment overrides via env)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8").strip()
+if ("cpu" in os.environ["JAX_PLATFORMS"]
+        and "xla_cpu_multi_thread_eigen" not in os.environ["XLA_FLAGS"]):
+    os.environ["XLA_FLAGS"] += " --xla_cpu_multi_thread_eigen=false"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def _toy_classification(n: int, d: int, classes: int, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=(d, classes)).astype(np.float32)
+    y = np.argmax(x @ w, axis=1).astype(np.int32).reshape(n, 1)
+    return x, y
+
+
+def _build(batch: int, d: int, hidden: int, classes: int,
+           depth: int, k: int):
+    """The e2e MLP (tests/test_e2e_mlp.py shape) with EXPLICIT layer
+    names: weight init keys on the op name, so cross-model bit-parity
+    needs stable names."""
+    from flexflow_tpu import (ActiMode, DataType, FFConfig, FFModel,
+                              LossType, MetricsType, SGDOptimizer)
+
+    cfg = FFConfig(batch_size=batch, seed=0, prefetch_depth=depth,
+                   steps_per_dispatch=k)
+    ff = FFModel(cfg)
+    x = ff.create_tensor((batch, d), DataType.FLOAT, name="x")
+    t = ff.dense(x, hidden, ActiMode.RELU, name="fc1")
+    t = ff.dense(t, classes, name="fc2")
+    ff.softmax(t, name="sm")
+    ff.compile(
+        optimizer=SGDOptimizer(lr=0.05),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY],
+    )
+    return ff
+
+
+def _params(ff):
+    return {(o, w): np.asarray(v)
+            for o, ws in ff.compiled.params.items() for w, v in ws.items()}
+
+
+def _median(xs):
+    xs = sorted(xs)
+    return xs[len(xs) // 2]
+
+
+def run_bench(samples: int = 8192, dim: int = 1024, hidden: int = 64,
+              classes: int = 8, batch: int = 512, trials: int = 9,
+              depth: int = 1, k: int = 4, native: bool = False) -> dict:
+    saved_native = os.environ.get("FLEXFLOW_TPU_NATIVE")
+    if not native:
+        # measure the PYTHON pipeline layers: the native C++ loader
+        # already assembles one batch ahead on its own thread, so with it
+        # engaged the "serial" baseline is partly overlapped and the
+        # comparison stops isolating the knobs under test (and a third
+        # thread oversubscribes small CPU hosts). --native opts back in.
+        # Restored on exit so an in-process caller (the tier-1 smoke)
+        # does not disable the native path for the rest of the process;
+        # no-op if the native library was already loaded.
+        os.environ["FLEXFLOW_TPU_NATIVE"] = "off"
+    try:
+        return _run_bench(samples, dim, hidden, classes, batch, trials,
+                          depth, k)
+    finally:
+        if not native:
+            if saved_native is None:
+                os.environ.pop("FLEXFLOW_TPU_NATIVE", None)
+            else:
+                os.environ["FLEXFLOW_TPU_NATIVE"] = saved_native
+
+
+def _run_bench(samples, dim, hidden, classes, batch, trials,
+               depth, k) -> dict:
+    x, y = _toy_classification(samples, dim, classes)
+    serial = _build(batch, dim, hidden, classes, depth=0, k=1)
+    pipe = _build(batch, dim, hidden, classes, depth=depth, k=k)
+    losses = {"serial": [], "pipeline": []}
+    rates = {"serial": [], "pipeline": []}
+    waits = {"serial": [], "pipeline": []}
+    occ = []
+    ratios = []
+
+    def one_epoch(name, ff):
+        hist = ff.fit(x, y, epochs=1, verbose=False)
+        losses[name] += [pm.sparse_cce_loss for pm in hist]
+        prof = ff.fit_profile
+        rates[name].append(prof["steps_per_s"])
+        waits[name].append(sum(e["input_wait_s"] for e in prof["epochs"]))
+        if name == "pipeline":
+            occ.append(prof["epochs"][-1]["dispatch_ahead_occupancy"])
+        return prof["steps_per_s"]
+
+    # warmup epoch each (compile + first placements), trajectory included
+    # so the bit-identity check covers every epoch both modes ran; the
+    # pipeline warmup runs a ramped plan, so every super size compiles
+    for name, ff in (("serial", serial), ("pipeline", pipe)):
+        hist = ff.fit(x, y, epochs=1, verbose=False)
+        losses[name] += [pm.sparse_cce_loss for pm in hist]
+    for t in range(trials):
+        # back-to-back pair, order alternating: each ratio compares two
+        # epochs that ran under (nearly) the same host conditions
+        if t % 2 == 0:
+            rs = one_epoch("serial", serial)
+            rp = one_epoch("pipeline", pipe)
+        else:
+            rp = one_epoch("pipeline", pipe)
+            rs = one_epoch("serial", serial)
+        ratios.append(rp / rs)
+    pa, pb = _params(serial), _params(pipe)
+    bit_identical = (losses["serial"] == losses["pipeline"]
+                     and set(pa) == set(pb)
+                     and all(np.array_equal(pa[kk], pb[kk]) for kk in pa))
+    if not bit_identical:
+        raise AssertionError(
+            "pipeline run diverged from serial: "
+            f"{losses['serial']} vs {losses['pipeline']}")
+    ms, mp = _median(rates["serial"]), _median(rates["pipeline"])
+    return {
+        "steps_per_s_serial": round(ms, 3),
+        "steps_per_s_pipeline": round(mp, 3),
+        "speedup": round(_median(ratios), 3),
+        "serial_trials": [round(r, 2) for r in rates["serial"]],
+        "pipeline_trials": [round(r, 2) for r in rates["pipeline"]],
+        "input_wait_serial_s": round(_median(waits["serial"]), 6),
+        "input_wait_pipeline_s": round(_median(waits["pipeline"]), 6),
+        "dispatch_ahead_occupancy": _median(occ),
+        "losses_bit_identical": bit_identical,
+        "steps": samples // batch,
+        "trials": trials,
+        "batch": batch,
+        "prefetch_depth": depth,
+        "steps_per_dispatch": k,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--samples", type=int, default=8192)
+    ap.add_argument("--dim", type=int, default=1024)
+    ap.add_argument("--hidden", type=int, default=64)
+    ap.add_argument("--classes", type=int, default=8)
+    ap.add_argument("--batch", type=int, default=512)
+    ap.add_argument("--trials", type=int, default=9,
+                    help="interleaved timed epoch-pairs (speedup = median "
+                         "of per-pair ratios)")
+    ap.add_argument("--prefetch-depth", type=int, default=1)
+    ap.add_argument("--steps-per-dispatch", type=int, default=4)
+    ap.add_argument("--native", action="store_true",
+                    help="keep the native C++ loader engaged (default: "
+                         "off, so the bench isolates the Python pipeline)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny workload (the tier-1 invocation)")
+    ns = ap.parse_args(argv)
+    if ns.smoke:
+        out = run_bench(samples=256, dim=64, hidden=32, classes=4,
+                        batch=64, trials=2, depth=2, k=2, native=ns.native)
+    else:
+        out = run_bench(samples=ns.samples, dim=ns.dim, hidden=ns.hidden,
+                        classes=ns.classes, batch=ns.batch,
+                        trials=ns.trials, depth=ns.prefetch_depth,
+                        k=ns.steps_per_dispatch, native=ns.native)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
