@@ -3,6 +3,10 @@
 #
 #   make ci       — everything below, in order (the green gate)
 #   make native   — build the C++ helpers (scheduler/batcher/sim engine)
+#   make lint     — static checks: hot-path race/sync lint over the
+#                   package source + bytecode-compile every module
+#   make pcg-lint — PCG validator + strategy linter over the model zoo;
+#                   one JSON line (tools/pcg_lint.py)
 #   make test     — full suite on the virtual 8-device CPU mesh
 #   make dryrun   — compile+run one training step per parallelism mode
 #   make bench    — the benchmark (real chip when present, CPU fallback)
@@ -12,9 +16,17 @@
 PY ?= python
 CPU_MESH = JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-.PHONY: ci native native-check test dryrun bench bench-fit
+.PHONY: ci native native-check lint pcg-lint test dryrun bench bench-fit
 
-ci: native native-check test dryrun
+ci: native native-check lint test dryrun
+
+lint:
+	$(PY) -c "from flexflow_tpu.analysis.hotpath_lint import main; \
+	  raise SystemExit(main(['flexflow_tpu']))"
+	$(PY) -m compileall -q flexflow_tpu tools
+
+pcg-lint:
+	$(CPU_MESH) $(PY) tools/pcg_lint.py --hotpath
 
 native:
 	$(MAKE) -C native -s

@@ -65,5 +65,7 @@ from .runtime.initializer import (
 from .runtime.dataloader import DataLoaderGroup, Prefetcher, SingleDataLoader
 from .runtime.guard import DivergenceError, TrainingGuard
 from .runtime.metrics import PerfMetrics
+from .analysis import (PCGValidationError, ValidationReport, lint_strategy,
+                       validate_pcg)
 
 __version__ = "0.1.0"

@@ -1,0 +1,38 @@
+"""Static analysis for the PCG pipeline: validator, linter, hot-path lint.
+
+Three passes, all runnable without executing a training step:
+
+* :func:`validate_pcg` (:mod:`.pcg_check`) — graph well-formedness +
+  sharding legality with ``PCG0xx`` codes and layer provenance; wired
+  into ``FFModel.compile()`` via ``config.validate_pcg`` and into every
+  ``.ffcache`` rehydration.
+* :func:`lint_strategy` (:mod:`.strategy_lint`) — non-fatal ``LINT0xx``
+  findings on legal-but-suspect strategies; exported by
+  ``tools/pcg_lint.py`` and renderable onto dot graphs via
+  ``utils/dot.annotate_findings``.
+* :func:`lint_hotpaths <.hotpath_lint.lint_paths>`
+  (:mod:`.hotpath_lint`) — AST ``HOT0xx`` race/sync lint over the
+  package source itself; the ``make lint`` gate.
+"""
+
+from .findings import (CODE_CATALOG, Finding, PCGValidationError,
+                       ValidationReport, layer_provenance,
+                       report_to_json_line)
+from .hotpath_lint import lint_paths as lint_hotpaths
+from .hotpath_lint import lint_source as lint_hotpath_source
+from .pcg_check import propagate_strategies, validate_pcg
+from .strategy_lint import lint_strategy
+
+__all__ = [
+    "CODE_CATALOG",
+    "Finding",
+    "PCGValidationError",
+    "ValidationReport",
+    "layer_provenance",
+    "lint_hotpath_source",
+    "lint_hotpaths",
+    "lint_strategy",
+    "propagate_strategies",
+    "report_to_json_line",
+    "validate_pcg",
+]

@@ -1,0 +1,218 @@
+"""Finding/report types shared by the static-analysis passes.
+
+The correctness story of the PCG pipeline rests on the graph being legal
+before it reaches the mapper (Unity/OSDI'22; "Beyond Data and Model
+Parallelism", arXiv:1807.05358 §4: the search only ever emits strategies
+the simulator could price, so anything else in the pipeline — a rehydrated
+cache payload, a rewritten variant, a hand-edited strategy file — must be
+re-checked). Every violation carries:
+
+* a machine-readable **code** (``PCG0xx`` validator errors, ``LINT0xx``
+  strategy-lint findings, ``HOT0xx`` hot-path lint findings) so tooling
+  and tests can assert on the exact class;
+* **layer provenance** — name, op type, and the originating rewrite rule
+  when the layer was produced by :mod:`..search.graph_xfer` (the builder
+  graph's layers have none) — so an error on a ``merged_...`` layer points
+  back at the rule that made it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Optional
+
+# ---------------------------------------------------------------- catalog
+# One line per code; tools/pcg_lint.py exports this table verbatim and the
+# README's lint-code catalog is generated from the same text.
+CODE_CATALOG: Dict[str, str] = {
+    # PCG validator (analysis/pcg_check.py) — compile-blocking classes
+    "PCG001": "graph order violation / cycle: a layer consumes a tensor "
+              "produced by a later layer (or produced twice)",
+    "PCG002": "dangling tensor ref: input tensor has no producer and is "
+              "not a model input",
+    "PCG003": "dead layer: no output is consumed and none is a protected "
+              "graph output (warning)",
+    "PCG004": "shape-flow mismatch: declared output dims differ from the "
+              "propagated ParallelTensorShape sizes",
+    "PCG005": "dtype-flow mismatch: declared output dtype differs from "
+              "the propagated dtype",
+    "PCG006": "unrealizable sharding: the strategy requests a mesh axis "
+              "the op's tensors cannot realize (indivisible dim or axis "
+              "conflict) — ops silently drop such requests, so the "
+              "executed plan would diverge from the stored one",
+    "PCG007": "mesh-axis violation: a partitioned dim references an axis "
+              "absent from the mesh or with a mismatched degree",
+    "PCG008": "duplicate mesh axis: one mesh axis shards two dims of the "
+              "same tensor (impossible GSPMD layout)",
+    "PCG009": "producer/consumer sharding inconsistency across an edge",
+    "PCG010": "memory budget exceeded: weight + optimizer state "
+              "(ZeRO- and pipe-aware) over the configured threshold "
+              "(warning: the memory-aware search may deliberately "
+              "report an over-budget trade-off)",
+    "PCG011": "schedule incompatibility: pipe axis degree exceeds the "
+              "graph's stage count (compile would silently un-pipe)",
+    "PCG012": "unregistered op type: no Op implementation for this layer",
+    "PCG013": "strategy for unknown layer: a strategy entry names no "
+              "layer in the graph (stale or corrupt plan)",
+    "PCG014": "propagation failure: the op rejected its inputs/strategy",
+    # strategy linter (analysis/strategy_lint.py) — legal but suspect
+    "LINT001": "replicated large weight where a free mesh axis could "
+               "shard it",
+    "LINT002": "degree-1 parallel choice: strategy entry or parallel op "
+               "maps to a trivial (size-1/absent) mesh axis",
+    "LINT003": "float-to-float cast in the step graph (mixed-precision "
+               "boundary cast in the hot loop)",
+    # hot-path lint (analysis/hotpath_lint.py) — source-level race/sync
+    "HOT000": "unparseable source file (syntax error) — nothing else "
+              "could be checked",
+    "HOT001": "host sync inside the step loop (block_until_ready / "
+              "float() / np.asarray / .item() on device values)",
+    "HOT002": "device work (jax call) on an input-pipeline worker thread",
+    "HOT003": "shared-state mutation in a worker thread without "
+              "lock/queue discipline",
+}
+
+_SEVERITIES = ("error", "warning", "info")
+
+
+@dataclasses.dataclass
+class Finding:
+    """One violation/observation from any analysis pass."""
+
+    code: str
+    severity: str  # "error" | "warning" | "info"
+    message: str
+    layer: Optional[str] = None      # layer name (graph passes)
+    op_type: Optional[str] = None    # op type string (graph passes)
+    origin: Optional[str] = None     # rewrite rule that made the layer
+    file: Optional[str] = None       # source file (hot-path lint)
+    line: Optional[int] = None       # source line (hot-path lint)
+
+    def __post_init__(self):
+        assert self.severity in _SEVERITIES, self.severity
+
+    def where(self) -> str:
+        if self.file is not None:
+            return f"{self.file}:{self.line}"
+        if self.layer is not None:
+            prov = f"layer '{self.layer}'"
+            if self.op_type:
+                prov += f" (op {self.op_type}"
+                prov += f", via rewrite {self.origin})" if self.origin \
+                    else ")"
+            return prov
+        return "<graph>"
+
+    def format(self) -> str:
+        return f"{self.code} [{self.severity}] {self.where()}: " \
+               f"{self.message}"
+
+    def to_dict(self) -> Dict:
+        return {k: v for k, v in dataclasses.asdict(self).items()
+                if v is not None}
+
+
+@dataclasses.dataclass
+class ValidationReport:
+    """Findings from one analysis run, ordered by discovery."""
+
+    findings: List[Finding] = dataclasses.field(default_factory=list)
+    source: str = "builder"  # "builder" | "cache" | "rewrite" | path
+
+    def add(self, code: str, message: str, *, severity: str = "error",
+            layer=None, **kw) -> Finding:
+        """Append one finding; ``layer`` may be a Layer object (provenance
+        is extracted) or a plain name string."""
+        name = op_type = origin = None
+        if layer is not None:
+            if isinstance(layer, str):
+                name = layer
+            else:
+                name = layer.name
+                op_type = getattr(getattr(layer, "op_type", None),
+                                  "value", None)
+                origin = layer.attrs.get("_origin_rewrite") \
+                    if getattr(layer, "attrs", None) else None
+        f = Finding(code=code, severity=severity, message=message,
+                    layer=name, op_type=op_type, origin=origin, **kw)
+        self.findings.append(f)
+        return f
+
+    @property
+    def errors(self) -> List[Finding]:
+        return [f for f in self.findings if f.severity == "error"]
+
+    @property
+    def warnings(self) -> List[Finding]:
+        return [f for f in self.findings if f.severity == "warning"]
+
+    def ok(self) -> bool:
+        return not self.errors
+
+    def codes(self) -> List[str]:
+        return [f.code for f in self.findings]
+
+    def format(self) -> str:
+        return "\n".join(f.format() for f in self.findings) or "clean"
+
+    def to_json(self) -> Dict:
+        """The machine-readable report (tools/pcg_lint.py schema)."""
+        return {
+            "source": self.source,
+            "errors": len(self.errors),
+            "warnings": len(self.warnings),
+            "findings": [f.to_dict() for f in self.findings],
+        }
+
+    def handle(self, mode: str, printer=print) -> None:
+        """Apply a ``config.validate_pcg`` mode: ``"error"`` raises
+        :class:`PCGValidationError` when any error-severity finding
+        exists (warnings stay silent on the report object); ``"warn"``
+        prints everything; ``"off"`` is a no-op."""
+        if mode == "off":
+            return
+        if mode == "error" and self.errors:
+            raise PCGValidationError(self)
+        if mode == "warn" and self.findings:
+            for f in self.findings:
+                printer(f"[pcg] {f.format()}", flush=True)
+
+
+class PCGValidationError(ValueError):
+    """A PCG validation gate failure. ``report`` carries every finding;
+    the message leads with the first error (code + layer provenance) so
+    the one-line traceback is already actionable."""
+
+    def __init__(self, report: ValidationReport):
+        self.report = report
+        errs = report.errors
+        head = errs[0].format() if errs else report.format()
+        more = f" (+{len(errs) - 1} more)" if len(errs) > 1 else ""
+        super().__init__(
+            f"PCG validation failed [{report.source}]: {head}{more}")
+
+
+def layer_provenance(layer) -> str:
+    """One-line provenance for compile-time error messages (the same
+    plumbing the validator's findings use): layer name, op type, and the
+    originating rewrite rule when the layer came out of graph_xfer."""
+    op = getattr(getattr(layer, "op_type", None), "value", None)
+    origin = layer.attrs.get("_origin_rewrite") \
+        if getattr(layer, "attrs", None) else None
+    s = f"layer '{layer.name}'"
+    if op:
+        s += f" (op {op}" + (f", via rewrite {origin})" if origin else ")")
+    return s
+
+
+def report_to_json_line(reports: Dict[str, ValidationReport],
+                        extra: Optional[Dict] = None) -> str:
+    """The one-line JSON record tools/pcg_lint.py emits."""
+    doc = {
+        "reports": {k: r.to_json() for k, r in reports.items()},
+        "codes": CODE_CATALOG,
+    }
+    if extra:
+        doc.update(extra)
+    return json.dumps(doc, sort_keys=True)

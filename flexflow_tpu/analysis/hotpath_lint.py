@@ -1,0 +1,287 @@
+"""Hot-path race/sync lint: AST checks over flexflow_tpu's own source.
+
+PR 2 made the step loop asynchronous end to end (bounded dispatch-ahead
+window, device-side metric folding, a Prefetcher worker thread) — which
+created two source-level hazard classes no runtime test reliably catches:
+
+* **HOT001 — host sync in the step loop.** A ``.block_until_ready()``,
+  ``float()``, ``np.asarray``/``np.array``, ``.item()`` or ``.tolist()``
+  on a device value inside the loop that dispatches
+  ``train_step``/``eval_step``/``train_k_steps`` stalls the dispatch
+  pipeline every iteration and silently reverts the loop to synchronous
+  throughput. The *step loop* is found structurally: the innermost
+  ``for``/``while`` whose body calls one of the step executables.
+* **HOT002 — device work on an input-pipeline worker thread.** Any call
+  into the ``jax`` namespace from a function used as a
+  ``threading.Thread(target=...)`` in ``runtime/`` contends with XLA's
+  execution locks (the exact contention runtime/dataloader.py's design
+  note documents — placement stays on the dispatch thread).
+* **HOT003 — unsynchronized shared-state mutation in a worker thread.**
+  Attribute/subscript stores or augmented assignments in a ``runtime/``
+  thread-target function outside any ``with`` (lock) block and not on a
+  queue — the data-race class a free-running worker introduces.
+
+Intentional syncs are annotated in source with a pragma comment on the
+same line: ``# hotpath: sync-ok (<reason>)`` for HOT001/002 and
+``# hotpath: lock-ok (<reason>)`` for HOT003. The pragma IS the review
+trail: every suppression names its reason.
+
+Thread rules (HOT002/003) are scoped to ``runtime/`` — the input
+pipeline and step loop layer. The serving engine's workers
+(serving/engine.py) run device inference by design (one worker per model
+instance is its batching architecture), so they are out of scope.
+
+Run as a module for the Makefile's ``lint`` gate::
+
+    python -m flexflow_tpu.analysis.hotpath_lint flexflow_tpu
+
+Exit status 1 when any finding fires; tests/test_analysis_lint.py keeps
+the repo itself lint-clean.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import Dict, List, Optional, Sequence, Set
+
+from .findings import Finding
+
+STEP_CALLS = {"train_step", "eval_step", "train_k_steps"}
+SYNC_ATTR_CALLS = {"block_until_ready", "item", "tolist"}
+SYNC_NAME_CALLS = {"float"}
+SYNC_NP_CALLS = {"asarray", "array"}
+SYNC_PRAGMA = "hotpath: sync-ok"
+LOCK_PRAGMA = "hotpath: lock-ok"
+# directories (relative to the package root) where thread-target rules
+# apply; see module docstring for why serving/ is exempt
+THREAD_RULE_DIRS = ("runtime",)
+
+
+def _module_aliases(tree: ast.Module) -> Dict[str, Set[str]]:
+    """Names bound to the numpy and jax modules in this file."""
+    np_alias, jax_alias = set(), set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "numpy":
+                    np_alias.add(a.asname or "numpy")
+                if a.name == "jax" or a.name.startswith("jax."):
+                    jax_alias.add((a.asname or a.name).split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            if node.module and node.module.split(".")[0] == "jax":
+                # `from jax import numpy as jnp` etc: bound SUBMODULES do
+                # device work. CamelCase from-imports are classes —
+                # NamedSharding/PartitionSpec/Mesh are pure host-side
+                # sharding metadata, not device calls — so only
+                # lowercase (module-shaped) names count.
+                for a in node.names:
+                    bound = a.asname or a.name
+                    if bound[:1].islower():
+                        jax_alias.add(bound)
+    return {"np": np_alias, "jax": jax_alias}
+
+
+def _attach_parents(tree: ast.AST) -> None:
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child._hp_parent = node  # type: ignore[attr-defined]
+
+
+def _innermost_loop(node: ast.AST) -> Optional[ast.AST]:
+    cur = getattr(node, "_hp_parent", None)
+    while cur is not None:
+        if isinstance(cur, (ast.For, ast.While, ast.AsyncFor)):
+            return cur
+        cur = getattr(cur, "_hp_parent", None)
+    return None
+
+
+def _inside_with(node: ast.AST, stop: ast.AST) -> bool:
+    cur = getattr(node, "_hp_parent", None)
+    while cur is not None and cur is not stop:
+        if isinstance(cur, (ast.With, ast.AsyncWith)):
+            return True
+        cur = getattr(cur, "_hp_parent", None)
+    return False
+
+
+def _has_pragma(lines: Sequence[str], node: ast.AST, pragma: str) -> bool:
+    ln = getattr(node, "lineno", 0)
+    return 0 < ln <= len(lines) and pragma in lines[ln - 1]
+
+
+def _rooted_at(expr: ast.AST, aliases: Set[str]) -> bool:
+    """True when an attribute/name chain is rooted at one of ``aliases``
+    (``jax.block_until_ready``, ``np.asarray``, bare ``jnp``...)."""
+    while isinstance(expr, ast.Attribute):
+        expr = expr.value
+    return isinstance(expr, ast.Name) and expr.id in aliases
+
+
+def _is_constant_arg(call: ast.Call) -> bool:
+    return bool(call.args) and isinstance(call.args[0], ast.Constant)
+
+
+def _sync_call_finding(call: ast.Call, aliases: Dict[str, Set[str]]
+                       ) -> Optional[str]:
+    """Classify one Call as a host sync, returning its description."""
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        if f.attr in SYNC_ATTR_CALLS:
+            return f".{f.attr}()"
+        if f.attr in SYNC_NP_CALLS and _rooted_at(f, aliases["np"]):
+            return f"np.{f.attr}()"
+    elif isinstance(f, ast.Name):
+        if f.id in SYNC_NAME_CALLS and call.args \
+                and not _is_constant_arg(call):
+            return f"{f.id}()"
+    return None
+
+
+def _step_loops(tree: ast.AST) -> List[ast.AST]:
+    """The innermost loop enclosing each step-executable call."""
+    loops: List[ast.AST] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in STEP_CALLS:
+            loop = _innermost_loop(node)
+            if loop is not None and loop not in loops:
+                loops.append(loop)
+    return loops
+
+
+def _thread_targets(tree: ast.AST) -> List[ast.FunctionDef]:
+    """FunctionDefs used as ``threading.Thread(target=...)`` in this
+    module (plain names and ``self._method`` attributes both resolve by
+    name)."""
+    wanted: Set[str] = set()
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and ((isinstance(node.func, ast.Attribute)
+                      and node.func.attr == "Thread")
+                     or (isinstance(node.func, ast.Name)
+                         and node.func.id == "Thread"))):
+            continue
+        for kw in node.keywords:
+            if kw.arg == "target":
+                if isinstance(kw.value, ast.Name):
+                    wanted.add(kw.value.id)
+                elif isinstance(kw.value, ast.Attribute):
+                    wanted.add(kw.value.attr)
+    return [n for n in ast.walk(tree)
+            if isinstance(n, ast.FunctionDef) and n.name in wanted]
+
+
+def lint_source(src: str, filename: str = "<string>",
+                thread_rules: bool = True) -> List[Finding]:
+    """Lint one module's source. ``thread_rules`` gates HOT002/003 (the
+    caller scopes them to THREAD_RULE_DIRS)."""
+    findings: List[Finding] = []
+    try:
+        tree = ast.parse(src, filename=filename)
+    except SyntaxError as e:
+        findings.append(Finding(
+            code="HOT000", severity="error", file=filename,
+            line=e.lineno or 0, message=f"syntax error: {e.msg}"))
+        return findings
+    _attach_parents(tree)
+    lines = src.splitlines()
+    aliases = _module_aliases(tree)
+
+    # --- HOT001: host syncs inside step loops ------------------------
+    for loop in _step_loops(tree):
+        for node in ast.walk(loop):
+            if not isinstance(node, ast.Call):
+                continue
+            desc = _sync_call_finding(node, aliases)
+            if desc and not _has_pragma(lines, node, SYNC_PRAGMA):
+                findings.append(Finding(
+                    code="HOT001", severity="error", file=filename,
+                    line=node.lineno,
+                    message=f"host sync {desc} inside the step loop "
+                            f"stalls dispatch every iteration "
+                            f"(annotate '# {SYNC_PRAGMA} (reason)' if "
+                            f"intentional)"))
+
+    if not thread_rules:
+        return findings
+
+    # --- HOT002/HOT003: worker-thread discipline ---------------------
+    for fn in _thread_targets(tree):
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                f = node.func
+                if (_rooted_at(f, aliases["jax"])
+                        or (isinstance(f, ast.Attribute)
+                            and f.attr == "device_put")) \
+                        and not _has_pragma(lines, node, SYNC_PRAGMA):
+                    findings.append(Finding(
+                        code="HOT002", severity="error", file=filename,
+                        line=node.lineno,
+                        message=f"jax/device call in thread worker "
+                                f"'{fn.name}' contends with XLA's "
+                                f"execution locks — keep placement on "
+                                f"the dispatch thread"))
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                shared = [t for t in targets
+                          if isinstance(t, (ast.Attribute, ast.Subscript))]
+                if shared and not _inside_with(node, fn) \
+                        and not _has_pragma(lines, node, LOCK_PRAGMA):
+                    findings.append(Finding(
+                        code="HOT003", severity="error", file=filename,
+                        line=node.lineno,
+                        message=f"shared-state store in thread worker "
+                                f"'{fn.name}' outside any lock — use a "
+                                f"queue or hold a lock (annotate "
+                                f"'# {LOCK_PRAGMA} (reason)' if safe)"))
+    return findings
+
+
+def lint_file(path: str, package_root: Optional[str] = None
+              ) -> List[Finding]:
+    with open(path) as f:
+        src = f.read()
+    rel = os.path.relpath(path, package_root) if package_root else path
+    thread_rules = any(
+        rel.replace(os.sep, "/").startswith(d + "/")
+        for d in THREAD_RULE_DIRS) if package_root else True
+    return lint_source(src, filename=path, thread_rules=thread_rules)
+
+
+def lint_paths(paths: Sequence[str]) -> List[Finding]:
+    """Lint every .py file under ``paths`` (files or directories)."""
+    findings: List[Finding] = []
+    for p in paths:
+        if os.path.isfile(p):
+            findings.extend(lint_file(p))
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    findings.extend(
+                        lint_file(os.path.join(dirpath, fn),
+                                  package_root=p))
+    return findings
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv:
+        # default: the package this module lives in
+        argv = [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+    findings = lint_paths(argv)
+    for f in findings:
+        print(f.format())
+    print(f"hotpath lint: {len(findings)} finding(s) over "
+          f"{', '.join(argv)}")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

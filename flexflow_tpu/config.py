@@ -110,6 +110,14 @@ class FFConfig:
     # entry; "off" (default) bypasses the cache entirely.
     search_cache: str = "off"
     search_cache_dir: str = ".ffcache/strategies"
+    # PCG validation gate (analysis/pcg_check.py): every compile — and
+    # every strategy rehydrated from the cache or produced by a graph
+    # rewrite — is statically checked for graph well-formedness and
+    # sharding legality BEFORE any XLA work. "error" (default) raises a
+    # PCG0xx-coded, layer-attributed PCGValidationError; "warn" prints
+    # every finding and proceeds (a corrupt cached strategy is treated
+    # as a miss); "off" restores the unchecked historical behavior.
+    validate_pcg: str = "error"
     substitution_json_path: Optional[str] = None
     machine_model_file: Optional[str] = None
     export_strategy_file: Optional[str] = None
@@ -246,6 +254,8 @@ class FFConfig:
                 cfg.search_cache = _next()
             elif a == "--search-cache-dir":
                 cfg.search_cache_dir = _next()
+            elif a == "--validate-pcg":
+                cfg.validate_pcg = _next()
             elif a == "--substitution-json":
                 cfg.substitution_json_path = _next()
             elif a == "--machine-model-file":

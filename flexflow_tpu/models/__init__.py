@@ -13,3 +13,69 @@ from .xdl import build_xdl, XDLConfig
 from .candle_uno import build_candle_uno, CandleUnoConfig
 from .nmt import build_nmt, NMTConfig
 from .gpt import build_gpt, GPTConfig
+
+
+def zoo_smoke_builders():
+    """name -> builder(ff, batch_size) for EVERY zoo model, at
+    CPU-test-friendly sizes. The single registry the static-analysis
+    tooling iterates (tools/pcg_lint.py ``--model all``,
+    tests/test_analysis.py's parametrized validator sweep) — adding a
+    model here makes it part of the compile-time correctness gate."""
+
+    def mlp(ff, bs):
+        build_mlp(ff, bs, in_dim=64, hidden_dims=(128, 128), num_classes=10)
+
+    def alexnet(ff, bs):
+        build_alexnet(ff, bs, image_size=64)
+
+    def resnet50(ff, bs):
+        build_resnet50(ff, bs, image_size=64)
+
+    def resnext50(ff, bs):
+        build_resnext50(ff, bs, image_size=64)
+
+    def inception_v3(ff, bs):
+        build_inception_v3(ff, bs, image_size=299)
+
+    def transformer(ff, bs):
+        build_transformer(ff, bs, TransformerConfig(
+            hidden_size=32, num_heads=4, num_layers=2, sequence_length=16))
+
+    def dlrm(ff, bs):
+        build_dlrm(ff, bs, DLRMConfig(embedding_size=[1000] * 4))
+
+    def moe(ff, bs):
+        build_moe_mnist(ff, bs, MoeConfig(
+            input_dim=16, num_exp=4, num_select=2, expert_hidden_size=32))
+
+    def xdl(ff, bs):
+        build_xdl(ff, bs, XDLConfig(embedding_size=[1000] * 4))
+
+    def candle_uno(ff, bs):
+        build_candle_uno(ff, bs, CandleUnoConfig(
+            dense_layers=[64] * 2, dense_feature_layers=[64] * 2))
+
+    def nmt(ff, bs):
+        build_nmt(ff, bs, NMTConfig(
+            src_vocab_size=200, tgt_vocab_size=200, embed_dim=32,
+            hidden_size=32, num_layers=1, src_length=8, tgt_length=8))
+
+    def gpt(ff, bs):
+        build_gpt(ff, bs, 16, GPTConfig(
+            vocab_size=128, max_positions=64, hidden_size=32,
+            num_heads=4, num_layers=2))
+
+    return {
+        "mlp": mlp,
+        "alexnet": alexnet,
+        "resnet50": resnet50,
+        "resnext50": resnext50,
+        "inception_v3": inception_v3,
+        "transformer": transformer,
+        "dlrm": dlrm,
+        "moe": moe,
+        "xdl": xdl,
+        "candle_uno": candle_uno,
+        "nmt": nmt,
+        "gpt": gpt,
+    }

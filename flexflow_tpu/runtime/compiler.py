@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from ..analysis.findings import layer_provenance
 from ..ffconst import CompMode, DataType, LossType, MetricsType, OpType
 from ..config import FFConfig
 from ..core.layer import Layer
@@ -96,7 +97,10 @@ def toposort_layers(layers: List[Layer]) -> List[Layer]:
     for l in layers:
         for t in l.inputs:
             if t.tensor_id in produced and t.tensor_id not in seen:
-                raise ValueError(f"layer graph not topologically ordered at {l}")
+                raise ValueError(
+                    f"{layer_provenance(l)}: layer graph not "
+                    f"topologically ordered (consumes tensor "
+                    f"'{t.name}' produced by a later layer)")
         for t in l.outputs:
             seen.add(t.tensor_id)
     return layers
@@ -112,16 +116,26 @@ def build_ops(
     pshapes: Dict[int, ParallelTensorShape] = dict(input_pshapes)
     ops: List[Op] = []
     for layer in toposort_layers(layers):
+        # every compile-time failure below carries full layer provenance
+        # (name, op type, originating rewrite rule — the validator's
+        # plumbing, analysis/findings.py) instead of a bare mismatch
         in_shapes = [pshapes[t.tensor_id] for t in layer.inputs]
         op = create_op(layer, in_shapes)
         strategy = dict(strategies.get(layer.name, {}))
         strategy["_axis_sizes"] = axis_sizes
         op.axis_sizes = dict(axis_sizes)  # single source for sim/search costs
-        out_shapes, weight_shapes = op.propagate(in_shapes, strategy)
+        try:
+            out_shapes, weight_shapes = op.propagate(in_shapes, strategy)
+        except (AssertionError, ValueError, KeyError, IndexError) as e:
+            raise ValueError(
+                f"{layer_provenance(layer)}: sharding propagation "
+                f"rejected strategy {strategies.get(layer.name)} on "
+                f"inputs {[str(s) for s in in_shapes]}: {e}") from e
         for ps in list(out_shapes) + list(weight_shapes.values()):
             if ps.has_duplicate_axes():
                 raise ValueError(
-                    f"{layer.name}: strategy {strategies.get(layer.name)} "
+                    f"{layer_provenance(layer)}: strategy "
+                    f"{strategies.get(layer.name)} "
                     f"maps one mesh axis onto two dims of a tensor "
                     f"({ps.partition_spec()}) — impossible GSPMD layout; "
                     f"pick a different axis for this op")
@@ -132,8 +146,9 @@ def build_ops(
         for i, (t, ps) in enumerate(zip(declared, out_shapes)):
             if tuple(t.dims) != tuple(ps.sizes):
                 raise ValueError(
-                    f"{layer.name} output {i}: declared {t.dims} vs propagated {ps.sizes}"
-                )
+                    f"{layer_provenance(layer)} output {i}: declared "
+                    f"dims {tuple(t.dims)} vs propagated "
+                    f"{tuple(ps.sizes)}")
             pshapes[t.tensor_id] = ps
         ops.append(op)
     return ops, pshapes

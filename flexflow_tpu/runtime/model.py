@@ -71,6 +71,10 @@ class FFModel:
         # ahead occupancy); surfaced by runtime/profiling.fit_report
         self.fit_profile = None
         self.eval_profile = None
+        # analysis.ValidationReport from the last compile()'s PCG gate
+        # (config.validate_pcg); None when the gate is off
+        self.pcg_report = None
+        self._pcg_prevalidated = None  # cache-hit report handoff
         self._search_strategies: Dict[str, Dict[str, str]] = {}
         self.iter_config = FFIterationConfig()
         self._param_index: Dict[int, Tuple[str, str]] = {}  # tensor_id -> (op, weight)
@@ -740,6 +744,9 @@ class FFModel:
         # pooler is not the tensor to train on); default: the last leaf
         logits = logits_tensor if logits_tensor is not None \
             else self._final_output()
+        # drop any cache-hit pre-validation from a PREVIOUS compile (the
+        # gate below only reuses a report produced this compile)
+        self._pcg_prevalidated = None
         # collect per-layer strategy attrs (the ParallelConfig-override path)
         self._search_layers = None  # set by _run_search when a rewrite wins
         strat = dict(strategies or {})
@@ -770,6 +777,44 @@ class FFModel:
         # logits — are the original Tensor objects, so everything
         # downstream (loss attachment, metrics) is unchanged
         compile_layers = self._search_layers or self.layers
+        # --- PCG validation gate (analysis/pcg_check.py): the
+        # post-search, post-rewrite graph plus the strategies actually
+        # in effect, checked statically before any param init / XLA
+        # trace. Runs BEFORE fusion — strategy entries name these
+        # layers; the fused graph is derived mechanically and its
+        # residual failure modes surface through build_ops' provenance-
+        # carrying errors. Findings carry PCG0xx codes and layer
+        # provenance (incl. the originating rewrite rule);
+        # config.validate_pcg picks raise/print/skip.
+        self.pcg_report = None
+        vmode = self._validate_mode()
+        if vmode != "off":
+            from ..core.machine import DATA_AXIS, mesh_axis_sizes as _mas
+
+            if mesh is not None:
+                vaxes = _mas(mesh)
+            elif self.config.mesh_shape:
+                vaxes = dict(self.config.mesh_shape)
+            else:
+                vaxes = {DATA_AXIS: len(jax.devices())}
+            # a cache hit already validated this exact strategies object
+            # against these layers/mesh in _validate_cached (and applied
+            # the mode policy there) — reuse its report instead of
+            # paying a second identical propagation walk
+            pre = getattr(self, "_pcg_prevalidated", None)
+            if pre is not None and pre[0] == id(strat):
+                self.pcg_report = pre[1]
+            else:
+                from ..analysis import validate_pcg as _validate_pcg
+
+                src = ("rewrite" if self._search_layers is not None
+                       else "builder")
+                self.pcg_report = _validate_pcg(
+                    compile_layers, self._used_inputs(), strat, vaxes,
+                    protected=frozenset({logits.tensor_id}),
+                    config=self.config, source=src)
+                self.pcg_report.handle(vmode)
+        self._pcg_prevalidated = None
         if self.config.perform_fusion:
             # reference: the --fusion pass packing adjacent ops
             # (model.cc:2964-3061); here it shrinks the graph the search
@@ -793,6 +838,21 @@ class FFModel:
                 pipeline = PipelineConfig(
                     num_stages=pipe_deg,
                     num_microbatches=pipe_microbatches(self.config.batch_size))
+            elif (pipe_deg > 1 and self.pcg_report is not None
+                  and "PCG011" not in self.pcg_report.codes()):
+                # the gate ran pre-fusion (strategy names live there);
+                # fusion shrinking the graph below the stage count is
+                # only knowable HERE — report the silent un-pipe the
+                # fallback below performs (PCG011, warning; skipped when
+                # the pre-fusion walk already flagged the same bound)
+                f = self.pcg_report.add(
+                    "PCG011",
+                    f"mesh pipe axis has degree {pipe_deg} but the "
+                    f"post-fusion graph has only {len(compile_layers)} "
+                    f"ops; compiling un-piped — the pipe axis stays "
+                    f"idle", severity="warning")
+                if vmode == "warn":
+                    print(f"[pcg] {f.format()}", flush=True)
         self.compiled = compile_model(
             self.config,
             compile_layers,
@@ -945,7 +1005,8 @@ class FFModel:
         cache_key = None
         cache_dir = getattr(cfg, "search_cache_dir", ".ffcache/strategies")
         if cache_mode in ("on", "refresh") and not use_mcmc:
-            from ..search.cache import (load_payload, result_from_payload,
+            from ..search.cache import (cache_path, load_payload,
+                                        result_from_payload,
                                         strategy_cache_key)
 
             cache_key = strategy_cache_key(
@@ -957,6 +1018,14 @@ class FFModel:
                 if payload is not None:
                     result = result_from_payload(payload, self.layers, cfg,
                                                  protected)
+                    # trust boundary: a rehydrated payload is validated
+                    # BEFORE any compile work — a corrupted entry raises
+                    # a PCG0xx-coded error (validate_pcg="error") or
+                    # demotes to a miss ("warn"), never compiles
+                    if result is not None and not self._validate_cached(
+                            result, inputs, protected,
+                            cache_path(cache_dir, cache_key)):
+                        result = None
                     if result is not None:
                         if not pinned:
                             self.config.mesh_shape = result.mesh_shape
@@ -1084,7 +1153,10 @@ class FFModel:
         if cache_key is not None:
             from ..search.cache import store_result, strategy_cache_key
 
-            store_result(cache_dir, cache_key, result)
+            # self.layers rides along so the stored strategy keys (which
+            # may embed process-local auto names) can remap positionally
+            # when another process rehydrates them
+            store_result(cache_dir, cache_key, result, layers=self.layers)
             if not pinned:
                 # the first compile pins config.mesh_shape to the searched
                 # mesh, so a recompile keys the cache with the mesh PINNED
@@ -1093,13 +1165,57 @@ class FFModel:
                                           mesh_axes=result.mesh_shape,
                                           protected=protected)
                 if key2 != cache_key:
-                    store_result(cache_dir, key2, result)
+                    store_result(cache_dir, key2, result,
+                                 layers=self.layers)
         # cache_key None = the cache never engaged (off, or mcmc bypass):
         # the label must say so even when cache_mode asked for "refresh"
         return self._finish_search(
             result, mesh, t_search,
             "off" if cache_key is None else
             ("refresh" if cache_mode == "refresh" else "miss"))
+
+    def _validate_mode(self) -> str:
+        """The config.validate_pcg gate mode, with the same typo guard
+        the cache mode gets (a misspelled mode must not silently turn
+        the correctness gate off)."""
+        mode = getattr(self.config, "validate_pcg", "error") or "off"
+        if mode not in ("error", "warn", "off"):
+            raise ValueError(
+                f"validate_pcg={mode!r}: expected 'error', 'warn' or "
+                "'off'")
+        return mode
+
+    def _validate_cached(self, result, inputs, protected,
+                         entry_path: str) -> bool:
+        """PCG-validate a strategy rehydrated from the persistent cache
+        (the variant graph when the stored rewrites re-applied, else the
+        builder graph). Returns False to demote the hit to a miss; in
+        "error" mode a corrupt entry raises the coded error instead —
+        the user asked for a hard gate and silently re-searching would
+        hide the corruption."""
+        mode = self._validate_mode()
+        if mode == "off":
+            return True
+        from ..analysis import validate_pcg
+
+        vlayers = result.layers or self.layers
+        report = validate_pcg(
+            vlayers, inputs, result.strategies, result.mesh_shape,
+            protected=protected, config=self.config,
+            source=f"cache:{entry_path}")
+        # "error" mode raises the coded error on any error finding;
+        # "warn" mode prints EVERY finding (warnings included — the
+        # documented contract), then errors demote the hit to a miss
+        report.handle(mode)
+        if report.errors:
+            print(f"[search] cached strategy {entry_path} failed PCG "
+                  f"validation ({report.errors[0].code}); treating as a "
+                  f"miss", flush=True)
+            return False
+        # compile()'s gate reuses this report for the SAME strategies
+        # object instead of re-walking the identical triple
+        self._pcg_prevalidated = (id(result.strategies), report)
+        return True
 
     def _finish_search(self, result, mesh, t_start, cache_label: str):
         """Shared tail of _run_search for searched AND cache-hit results:
@@ -1539,7 +1655,7 @@ class FFModel:
                                         "check_interval", 1))
                     if (recompile_state.iteration + 1) % ci == 0:
                         src = prev_loss if prev_loss is not None else loss
-                        recompile_state.last_metric = float(src)
+                        recompile_state.last_metric = float(src)  # hotpath: sync-ok (throttled to check_interval; reads the PREVIOUS step's already-ready loss)
                     if recompile_on_condition(self, recompile_state):
                         cm = self.compiled
                 prev_loss = loss

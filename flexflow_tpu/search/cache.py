@@ -25,11 +25,18 @@ Key = SHA-256 over three signatures:
   transfer across them.
 
 Values are JSON files under ``<cache_dir>/<key>.json`` (default
-``.ffcache/strategies/``), written atomically. A result that won on a
-structurally rewritten graph stores only the rewrite NAMES; rehydration
-re-derives the variant through :func:`~.graph_xfer.rehydrate_variant` and
-treats any mismatch (renamed layers, changed rule set) as a miss — the
-cache can go stale, never wrong.
+``.ffcache/strategies/``), written atomically, carrying the entry-level
+``schema`` version (:data:`PAYLOAD_SCHEMA`): rehydration validates the
+schema version and every required payload field BEFORE reading anything,
+so a truncated or hand-edited entry demotes to a clearly-attributed miss
+(:class:`CacheSchemaWarning`) instead of an AttributeError deep in the
+search machinery, and the rehydrated strategy is then PCG-validated by
+``FFModel._validate_cached`` before any compile work. A result that won
+on a structurally rewritten graph stores only the rewrite NAMES;
+rehydration re-derives the variant through
+:func:`~.graph_xfer.rehydrate_variant` and treats any mismatch (renamed
+layers, changed rule set) as a miss — the cache can go stale, never
+wrong.
 """
 
 from __future__ import annotations
@@ -39,11 +46,55 @@ import hashlib
 import json
 import os
 import time
+import warnings
 from typing import Dict, List, Optional, Sequence
 
 from .unity import GraphSearchResult
 
-CACHE_VERSION = 1
+# v2: auto-generated layer names are canonicalized in the graph
+# signature (they embed the process-global layer guid, which broke the
+# "same graph, same key" promise for any graph with an unnamed layer),
+# and payloads carry the toposorted layer-name list so strategies remap
+# positionally on rehydration in another process.
+CACHE_VERSION = 2
+
+# Version of the RESULT payload layout inside an entry (the fields
+# result_to_payload writes and result_from_payload reads). Orthogonal to
+# CACHE_VERSION, which versions the KEY derivation: a key-derivation
+# change re-addresses entries, a payload-layout change invalidates their
+# CONTENT. Rehydration validates this before touching any field, so a
+# layout change (or a hand-edited entry) fails with a clear
+# schema-mismatch message instead of a downstream AttributeError.
+PAYLOAD_SCHEMA = 2
+
+# required payload fields and their validators: rehydration checks every
+# one of these BEFORE constructing a GraphSearchResult
+_PAYLOAD_FIELDS = {
+    "strategies": lambda v: (isinstance(v, dict)
+                             and all(isinstance(k, str)
+                                     and isinstance(s, dict)
+                                     for k, s in v.items())),
+    "mesh_shape": lambda v: (isinstance(v, dict)
+                             and all(isinstance(s, int)
+                                     and not isinstance(s, bool)
+                                     and s >= 1
+                                     for s in v.values())),
+    "est_step_time": lambda v: isinstance(v, (int, float)),
+    "est_memory": lambda v: isinstance(v, (int, float)),
+    "rewrites": lambda v: (isinstance(v, list)
+                           and all(isinstance(r, str) for r in v)),
+}
+
+
+class CacheSchemaWarning(UserWarning):
+    """A cache entry was rejected for SCHEMA reasons (version mismatch
+    or malformed payload). Schema failures are always a MISS, never an
+    error — malformed storage must never fail a compile. (A
+    schema-VALID entry whose strategy fails PCG validation is a
+    different boundary: under ``validate_pcg="error"`` the user asked
+    for a hard gate and FFModel._validate_cached raises the coded error
+    rather than hiding the corruption behind a silent re-search;
+    ``"warn"`` demotes it to a miss.)"""
 
 # config knobs that can change what the search selects (NOT how fast it
 # runs) — the adoption margin depends on playoff_steps, the beam on
@@ -86,9 +137,24 @@ def _attr_sig(v):
     return v.__class__.__name__
 
 
+def _canon_layer_name(layer) -> str:
+    """A layer's name with the process-local guid scrubbed. Unnamed
+    layers auto-name as ``{op_type}_{layer_guid}`` (core/layer.py) and
+    the guid counter is process-global, so the raw name would make the
+    key process-local — exactly what the dense tensor-id remap below
+    exists to prevent. Explicit user names pass through untouched."""
+    auto = f"{layer.op_type.value}_{layer.layer_guid}"
+    if layer.name == auto:
+        return f"{layer.op_type.value}__auto"
+    return layer.name
+
+
 def graph_signature(layers: Sequence, input_tensors: Sequence,
                     protected: Optional[frozenset] = None) -> List:
-    """Layer toposort with tensor ids remapped to dense local indices.
+    """Layer toposort with tensor ids remapped to dense local indices
+    and auto-generated layer names canonicalized (see
+    :func:`_canon_layer_name`), so two identical models built in
+    different processes — or twice in one — collide on the same key.
     ``protected`` (tensor ids that must survive as graph outputs — the
     logits choice) is part of the signature: it changes rewrite legality
     and the pipe-stage bound, so two compiles of the same graph with
@@ -107,7 +173,7 @@ def graph_signature(layers: Sequence, input_tensors: Sequence,
             if not k.startswith("_")
         )
         sig.append([
-            layer.name,
+            _canon_layer_name(layer),
             str(layer.op_type),
             attrs,
             [tref(t) for t in layer.inputs],
@@ -182,8 +248,15 @@ def cache_path(cache_dir: str, key: str) -> str:
     return os.path.join(cache_dir, f"{key}.json")
 
 
-def result_to_payload(result: GraphSearchResult) -> Dict:
-    return {
+def result_to_payload(result: GraphSearchResult,
+                      layers: Optional[Sequence] = None) -> Dict:
+    """``layers``: the toposorted layer list the strategies refer to
+    (the rewritten variant when one won, else the builder graph).
+    Stored as ``layer_names`` so rehydration in ANOTHER process — where
+    auto-generated names carry different guids — can remap strategy
+    keys positionally instead of missing on every unnamed layer."""
+    names_src = result.layers if result.layers is not None else layers
+    payload = {
         "strategies": result.strategies,
         "mesh_shape": result.mesh_shape,
         "est_step_time": result.est_step_time,
@@ -194,10 +267,13 @@ def result_to_payload(result: GraphSearchResult) -> Dict:
         "candidates": result.candidates,
         "pruned": result.pruned,
     }
+    if names_src is not None:
+        payload["layer_names"] = [l.name for l in names_src]
+    return payload
 
 
-def store_result(cache_dir: str, key: str,
-                 result: GraphSearchResult) -> Optional[str]:
+def store_result(cache_dir: str, key: str, result: GraphSearchResult,
+                 layers: Optional[Sequence] = None) -> Optional[str]:
     """Atomic write; returns the path, or None when the cache dir is
     unwritable (caching must never fail a compile)."""
     try:
@@ -207,9 +283,10 @@ def store_result(cache_dir: str, key: str,
         with open(tmp, "w") as f:
             json.dump({
                 "version": CACHE_VERSION,
+                "schema": PAYLOAD_SCHEMA,
                 "key": key,
                 "created_at": time.time(),
-                "result": result_to_payload(result),
+                "result": result_to_payload(result, layers),
             }, f, indent=1)
         os.replace(tmp, path)
         return path
@@ -217,15 +294,63 @@ def store_result(cache_dir: str, key: str,
         return None
 
 
+def validate_payload(payload) -> List[str]:
+    """Schema problems in a result payload (empty list = valid). Checked
+    BEFORE rehydration reads any field, so a truncated/hand-edited entry
+    is rejected with a named-field message instead of surfacing later as
+    an AttributeError inside the search machinery."""
+    if not isinstance(payload, dict):
+        return [f"payload is {type(payload).__name__}, expected object"]
+    problems = []
+    if "layer_names" in payload and not (
+            isinstance(payload["layer_names"], list)
+            and all(isinstance(n, str) for n in payload["layer_names"])):
+        problems.append("optional field 'layer_names' is not a list of "
+                        "strings")
+    for field, check in _PAYLOAD_FIELDS.items():
+        if field not in payload:
+            problems.append(f"missing required field '{field}'")
+            continue
+        try:
+            ok = check(payload[field])
+        except (TypeError, ValueError):
+            ok = False
+        if not ok:
+            problems.append(
+                f"field '{field}' has malformed value "
+                f"{payload[field]!r:.80}")
+    return problems
+
+
 def load_payload(cache_dir: str, key: str) -> Optional[Dict]:
+    path = cache_path(cache_dir, key)
     try:
-        with open(cache_path(cache_dir, key)) as f:
+        with open(path) as f:
             doc = json.load(f)
-    except (OSError, ValueError):
+    except OSError:
+        return None
+    except ValueError as e:
+        warnings.warn(f"strategy cache entry {path} is not valid JSON "
+                      f"({e}); treating as a miss", CacheSchemaWarning)
         return None
     if doc.get("version") != CACHE_VERSION or doc.get("key") != key:
         return None
-    return doc.get("result")
+    if doc.get("schema") != PAYLOAD_SCHEMA:
+        warnings.warn(
+            f"strategy cache entry {path} has payload schema "
+            f"{doc.get('schema')!r}, this build expects {PAYLOAD_SCHEMA}; "
+            f"treating as a miss (delete the cache dir to silence)",
+            CacheSchemaWarning)
+        return None
+    payload = doc.get("result")
+    problems = validate_payload(payload)
+    if problems:
+        warnings.warn(
+            f"strategy cache entry {path} failed payload validation: "
+            f"{'; '.join(problems)}; treating as a miss",
+            CacheSchemaWarning)
+        return None
+    return payload
 
 
 def result_from_payload(payload: Dict, layers, config=None,
@@ -247,6 +372,18 @@ def result_from_payload(payload: Dict, layers, config=None,
         strategies = {
             k: dict(v) for k, v in payload["strategies"].items()
         }
+        # cross-process rename map: auto-generated layer names embed the
+        # process-global guid counter, so the stored names need not
+        # match this process's. The stored toposort aligns 1:1 with the
+        # replayed variant (same graph signature, same rewrites), so
+        # strategy keys remap positionally; anything left unmapped must
+        # still name a current layer or the entry is stale.
+        stored_names = payload.get("layer_names")
+        if stored_names is not None and len(stored_names) == len(vlayers):
+            rename = {str(old): l.name
+                      for old, l in zip(stored_names, vlayers)}
+            strategies = {rename.get(k, k): v
+                          for k, v in strategies.items()}
         if not set(strategies).issubset(names):
             return None
         return GraphSearchResult(

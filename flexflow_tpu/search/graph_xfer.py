@@ -143,7 +143,8 @@ class LinearActivationFusion(GraphRewrite):
         li, ui, act = site
         lin, unary = layers[li], layers[ui]
         fused = Layer(OpType.LINEAR, name=lin.name, inputs=list(lin.inputs),
-                      attrs={**lin.attrs, "activation": act})
+                      attrs={**lin.attrs, "activation": act,
+                             "_origin_rewrite": self.name})
         fused.outputs = [unary.outputs[0]]
         out = []
         for i, l in enumerate(layers):
@@ -212,6 +213,9 @@ class _ParallelMerge(GraphRewrite):
         cat = layers[ci]
         branches = [layers[i] for i in branch_idx]
         merged = self._merged_layer(branches)
+        # provenance: validator/compiler findings on this layer name the
+        # rule that created it (analysis/findings.py layer_provenance)
+        merged.attrs["_origin_rewrite"] = self.name
         merged.outputs = [cat.outputs[0]]
         drop = set(branch_idx) | {ci}
         first = min(branch_idx)

@@ -629,6 +629,9 @@ class JsonRuleRewrite(GraphRewrite):
             else:
                 layer = Layer(_KIND_OF[n.op], name=None, inputs=ins,
                               attrs={})
+            # provenance for validator/compiler findings on this layer
+            # (analysis/findings.py layer_provenance)
+            layer.attrs["_origin_rewrite"] = self.name
             # infer output shapes through the real op implementation
             try:
                 probe = create_op(layer, [

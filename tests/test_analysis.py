@@ -1,0 +1,484 @@
+"""PCG validator + strategy linter tests (analysis/).
+
+Parametrized clean-report sweeps over EVERY zoo model (default plan,
+searched plan, and every per-layer search candidate), plus targeted
+corruption tests asserting the exact PCG0xx code fires, and the
+compile()-gate / cache trust-boundary end-to-end paths."""
+
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+from flexflow_tpu.analysis import (CODE_CATALOG, PCGValidationError,
+                                   lint_strategy, validate_pcg)
+from flexflow_tpu.core.layer import Layer
+from flexflow_tpu.core.tensor import Tensor
+from flexflow_tpu.ffconst import DataType, OpType
+from flexflow_tpu.models import build_mlp, zoo_smoke_builders
+
+BS = 16
+TP_MESH = {"data": 2, "model": 4}
+
+ZOO = zoo_smoke_builders()
+
+
+def _build(name):
+    ff = FFModel(FFConfig(batch_size=BS))
+    ZOO[name](ff, BS)
+    return ff
+
+
+def _validate(ff, strategies, axes, **kw):
+    return validate_pcg(ff.layers, ff._used_inputs(), strategies, axes,
+                        protected={ff._final_output().tensor_id},
+                        config=ff.config, **kw)
+
+
+# --------------------------------------------------------- clean sweeps
+@pytest.mark.parametrize("name", sorted(ZOO))
+def test_zoo_default_plan_validates_clean(name):
+    ff = _build(name)
+    report = _validate(ff, {}, {"data": 8})
+    assert report.ok(), report.format()
+
+
+@pytest.mark.parametrize("name", sorted(ZOO))
+def test_zoo_searched_strategy_validates_clean(name):
+    """The acceptance sweep: the Unity search's winning strategy for
+    every bundled model passes the validator with zero errors."""
+    from flexflow_tpu.search.unity import full_search
+    from flexflow_tpu.sim import detect_machine_model
+
+    ff = _build(name)
+    protected = frozenset({ff._final_output().tensor_id})
+    res = full_search(ff.layers, ff._used_inputs(), detect_machine_model(),
+                      ff.config, beam_width=8, max_pipe=1,
+                      protected=protected)
+    layers = res.layers or ff.layers
+    report = validate_pcg(layers, ff._used_inputs(), res.strategies,
+                          res.mesh_shape, protected=protected,
+                          config=ff.config)
+    assert report.ok(), report.format()
+
+
+@pytest.mark.parametrize("name", sorted(ZOO))
+def test_zoo_search_candidates_validate_clean(name):
+    """Every per-layer candidate the search could ever price on a TP
+    mesh is realizable: candidate generation (search/substitution.py)
+    divisibility-filters, and the validator must agree with that filter
+    — a disagreement means the search prices plans that would silently
+    run as something else."""
+    from flexflow_tpu.search.substitution import candidate_strategies
+
+    ff = _build(name)
+    axes = dict(TP_MESH)
+    checked = 0
+    for layer in ff.layers:
+        # config=None: all candidate families enabled, the search's own
+        # most-permissive setting
+        for cand in candidate_strategies(layer, axes, None):
+            if not cand:
+                continue
+            report = _validate(ff, {layer.name: cand}, axes)
+            assert report.ok(), (layer.name, cand, report.format())
+            checked += 1
+    # at least the linear-heavy models must have produced candidates
+    if name in ("mlp", "transformer", "gpt", "dlrm"):
+        assert checked > 0
+
+
+# ------------------------------------------------------ corruption tests
+def test_indivisible_shard_dim_fires_pcg006():
+    ff = _build("mlp")
+    # mlp_head out_dim=10; model axis 4 does not divide it
+    report = _validate(ff, {"mlp_head": {"out": "model"}}, TP_MESH)
+    assert [f.code for f in report.errors] == ["PCG006"]
+    f = report.errors[0]
+    assert f.layer == "mlp_head" and f.op_type == "linear"
+
+
+def test_dropped_entry_masked_by_inherited_axis_fires_pcg006():
+    """Detection is by ablation, not realized-axis scanning: Linear
+    refuses {"out": "data"} because "data" already shards the output's
+    batch dim — the axis is realized on the op ANYWAY, which must not
+    mask the fact that the entry itself was dropped."""
+    ff = _build("mlp")
+    report = _validate(ff, {"mlp_dense0": {"out": "data"}}, {"data": 8})
+    codes = [f.code for f in report.errors]
+    assert codes == ["PCG006"], report.format()
+
+
+def test_cycle_injection_fires_pcg001():
+    ff = _build("mlp")
+    layers = list(ff.layers)
+    # make the first dense consume the head's output: a back edge
+    layers[0].inputs.append(layers[-2].outputs[0])
+    report = validate_pcg(layers, ff._used_inputs(), {}, {"data": 8},
+                          config=ff.config)
+    assert "PCG001" in [f.code for f in report.errors]
+
+
+def test_dangling_ref_fires_pcg002():
+    ff = _build("mlp")
+    layers = [l for l in ff.layers if l.name != "mlp_dense1"]
+    report = validate_pcg(layers, ff._used_inputs(), {}, {"data": 8},
+                          config=ff.config)
+    codes = [f.code for f in report.errors]
+    assert "PCG002" in codes, report.format()
+
+
+def test_shape_flow_mismatch_fires_pcg004():
+    ff = _build("mlp")
+    # declare a wrong output size on the head layer
+    head = [l for l in ff.layers if l.name == "mlp_head"][0]
+    head.outputs[0].dims = (BS, 12)  # propagation will say (BS, 10)
+    report = _validate(ff, {}, {"data": 8})
+    assert "PCG004" in [f.code for f in report.errors]
+
+
+def test_unregistered_op_fires_pcg012():
+    ff = _build("mlp")
+    t_in = ff.layers[-1].outputs[0]
+    bogus = Layer(OpType.FUSED_PARALLEL, name="bogus", inputs=[t_in])
+    bogus.outputs.append(Tensor((BS, 10), DataType.FLOAT,
+                                owner_layer=bogus, name="bogus:out0"))
+    report = validate_pcg(ff.layers + [bogus], ff._used_inputs(), {},
+                          {"data": 8}, config=ff.config)
+    assert "PCG012" in [f.code for f in report.errors]
+
+
+def test_stale_strategy_name_warns_pcg013():
+    ff = _build("mlp")
+    report = _validate(ff, {"no_such_layer": {"out": "model"}}, TP_MESH)
+    assert report.ok()  # warning, not error
+    assert "PCG013" in [f.code for f in report.warnings]
+
+
+def test_unknown_axis_warns_pcg007():
+    ff = _build("mlp")
+    report = _validate(ff, {"mlp_dense0": {"out": "model"}}, {"data": 8})
+    assert report.ok()
+    assert "PCG007" in [f.code for f in report.warnings]
+
+
+def test_dead_layer_warns_pcg003():
+    ff = _build("mlp")
+    x = ff.layers[0].inputs[0]
+    dead = Layer(OpType.RELU, name="dead_relu", inputs=[x])
+    dead.outputs.append(Tensor(x.dims, DataType.FLOAT, owner_layer=dead,
+                               name="dead:out0"))
+    # insert BEFORE the final layer so the dead output is not the graph's
+    # final leaf
+    layers = ff.layers[:-1] + [dead] + ff.layers[-1:]
+    report = validate_pcg(layers, ff._used_inputs(), {}, {"data": 8},
+                          protected={ff._final_output().tensor_id},
+                          config=ff.config)
+    assert report.ok()
+    assert ["PCG003"] == [f.code for f in report.warnings
+                          if f.layer == "dead_relu"]
+
+
+def test_memory_budget_fires_pcg010():
+    """PCG010 is a WARNING (the memory-aware search may deliberately
+    report an over-budget trade-off, unity.py strict_budget=False — the
+    gate must not turn that into a hard compile failure), scaled by the
+    pipe degree like memory_aware_search's own budget convention."""
+    ff = FFModel(FFConfig(batch_size=BS, memory_threshold_mb=1))
+    # ~16 MiB of fp32 weights >> the 1 MiB budget
+    build_mlp(ff, BS, in_dim=1024, hidden_dims=(2048,), num_classes=10)
+    report = _validate(ff, {}, {"data": 8})
+    assert report.ok()  # warning, not a compile blocker
+    assert "PCG010" in [f.code for f in report.warnings]
+    # ZeRO + a model axis shrink per-device state but weights still blow
+    # the 1 MiB budget; the message must reflect the ZeRO accounting
+    ff2 = FFModel(FFConfig(batch_size=BS, memory_threshold_mb=1,
+                           zero_optimizer=True))
+    build_mlp(ff2, BS, in_dim=1024, hidden_dims=(2048,), num_classes=10)
+    report2 = _validate(ff2, {}, {"data": 8})
+    pcg10 = [f for f in report2.warnings if f.code == "PCG010"]
+    assert pcg10 and "ZeRO on" in pcg10[0].message
+    # a pipe axis scales the budget by the stage count (each stage holds
+    # ~1/P of the model): 16 stages x 1 MiB covers the ~16 MiB model
+    ff3 = FFModel(FFConfig(batch_size=BS, memory_threshold_mb=2))
+    build_mlp(ff3, BS, in_dim=1024, hidden_dims=(2048,), num_classes=10)
+    report3 = _validate(ff3, {}, {"data": 1, "pipe": 16})
+    assert "PCG010" not in [f.code for f in report3.findings]
+
+
+def test_pipe_oversubscription_warns_pcg011():
+    ff = _build("mlp")  # 4 layers
+    report = _validate(ff, {}, {"pipe": 8, "data": 1})
+    assert "PCG011" in [f.code for f in report.warnings]
+
+
+def test_rewrite_provenance_in_findings():
+    """A finding on a rewritten layer names the originating rule."""
+    from flexflow_tpu.search.graph_xfer import ParallelLinearMerge
+
+    ff = FFModel(FFConfig(batch_size=BS))
+    x = ff.create_tensor((BS, 32), DataType.FLOAT, name="in")
+    a = ff.dense(x, 24, name="branch_a")
+    b = ff.dense(x, 24, name="branch_b")
+    ff.concat([a, b], axis=-1, name="cat")
+    merged = ParallelLinearMerge().apply_all(list(ff.layers))
+    assert any(l.attrs.get("_origin_rewrite") for l in merged)
+    mname = [l.name for l in merged
+             if l.attrs.get("_origin_rewrite")][0]
+    # merged out_dim=48; a 5-wide axis cannot divide it
+    report = validate_pcg(merged, ff._used_inputs(),
+                          {mname: {"out": "model"}},
+                          {"data": 1, "model": 5}, config=ff.config)
+    assert not report.ok()
+    f = report.errors[0]
+    assert f.origin == "parallel_linear_merge"
+    assert "parallel_linear_merge" in f.where()
+
+
+# ----------------------------------------------------- compile-time gate
+def test_compile_gate_rejects_bad_strategy():
+    ff = FFModel(FFConfig(batch_size=BS, mesh_shape=dict(TP_MESH)))
+    build_mlp(ff, BS, in_dim=64, hidden_dims=(128,), num_classes=10)
+    with pytest.raises(PCGValidationError) as ei:
+        ff.compile(optimizer=SGDOptimizer(lr=0.01),
+                   loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                   strategies={"mlp_head": {"out": "model"}})
+    assert "PCG006" in str(ei.value) and "mlp_head" in str(ei.value)
+    # the same compile passes with the gate off (historical behavior:
+    # the op silently drops the unrealizable entry)
+    ff2 = FFModel(FFConfig(batch_size=BS, mesh_shape=dict(TP_MESH),
+                           validate_pcg="off"))
+    build_mlp(ff2, BS, in_dim=64, hidden_dims=(128,), num_classes=10)
+    ff2.compile(optimizer=SGDOptimizer(lr=0.01),
+                loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                strategies={"mlp_head": {"out": "model"}})
+    assert ff2.pcg_report is None
+
+
+def test_compile_gate_publishes_report():
+    ff = FFModel(FFConfig(batch_size=BS))
+    build_mlp(ff, BS, in_dim=64, hidden_dims=(128,), num_classes=10)
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+    assert ff.pcg_report is not None and ff.pcg_report.ok()
+
+
+def test_compile_gate_warn_mode_prints(capsys):
+    ff = FFModel(FFConfig(batch_size=BS, mesh_shape=dict(TP_MESH),
+                          validate_pcg="warn"))
+    build_mlp(ff, BS, in_dim=64, hidden_dims=(128,), num_classes=10)
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               strategies={"mlp_head": {"out": "model"}})
+    out = capsys.readouterr().out
+    assert "PCG006" in out and "mlp_head" in out
+
+
+def test_compile_gate_validates_pre_fusion_names(capsys):
+    """The gate runs BEFORE fusion: strategy entries name builder/rewrite
+    layers, and fusion renaming must not produce false PCG013 'stale
+    plan' findings (regression: the gate once validated the post-fusion
+    graph against pre-fusion strategy names)."""
+    ff = FFModel(FFConfig(batch_size=BS, mesh_shape=dict(TP_MESH),
+                          perform_fusion=True, validate_pcg="warn"))
+    build_mlp(ff, BS, in_dim=64, hidden_dims=(128,), num_classes=10)
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               strategies={"mlp_dense0": {"out": "model"}})
+    out = capsys.readouterr().out
+    assert "PCG013" not in out, out
+    assert ff.pcg_report is not None
+    assert "PCG013" not in ff.pcg_report.codes()
+
+
+def test_compile_gate_reports_post_fusion_unpipe(capsys):
+    """Fusion shrinking the graph below the pipe-stage count makes
+    compile() silently un-pipe; the gate reports it as PCG011 even
+    though validation itself runs pre-fusion."""
+    from flexflow_tpu.core.machine import make_mesh
+
+    ff = FFModel(FFConfig(batch_size=BS, perform_fusion=True,
+                          validate_pcg="warn"))
+    x = ff.create_tensor((BS, 16), name="input")
+    # dense + a 4-op unary chain: 5 ops pre-fusion (>= pipe, so the
+    # pre-fusion walk stays quiet) but 2 post-fusion (< pipe)
+    t = ff.dense(x, 16, name="d0")
+    t = ff.relu(t, name="r0")
+    t = ff.sigmoid(t, name="s0")
+    t = ff.tanh(t, name="t0")
+    t = ff.exp(t, name="e0")
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type=LossType.MEAN_SQUARED_ERROR_AVG_REDUCE,
+               mesh=make_mesh({"pipe": 4, "data": 2}))
+    assert "PCG011" in ff.pcg_report.codes(), ff.pcg_report.format()
+    assert "PCG011" in capsys.readouterr().out
+    assert ff.pipelined is None  # the un-pipe fallback actually happened
+
+
+def test_cache_hit_reuses_validation_report(tmp_path):
+    """A warm hit validates ONCE: _validate_cached's report is handed to
+    compile()'s gate instead of a second identical walk."""
+    ff = _cached_mlp_model(tmp_path)
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+    assert ff.pcg_report.source == "builder"
+    ff2 = _cached_mlp_model(tmp_path)
+    ff2.compile(optimizer=SGDOptimizer(lr=0.01),
+                loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+    assert ff2.search_profile["cache"] == "hit"
+    assert ff2.pcg_report is not None
+    assert ff2.pcg_report.source.startswith("cache:")  # reused, not re-walked
+
+
+def test_compile_gate_typo_mode_rejected():
+    ff = FFModel(FFConfig(batch_size=BS, validate_pcg="errorr"))
+    build_mlp(ff, BS, in_dim=64, hidden_dims=(128,), num_classes=10)
+    with pytest.raises(ValueError, match="validate_pcg"):
+        ff.compile(optimizer=SGDOptimizer(lr=0.01),
+                   loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+
+
+def test_compiler_errors_carry_provenance():
+    """build_ops failures name layer + op type (the validator's
+    provenance plumbing) instead of a bare shape mismatch."""
+    from flexflow_tpu.runtime.compiler import compile_model
+
+    ff = FFModel(FFConfig(batch_size=BS, mesh_shape=dict(TP_MESH),
+                          validate_pcg="off"))
+    build_mlp(ff, BS, in_dim=64, hidden_dims=(128,), num_classes=10)
+    head = [l for l in ff.layers if l.name == "mlp_head"][0]
+    head.outputs[0].dims = (BS, 12)  # declared/propagated mismatch
+    with pytest.raises(ValueError) as ei:
+        ff.compile(optimizer=SGDOptimizer(lr=0.01),
+                   loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+    msg = str(ei.value)
+    assert "mlp_head" in msg and "op linear" in msg
+
+
+# -------------------------------------------- cache trust boundary (e2e)
+def _cached_mlp_model(tmp_path):
+    cfg = FFConfig(batch_size=BS, search_budget=1, search_cache="on",
+                   search_cache_dir=str(tmp_path),
+                   mesh_shape=dict(TP_MESH))
+    ff = FFModel(cfg)
+    build_mlp(ff, BS, in_dim=64, hidden_dims=(128, 128), num_classes=10)
+    return ff
+
+
+def test_corrupted_cache_entry_rejected_with_coded_error(tmp_path):
+    """The acceptance path: compile() with validate_pcg="error" rejects
+    a hand-corrupted cached strategy (indivisible shard dim) with a
+    PCG0xx-coded, layer-attributed error BEFORE any compile work."""
+    ff = _cached_mlp_model(tmp_path)
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+    # warm path must hit (cross-build: fresh Layer objects, fresh guids)
+    ff2 = _cached_mlp_model(tmp_path)
+    ff2.compile(optimizer=SGDOptimizer(lr=0.01),
+                loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+    assert ff2.search_profile["cache"] == "hit"
+    # hand-corrupt: shard the 10-wide head over the 4-wide model axis
+    entries = glob.glob(os.path.join(str(tmp_path), "*.json"))
+    assert entries
+    for p in entries:
+        with open(p) as f:
+            doc = json.load(f)
+        doc["result"]["strategies"]["mlp_head"] = {"out": "model"}
+        with open(p, "w") as f:
+            json.dump(doc, f)
+    ff3 = _cached_mlp_model(tmp_path)
+    with pytest.raises(PCGValidationError) as ei:
+        ff3.compile(optimizer=SGDOptimizer(lr=0.01),
+                    loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+    msg = str(ei.value)
+    assert "PCG006" in msg and "mlp_head" in msg and "cache:" in msg
+    # warn mode demotes the corrupt entry to a miss and re-searches
+    ff4 = _cached_mlp_model(tmp_path)
+    ff4.config.validate_pcg = "warn"
+    ff4.compile(optimizer=SGDOptimizer(lr=0.01),
+                loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+    assert ff4.search_profile["cache"] == "miss"
+
+
+def test_truncated_cache_payload_is_clean_miss(tmp_path):
+    """A truncated/schema-broken entry demotes to a miss with a
+    CacheSchemaWarning — never an AttributeError, never a compile
+    failure."""
+    from flexflow_tpu.search.cache import CacheSchemaWarning, load_payload
+
+    ff = _cached_mlp_model(tmp_path)
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+    entries = glob.glob(os.path.join(str(tmp_path), "*.json"))
+    assert entries
+    p = entries[0]
+    key = os.path.basename(p)[:-len(".json")]
+    # truncated JSON
+    blob = open(p).read()
+    open(p, "w").write(blob[: len(blob) // 2])
+    with pytest.warns(CacheSchemaWarning, match="not valid JSON"):
+        assert load_payload(str(tmp_path), key) is None
+    # valid JSON, missing required payload fields
+    with open(p, "w") as f:
+        json.dump({"version": 2, "schema": 2, "key": key,
+                   "result": {"strategies": {}}}, f)
+    with pytest.warns(CacheSchemaWarning, match="missing required field"):
+        assert load_payload(str(tmp_path), key) is None
+    # wrong payload schema version
+    doc = json.loads(blob + blob[len(blob) // 2:]) if False else None
+    with open(p, "w") as f:
+        json.dump({"version": 2, "schema": 1, "key": key,
+                   "result": {}}, f)
+    with pytest.warns(CacheSchemaWarning, match="payload schema"):
+        assert load_payload(str(tmp_path), key) is None
+    # end to end: the broken entry never fails the compile
+    ff2 = _cached_mlp_model(tmp_path)
+    ff2.compile(optimizer=SGDOptimizer(lr=0.01),
+                loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+    assert ff2.search_profile["cache"] == "miss"
+
+
+# ------------------------------------------------------- strategy linter
+def test_lint_replicated_large_weight():
+    ff = FFModel(FFConfig(batch_size=BS))
+    # 1024x1024 fp32 kernel = 4 MiB, divisible by the 4-wide model axis
+    build_mlp(ff, BS, in_dim=1024, hidden_dims=(1024,), num_classes=10)
+    report = lint_strategy(ff.layers, ff._used_inputs(), {}, TP_MESH,
+                           config=ff.config)
+    assert "LINT001" in [f.code for f in report.findings]
+    # sharding it silences the finding for that layer
+    report2 = lint_strategy(ff.layers, ff._used_inputs(),
+                            {"mlp_dense0": {"out": "model"}}, TP_MESH,
+                            config=ff.config)
+    lint1_layers = {f.layer for f in report2.findings
+                    if f.code == "LINT001"}
+    assert "mlp_dense0" not in lint1_layers
+
+
+def test_lint_degree_one_strategy_entry():
+    ff = _build("mlp")
+    report = lint_strategy(ff.layers, ff._used_inputs(),
+                           {"mlp_dense0": {"out": "model"}},
+                           {"data": 8, "model": 1}, config=ff.config)
+    assert "LINT002" in [f.code for f in report.findings]
+
+
+def test_lint_float_cast_in_step_graph():
+    ff = FFModel(FFConfig(batch_size=BS))
+    x = ff.create_tensor((BS, 8), DataType.FLOAT, name="in")
+    t = ff.cast(x, DataType.BFLOAT16, name="boundary_cast")
+    ff.dense(t, 4, name="head")
+    report = lint_strategy(ff.layers, ff._used_inputs(), {}, {"data": 8},
+                           config=ff.config)
+    f = [f for f in report.findings if f.code == "LINT003"]
+    assert f and f[0].layer == "boundary_cast"
+
+
+def test_code_catalog_covers_all_emitted_codes():
+    assert set(CODE_CATALOG) >= {
+        "PCG001", "PCG002", "PCG003", "PCG004", "PCG006", "PCG007",
+        "PCG010", "PCG011", "PCG012", "PCG013", "LINT001", "LINT002",
+        "LINT003", "HOT001", "HOT002", "HOT003"}

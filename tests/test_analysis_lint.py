@@ -1,0 +1,238 @@
+"""Hot-path lint + tooling smoke tests (tier-1 gate).
+
+The repo's own source must stay lint-clean (regressions fail fast here,
+mirroring ``make lint``), seeded fixtures must trip each HOT0xx rule,
+and the JSON-report / dot-annotation tooling round-trips."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+from flexflow_tpu.analysis import lint_hotpath_source, lint_hotpaths
+
+PKG = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "flexflow_tpu")
+
+
+# ------------------------------------------------------- repo stays clean
+def test_repo_is_hotpath_lint_clean():
+    """The ``make lint`` gate, in-process: zero findings over the whole
+    package. Any new host sync in the step loop or unlocked shared
+    mutation in a runtime/ worker thread fails tier-1 here."""
+    findings = lint_hotpaths([PKG])
+    assert not findings, "\n".join(f.format() for f in findings)
+
+
+def test_make_lint_target_exists():
+    mk = open(os.path.join(os.path.dirname(PKG), "Makefile")).read()
+    assert "hotpath_lint" in mk and "compileall" in mk
+    assert "\nlint:" in mk
+
+
+# --------------------------------------------------------- HOT001 fixture
+_STEP_LOOP_SYNC = textwrap.dedent("""
+    import numpy as np
+
+    def fit(cm, batches, rng):
+        losses = []
+        for batch in batches:
+            params, opt, loss, m = cm.train_step(rng, *batch)
+            losses.append(float(loss))
+        return losses
+""")
+
+
+def test_seeded_host_sync_in_step_loop_fires_hot001():
+    findings = lint_hotpath_source(_STEP_LOOP_SYNC, "fixture.py")
+    assert [f.code for f in findings] == ["HOT001"]
+    assert "float()" in findings[0].message
+
+
+def test_sync_pragma_suppresses_hot001():
+    src = _STEP_LOOP_SYNC.replace(
+        "losses.append(float(loss))",
+        "losses.append(float(loss))  # hotpath: sync-ok (test fixture)")
+    assert lint_hotpath_source(src, "fixture.py") == []
+
+
+def test_block_until_ready_and_np_asarray_fire_hot001():
+    src = textwrap.dedent("""
+        import jax
+        import numpy as np
+
+        def loop(cm, batches):
+            for b in batches:
+                out = cm.eval_step(*b)
+                jax.block_until_ready(out)
+                host = np.asarray(out)
+    """)
+    codes = sorted(f.code for f in lint_hotpath_source(src, "f.py"))
+    assert codes == ["HOT001", "HOT001"]
+
+
+def test_sync_outside_step_loop_is_fine():
+    src = textwrap.dedent("""
+        def report(cm, batch):
+            loss = cm.train_step(*batch)  # not in a loop
+            return float(loss)
+    """)
+    assert lint_hotpath_source(src, "f.py") == []
+
+
+# --------------------------------------------------- HOT002/003 fixtures
+def test_jax_call_in_worker_thread_fires_hot002():
+    src = textwrap.dedent("""
+        import threading
+        import jax
+
+        def start(self):
+            def _work():
+                while True:
+                    batch = self.q.get()
+                    jax.device_put(batch)
+            t = threading.Thread(target=_work, daemon=True)
+            t.start()
+    """)
+    findings = lint_hotpath_source(src, "worker.py")
+    assert [f.code for f in findings] == ["HOT002"]
+
+
+def test_unlocked_shared_store_in_worker_fires_hot003():
+    src = textwrap.dedent("""
+        import threading
+
+        def start(self):
+            def _work():
+                for item in self.items:
+                    self.results[item] = compute(item)
+            threading.Thread(target=_work).start()
+    """)
+    findings = lint_hotpath_source(src, "worker.py")
+    assert [f.code for f in findings] == ["HOT003"]
+
+
+def test_sharding_metadata_in_worker_not_flagged():
+    """NamedSharding/PartitionSpec are host-side metadata, not device
+    work — CamelCase from-jax imports must not trip HOT002."""
+    src = textwrap.dedent("""
+        import threading
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        def start(self):
+            def _work():
+                while True:
+                    item = self.q.get()
+                    spec = PartitionSpec(None, "data")
+                    self.out.put(NamedSharding(self.mesh, spec))
+            threading.Thread(target=_work).start()
+    """)
+    assert lint_hotpath_source(src, "runtime_worker.py") == []
+
+
+def test_locked_store_in_worker_is_fine():
+    src = textwrap.dedent("""
+        import threading
+
+        def start(self):
+            def _work():
+                for item in self.items:
+                    with self.mu:
+                        self.results[item] = compute(item)
+            threading.Thread(target=_work).start()
+    """)
+    assert lint_hotpath_source(src, "worker.py") == []
+
+
+def test_lock_pragma_suppresses_hot003():
+    src = textwrap.dedent("""
+        import threading
+
+        def start(self):
+            def _work():
+                self.done = True  # hotpath: lock-ok (single writer)
+            threading.Thread(target=_work).start()
+    """)
+    assert lint_hotpath_source(src, "worker.py") == []
+
+
+def test_thread_rules_scoped_to_runtime_dir(tmp_path):
+    """serving/-style workers run device work by design; HOT002/003 only
+    apply under runtime/ (THREAD_RULE_DIRS)."""
+    src = textwrap.dedent("""
+        import threading
+        import jax
+
+        def start(self):
+            def _work():
+                while True:
+                    jax.device_put(self.q.get())
+            threading.Thread(target=_work).start()
+    """)
+    for sub in ("runtime", "serving"):
+        os.makedirs(tmp_path / "pkg" / sub, exist_ok=True)
+        (tmp_path / "pkg" / sub / "mod.py").write_text(src)
+    findings = lint_hotpaths([str(tmp_path / "pkg")])
+    assert [f.code for f in findings] == ["HOT002"]
+    assert f"runtime{os.sep}mod.py" in findings[0].file
+
+
+# ----------------------------------------------------- tools round-trips
+def test_pcg_lint_tool_emits_one_json_line(tmp_path):
+    out = tmp_path / "lint.json"
+    tools = os.path.join(os.path.dirname(PKG), "tools", "pcg_lint.py")
+    r = subprocess.run(
+        [sys.executable, tools, "--model", "mlp", "--mesh",
+         "data=2,model=4", "--out", str(out)],
+        capture_output=True, text=True, timeout=240,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "XLA_FLAGS": "--xla_force_host_platform_device_count=8"})
+    assert r.returncode == 0, r.stderr
+    lines = [l for l in r.stdout.strip().splitlines() if l.startswith("{")]
+    assert len(lines) == 1, r.stdout
+    doc = json.loads(lines[0])
+    assert doc["reports"]["mlp"]["errors"] == 0
+    assert "PCG006" in doc["codes"]
+    assert json.loads(out.read_text())["exit"] == 0
+
+
+def test_dot_annotation_renders_findings(tmp_path):
+    from flexflow_tpu.utils.dot import DotFile, annotate_findings
+
+    d = DotFile("strategy")
+    d.add_node("mlp_head", "mlp_head: out=model", extra={"shape": "box"})
+    n = annotate_findings(d, [
+        {"code": "PCG006", "severity": "error", "layer": "mlp_head",
+         "message": "indivisible"},
+        {"code": "PCG011", "severity": "warning", "message": "pipe idle"},
+    ])
+    assert n == 2
+    rendered = d.render()
+    assert "[PCG006] indivisible" in rendered
+    assert "fillcolor" in rendered and "#ffb3b3" in rendered
+    assert "__graph__" in rendered  # graph-level finding legend node
+    # internal keys never leak into the dot output
+    assert "_severity" not in rendered
+
+
+def test_strategy_to_dot_consumes_lint_json(tmp_path):
+    strat = tmp_path / "strategy.json"
+    strat.write_text(json.dumps(
+        {"version": 1, "strategies": {"mlp_head": {"out": "model"}}}))
+    lint = tmp_path / "lint.json"
+    lint.write_text(json.dumps({
+        "reports": {"mlp": {"findings": [
+            {"code": "PCG006", "severity": "error", "layer": "mlp_head",
+             "message": "indivisible shard dim"}]}}}))
+    out = tmp_path / "out.dot"
+    tools = os.path.join(os.path.dirname(PKG), "tools",
+                         "strategy_to_dot.py")
+    r = subprocess.run(
+        [sys.executable, tools, str(strat), str(out), "--findings",
+         str(lint)],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stderr
+    rendered = out.read_text()
+    assert "PCG006" in rendered and "fillcolor" in rendered

@@ -3,23 +3,48 @@
 
 reference: the --compgraph / strategy dot exports (model.cc:3666-3674);
 this standalone tool renders a saved strategy JSON without rebuilding the
-model.
+model. ``--findings lint.json`` additionally annotates each layer node
+with the validator/linter findings from a ``tools/pcg_lint.py`` report
+(error layers fill red, warnings amber).
 
-Usage: python tools/strategy_to_dot.py strategy.json [out.dot]
+Usage:
+    python tools/strategy_to_dot.py strategy.json [out.dot]
+    python tools/strategy_to_dot.py strategy.json out.dot --findings lint.json
 """
 
+import argparse
 import json
 import sys
 
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
-from flexflow_tpu.utils.dot import DotFile  # noqa: E402
+from flexflow_tpu.utils.dot import DotFile, annotate_findings  # noqa: E402
 
 
-def main():
-    if len(sys.argv) < 2:
-        raise SystemExit(__doc__)
-    with open(sys.argv[1]) as f:
+def load_findings(path):
+    """Flatten a pcg_lint.py JSON report (or a bare findings list) into
+    one findings sequence."""
+    with open(path) as f:
+        data = json.load(f)
+    if isinstance(data, list):
+        return data
+    out = []
+    for rep in data.get("reports", {}).values():
+        out.extend(rep.get("findings", []))
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("strategy", help="strategy JSON (--export-strategy)")
+    ap.add_argument("out", nargs="?", default="/dev/stdout",
+                    help="output dot path (default stdout)")
+    ap.add_argument("--findings", default=None,
+                    help="pcg_lint.py JSON report to annotate onto the "
+                         "graph")
+    args = ap.parse_args(argv)
+
+    with open(args.strategy) as f:
         data = json.load(f)
     strategies = data.get("strategies", data)
     d = DotFile("strategy")
@@ -27,8 +52,10 @@ def main():
         body = ", ".join(f"{k}={v}" for k, v in sorted(strat.items())
                          if not k.startswith("_")) or "data-parallel"
         d.add_node(layer, f"{layer}: {body}", extra={"shape": "box"})
-    out = sys.argv[2] if len(sys.argv) > 2 else "/dev/stdout"
-    d.write(out)
+    if args.findings:
+        n = annotate_findings(d, load_findings(args.findings))
+        print(f"annotated {n} finding(s)", file=sys.stderr)
+    d.write(args.out)
 
 
 if __name__ == "__main__":
