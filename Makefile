@@ -12,11 +12,15 @@
 #   make bench    — the benchmark (real chip when present, CPU fallback)
 #   make bench-fit — step-loop overlap bench (prefetch / dispatch-ahead /
 #                    multi-step dispatch) on the e2e MLP; one JSON line
+#   make bench-pipe — pipeline schedule/engine bench (host GPipe vs 1F1B
+#                     vs single-dispatch compiled): dispatch counts, step
+#                     time, peak activation bytes; one JSON line
 
 PY ?= python
 CPU_MESH = JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-.PHONY: ci native native-check lint pcg-lint test dryrun bench bench-fit
+.PHONY: ci native native-check lint pcg-lint test dryrun bench bench-fit \
+        bench-pipe
 
 ci: native native-check lint test dryrun
 
@@ -46,3 +50,6 @@ bench:
 
 bench-fit:
 	$(CPU_MESH) $(PY) tools/fit_bench.py
+
+bench-pipe:
+	$(CPU_MESH) $(PY) tools/pipe_bench.py

@@ -56,6 +56,9 @@ CODE_CATALOG: Dict[str, str] = {
     "PCG013": "strategy for unknown layer: a strategy entry names no "
               "layer in the graph (stale or corrupt plan)",
     "PCG014": "propagation failure: the op rejected its inputs/strategy",
+    "PCG015": "illegal pipeline schedule: unknown schedule name, bad "
+              "interleave degree, or more virtual chunks than graph ops "
+              "for the mesh's pipe axis",
     # strategy linter (analysis/strategy_lint.py) — legal but suspect
     "LINT001": "replicated large weight where a free mesh axis could "
                "shard it",

@@ -48,7 +48,10 @@ from typing import Dict, List, Optional, Sequence, Set
 
 from .findings import Finding
 
-STEP_CALLS = {"train_step", "eval_step", "train_k_steps"}
+# the pipeline tail program (`self._bwd_last(...)`) marks the schedule
+# tick loop in parallel/pipeline.py as a step-dispatch loop, so HOT001
+# covers the new schedule replay exactly like the fit/eval loops
+STEP_CALLS = {"train_step", "eval_step", "train_k_steps", "_bwd_last"}
 SYNC_ATTR_CALLS = {"block_until_ready", "item", "tolist"}
 SYNC_NAME_CALLS = {"float"}
 SYNC_NP_CALLS = {"asarray", "array"}

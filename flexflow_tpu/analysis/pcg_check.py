@@ -383,12 +383,18 @@ def _check_memory(records: List[dict], axis_sizes: Dict[str, int],
 
 
 def _check_schedules(layers: Sequence, axis_sizes: Dict[str, int],
-                     report: ValidationReport) -> None:
-    """Collective/schedule compatibility for parallel/ (PCG011): the
-    GPipe engine (parallel/pipeline.py) needs at least one op per stage;
-    compile() silently falls back to an un-piped graph below that, which
-    leaves the pipe axis idle — flagged so the idle hardware is never a
-    surprise."""
+                     report: ValidationReport, config=None) -> None:
+    """Collective/schedule compatibility for parallel/ (PCG011/PCG015):
+    the pipeline engines (parallel/pipeline.py) need at least one op per
+    stage; compile() silently falls back to an un-piped graph below
+    that, which leaves the pipe axis idle — flagged so the idle hardware
+    is never a surprise. The configured pipeline SCHEDULE is legality-
+    checked against the same source of truth the engines use
+    (parallel/schedule.py check_schedule): an unknown schedule name or a
+    bad interleave degree is PCG015 (error — the typo-guard philosophy:
+    a misspelled knob must not silently change what executes), and an
+    interleaved chunk count exceeding the op count is PCG015 too (the
+    engine's stage splitter would refuse it at compile time)."""
     pipe = axis_sizes.get("pipe", 1)
     if pipe > 1 and len(layers) < pipe:
         report.add(
@@ -397,6 +403,31 @@ def _check_schedules(layers: Sequence, axis_sizes: Dict[str, int],
             f"{len(layers)} ops; compile() will fall back to an un-piped "
             f"graph and the pipe axis stays idle",
             severity="warning", layer=None)
+    if pipe <= 1 or config is None:
+        return
+    from ..parallel.schedule import (SCHEDULES, ScheduleError,
+                                     check_schedule)
+    from ..search.unity import pipe_microbatches
+
+    kind = getattr(config, "pipeline_schedule", "auto") or "auto"
+    if kind == "auto":
+        return  # resolution only ranks legal candidates
+    ilv = int(getattr(config, "pipeline_interleave", 2)) \
+        if kind == "interleaved" else 1
+    try:
+        check_schedule(kind, pipe,
+                       pipe_microbatches(getattr(config, "batch_size",
+                                                 None)), ilv)
+    except ScheduleError as e:
+        report.add("PCG015", str(e), layer=None)
+        return
+    if kind == "interleaved" and pipe * ilv > len(layers):
+        report.add(
+            "PCG015",
+            f"schedule 'interleaved' needs {pipe} stages x {ilv} "
+            f"virtual chunks = {pipe * ilv} graph ops but the graph "
+            f"has {len(layers)}; lower pipeline_interleave or use "
+            f"'1f1b'", layer=None)
 
 
 def validate_pcg(
@@ -442,5 +473,5 @@ def validate_pcg(
         # serialized) so the strategy linter can reuse them instead of
         # re-propagating the whole graph
         report.records = records
-    _check_schedules(layers, axis_sizes, report)
+    _check_schedules(layers, axis_sizes, report, config=config)
     return report

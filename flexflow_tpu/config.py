@@ -146,7 +146,31 @@ class FFConfig:
     # microbatches, averages their gradients inside ONE jitted step
     # (lax.scan), and applies a single optimizer update — K x the
     # effective batch at 1/K the activation memory. No reference analog.
+    # Composes with pipelining: a pipelined compile folds K into the
+    # schedule's microbatch count (K x num_microbatches), which is the
+    # same averaging at the same activation budget.
     grad_accum_steps: int = 1
+    # --- pipeline schedule (parallel/schedule.py) -------------------------
+    # microbatch ordering for pipe-prefixed meshes: "gpipe" (all
+    # forwards then all backwards — the historical engine), "1f1b"
+    # (one-forward-one-backward steady state: live activations capped at
+    # O(stages) instead of O(microbatches)), "interleaved" (1f1b over
+    # pipeline_interleave virtual chunks per stage: ~interleave x
+    # smaller bubble for interleave x boundary traffic), or "auto"
+    # (default): the simulator's schedule cost model
+    # (sim/simulator.py pipeline_schedule_cost) ranks the candidates for
+    # the actual mesh/graph and the cheapest wins (ties resolve to the
+    # smaller activation footprint, i.e. 1F1B over GPipe). The selected
+    # schedule rides on search results and the strategy cache, so a
+    # cached plan always replays the schedule it was priced with.
+    pipeline_schedule: str = "auto"
+    # per-stage rematerialization inside the pipeline backward (the
+    # PipelineConfig.remat default when compile() auto-enables the
+    # pipeline engine): ~1.33x FLOPs, only stage-boundary activations
+    # ever stored
+    pipeline_remat: bool = False
+    # virtual chunks per stage for schedule="interleaved" (>= 2)
+    pipeline_interleave: int = 2
     # --- async input pipeline + dispatch-ahead step loop ------------------
     # bounded background batch queue (runtime/dataloader.py Prefetcher): a
     # worker thread assembles the next batches (shuffle-perm gather, cast,
@@ -282,6 +306,12 @@ class FFConfig:
                 cfg.zero_optimizer = True
             elif a == "--grad-accum-steps":
                 cfg.grad_accum_steps = int(_next())
+            elif a == "--pipeline-schedule":
+                cfg.pipeline_schedule = _next()
+            elif a == "--pipeline-remat":
+                cfg.pipeline_remat = True
+            elif a == "--pipeline-interleave":
+                cfg.pipeline_interleave = int(_next())
             elif a == "--prefetch-depth":
                 cfg.prefetch_depth = int(_next())
             elif a == "--max-inflight-steps":

@@ -24,6 +24,7 @@ import math
 from typing import Optional
 
 import jax
+from ..utils.compat import shard_map
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
@@ -465,7 +466,7 @@ def sharded_flash_attention(q, k, v, mesh, batch_axis, heads_axis,
     spec = PartitionSpec(batch_axis, None, heads_axis, None)
     fn = functools.partial(flash_attention, causal=causal, scale=scale,
                            block_q=block_q)
-    return jax.shard_map(
+    return shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,
     )(q, k, v)

@@ -30,6 +30,7 @@ import math
 from typing import List, Tuple
 
 import jax
+from ..utils.compat import shard_map
 import jax.numpy as jnp
 
 from ..ffconst import ActiMode, DataType, OpType
@@ -340,7 +341,7 @@ class GroupByStacked(Op):
                 disp = moe_dispatch_mask(assign_loc, n, c_loc)
                 return jnp.einsum("tnc,tf->ncf", disp, xk)
 
-            rows = jax.shard_map(
+            rows = shard_map(
                 body, mesh=ctx.mesh,
                 in_specs=(P(ax, *([None] * (x.ndim - 1))), P(ax, None)),
                 out_specs=P(None, ax, None),
@@ -479,7 +480,7 @@ class AggregateStacked(_AggregateBase):
                 out = jnp.einsum("tnc,ncf->tf", comb, rows_loc)
                 return out.reshape(gate_loc.shape[0], k, -1).sum(axis=1)
 
-            out = jax.shard_map(
+            out = shard_map(
                 body, mesh=ctx.mesh,
                 in_specs=(P(None, ax, None), P(ax, None), P(ax, None)),
                 out_specs=P(ax, None),

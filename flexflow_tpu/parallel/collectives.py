@@ -17,6 +17,7 @@ import functools
 from typing import Optional
 
 import jax
+from ..utils.compat import shard_map
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -56,14 +57,14 @@ def ring_all_reduce(x: jax.Array, mesh: Mesh, axis: str) -> jax.Array:
         return jnp.concatenate(list(full), axis=0)
 
     spec = P(axis)
-    fn = jax.shard_map(body, mesh=mesh, in_specs=spec, out_specs=spec)
+    fn = shard_map(body, mesh=mesh, in_specs=spec, out_specs=spec)
     # operate over leading dim: requires x leading dim divisible by n
     return fn(x)
 
 
 def psum_all_reduce(x: jax.Array, mesh: Mesh, axis: str) -> jax.Array:
     """Production all-reduce: psum under shard_map (XLA picks the ring)."""
-    fn = jax.shard_map(
+    fn = shard_map(
         lambda v: jax.lax.psum(v, axis),
         mesh=mesh,
         in_specs=P(axis),
@@ -82,7 +83,7 @@ def expert_all_to_all(x: jax.Array, mesh: Mesh, axis: str) -> jax.Array:
     def body(xs):
         return jax.lax.all_to_all(xs, axis, split_axis=0, concat_axis=1, tiled=True)
 
-    fn = jax.shard_map(body, mesh=mesh, in_specs=P(None, axis), out_specs=P(axis, None))
+    fn = shard_map(body, mesh=mesh, in_specs=P(None, axis), out_specs=P(axis, None))
     return fn(x)
 
 
@@ -95,5 +96,5 @@ def experts_to_tokens(x: jax.Array, mesh: Mesh, axis: str) -> jax.Array:
     def body(xs):
         return jax.lax.all_to_all(xs, axis, split_axis=1, concat_axis=0, tiled=True)
 
-    fn = jax.shard_map(body, mesh=mesh, in_specs=P(axis, None), out_specs=P(None, axis))
+    fn = shard_map(body, mesh=mesh, in_specs=P(axis, None), out_specs=P(None, axis))
     return fn(x)

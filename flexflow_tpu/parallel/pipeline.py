@@ -1,27 +1,53 @@
-"""Pipeline parallelism (GPipe schedule).
+"""Pipeline parallelism: schedule-driven engines (GPipe / 1F1B /
+interleaved).
 
 The reference RESERVED pipeline parallelism but never implemented it
 (reference: PIPELINE_{INIT,FWD,BWD}_TASK_ID task ids exist, model.h:190-192,
 but no Pipeline op exists anywhere in src/ — SURVEY.md §2.3). Here it is a
-first-class strategy, per SURVEY.md §7 step 10.
+first-class strategy whose SCHEDULE is itself a knob the simulator can
+price and the search can select (``config.pipeline_schedule =
+gpipe|1f1b|interleaved|auto``).
 
-Design (TPU single-controller):
+Two engines execute the same schedule IR (:mod:`.schedule`):
 
-* the op chain is split into ``num_stages`` contiguous stages balanced by
-  FLOPs; stage *s*'s parameters live only on the mesh slice ``pipe = s``
-  (a submesh keeping every other axis, so dp/tp still apply *inside* a
-  stage);
-* each stage compiles exactly TWO programs on its submesh — a jitted
-  forward and a jitted backward (the backward rematerializes the stage's
-  forward via ``jax.vjp`` inside the jit, so only the inter-stage boundary
-  activations are ever stored: GPipe with per-stage rematerialization);
-* the global batch splits into ``num_microbatches`` microbatches, each kept
-  **sharded over the stage submesh's data axis**; the GPipe schedule emerges
-  from JAX's async dispatch — microbatch *m+1*'s stage-*s* program is
-  enqueued while microbatch *m* runs on stage *s+1*'s devices, so different
-  stages execute concurrently on disjoint device groups;
-* gradients accumulate over microbatches and each stage's optimizer update
-  runs on its own submesh;
+* :class:`PipelinedModel` — the **host-driven** engine (this module):
+  replays the tick table with one compiled program dispatch per action.
+  General: any mesh (dp/tp inside stages), any schedule including
+  interleaved virtual stages. Under 1F1B it frees each microbatch's
+  residuals as soon as its backward consumes them, so live activations
+  are O(num_stages) instead of O(num_microbatches).
+* :class:`~.pipeline_compiled.CompiledPipelinedModel` — the
+  **single-dispatch** engine (:mod:`.pipeline_compiled`): the whole
+  warmup/steady/cooldown schedule lowered into ONE jitted program
+  (``lax.scan`` over schedule ticks, stage-boundary transfers as
+  collective permutes inside ``shard_map``). Requires one device per
+  stage; :func:`make_pipelined_model` picks it automatically when the
+  mesh and schedule allow and falls back to the host engine otherwise.
+
+Both engines share the stage split, per-chunk programs, parameter
+placement, and gradient-accumulation order (backwards run in microbatch
+order per stage under EVERY schedule), so per-step losses and grads are
+schedule-invariant and engine-invariant up to float reassociation by XLA.
+
+Design (TPU single-controller), host engine:
+
+* the op chain is split into ``num_stages * interleave`` contiguous
+  chunks balanced by FLOPs; chunk *c* lives on the mesh slice
+  ``pipe = c % num_stages`` (a submesh keeping every other axis, so dp/tp
+  still apply *inside* a stage);
+* each chunk compiles exactly TWO programs on its submesh — a jitted
+  forward and a jitted backward (the backward rematerializes the chunk's
+  forward via ``jax.vjp`` inside the jit when ``remat=True``; by default
+  the vjp residuals of the jitted forward are kept and freed at the
+  consuming backward);
+* the global batch splits into ``num_microbatches`` microbatches, each
+  kept **sharded over the stage submesh's data axis**; the schedule's
+  overlap emerges from JAX's async dispatch — actions in one tick are
+  enqueued back to back and run concurrently on disjoint device groups;
+* gradients accumulate over microbatches (fixed microbatch order) and
+  each stage's optimizer update runs on its own submesh with the
+  optimizer hyperparameters passed as TRACED arguments (mirroring
+  runtime/compiler.py's ``hyper``), so LR schedules never retrace;
 * inter-stage activation (and cotangent) transfers are device_put edges
   between submeshes — the ICI hop where the reference would have issued a
   Legion region copy.
@@ -30,7 +56,7 @@ Design (TPU single-controller):
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, List, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -39,23 +65,48 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ..core.machine import DATA_AXIS, PIPE_AXIS, mesh_axis_sizes
 from ..core.op import LowerCtx
+from .schedule import (Action, PipelineSchedule, build_schedule,
+                       check_schedule, schedule_summary)
 
 
 @dataclasses.dataclass
 class PipelineConfig:
     """compile(..., pipeline=PipelineConfig(...)).
 
-    ``remat=False`` (default) stores each stage's vjp residuals per
-    microbatch — the plain GPipe memory profile, no recompute.
-    ``remat=True`` rematerializes each stage's forward inside its compiled
-    backward: ~1.33x the FLOPs, but only stage-boundary activations are
-    ever stored (for memory-constrained configs).
+    ``schedule``: microbatch ordering — ``"gpipe"`` (all forwards, then
+    all backwards: the historical engine), ``"1f1b"`` (one-forward-
+    one-backward steady state: live activations capped at
+    O(num_stages)), or ``"interleaved"`` (1F1B over ``interleave``
+    virtual chunks per stage: ~interleave× smaller bubble for
+    interleave× boundary traffic). ``"auto"`` is resolved by the caller
+    (FFModel.compile via the simulator's schedule cost model) before the
+    engine is built.
+
+    ``remat=False`` (default) stores each chunk's vjp residuals per
+    microbatch — no recompute; residuals are freed as soon as the
+    consuming backward runs, so the live set follows the schedule.
+    ``remat=True`` rematerializes each chunk's forward inside its
+    compiled backward: ~1.33x the FLOPs, but only stage-boundary
+    activations are ever stored.
+
+    ``engine``: ``"auto"`` picks the single-dispatch compiled engine
+    (:mod:`.pipeline_compiled`) when the mesh has one device per stage
+    and the schedule supports it, else the host-driven engine;
+    ``"host"``/``"compiled"`` force one (forcing ``"compiled"`` outside
+    its envelope raises).
     """
 
     num_stages: int
     num_microbatches: int = 4
     axis: str = PIPE_AXIS
     remat: bool = False
+    schedule: str = "gpipe"
+    interleave: int = 1
+    engine: str = "auto"
+    # set by FFModel._resolve_pipeline once config.grad_accum_steps has
+    # been folded into num_microbatches, so a recompile that passes the
+    # resolved config back through compile() never folds twice
+    accum_folded: bool = False
 
 
 def split_stages(ops: List, num_stages: int) -> List[List]:
@@ -88,11 +139,15 @@ def split_stages(ops: List, num_stages: int) -> List[List]:
 
 
 class PipelinedModel:
-    """Pipeline execution engine behind FFModel.compile(pipeline=...).
+    """Schedule-driven pipeline engine behind FFModel.compile(pipeline=...).
 
     ``train_step(rng, xs, y) -> (loss, batch_metrics)`` mutates the
-    per-stage params/opt_state in place (host-driven schedule).
+    per-stage params/opt_state in place, replaying the schedule's tick
+    table (one program dispatch per action — the host-driven engine; see
+    :mod:`.pipeline_compiled` for the single-dispatch engine).
     """
+
+    engine_name = "host"
 
     def __init__(self, ops, mesh: Mesh, cfg: PipelineConfig, optimizer,
                  loss_fn, metrics_fn, input_ids: List[int], logits_id: int,
@@ -107,6 +162,7 @@ class PipelinedModel:
                 f"num_stages={cfg.num_stages} must equal mesh {cfg.axis} "
                 f"size {S}"
             )
+        check_schedule(cfg.schedule, S, cfg.num_microbatches, cfg.interleave)
         from ..ffconst import OpType
 
         if any(op.op_type is OpType.BATCHNORM for op in ops):
@@ -130,7 +186,15 @@ class PipelinedModel:
         self.metrics_fn = metrics_fn
         self.input_ids = input_ids
         self.logits_id = logits_id
-        self.stages = split_stages(ops, S)
+        # contiguous FLOP-balanced chunks; chunk c lives on stage c % S
+        self.chunks: List[List] = split_stages(ops, S * cfg.interleave)
+        self.stages: List[List] = [
+            [op for c in range(s, len(self.chunks), S)
+             for op in self.chunks[c]]
+            for s in range(S)
+        ]
+        self.schedule: PipelineSchedule = build_schedule(
+            cfg.schedule, S, cfg.num_microbatches, cfg.interleave)
 
         # per-stage submeshes: slice the pipe axis, keep the other axes
         pipe_index = list(mesh.axis_names).index(cfg.axis)
@@ -139,7 +203,7 @@ class PipelinedModel:
         for s in range(S):
             devs = np.take(mesh.devices, s, axis=pipe_index)
             if not other_axes:  # keep a mesh, even if trivial
-                devs = devs.reshape(1)
+                devs = np.asarray(devs, dtype=object).reshape(1)
                 self.submeshes.append(Mesh(devs, ("_stage",)))
             else:
                 self.submeshes.append(Mesh(devs, tuple(other_axes)))
@@ -163,18 +227,26 @@ class PipelinedModel:
             [optimizer.init_state(sp) for sp in self.stage_params]
             if opt_state is None else self._slice_opt_state(opt_state)
         )
-        self._stage_fwd = [self._make_stage_fwd(s, training=True)
-                           for s in range(S)]
-        self._stage_fwd_eval = [self._make_stage_fwd(s, training=False)
-                                for s in range(S)]
-        self._stage_bwd = [self._make_stage_bwd(s) for s in range(S)]
+        C = len(self.chunks)
+        self._chunk_fwd = [self._make_chunk_fwd(c, training=True)
+                           for c in range(C)]
+        self._chunk_fwd_eval = [self._make_chunk_fwd(c, training=False)
+                                for c in range(C)]
+        self._chunk_bwd = [self._make_chunk_bwd(c) for c in range(C)]
         self._stage_update = [self._make_stage_update(s) for s in range(S)]
-        self._bwd_last = self._make_last_stage_bwd()
+        self._bwd_last = self._make_last_chunk_bwd()
         # one jitted tree-add per stage param structure (grad accumulation
         # as ONE dispatch, not one per leaf)
         self._acc = jax.jit(lambda a, b: jax.tree.map(jnp.add, a, b))
+        # per-step dispatch/transfer accounting (pipe_bench + fit_profile)
+        self.step_dispatches = 0
+        self.step_transfers = 0
 
     # ------------------------------------------------------------------ #
+    def chunk_stage(self, c: int) -> int:
+        """The physical stage hosting chunk ``c``."""
+        return c % len(self.stages)
+
     def _weight_sharding(self, s: int, op, wname: str) -> NamedSharding:
         ps = op.weight_shapes[wname]
         sub = self.submeshes[s]
@@ -199,6 +271,7 @@ class PipelinedModel:
     def _ship(self, s: int, tree):
         """Move an activation/cotangent dict onto stage s's submesh,
         keeping the batch dim sharded over the stage's data axis."""
+        self.step_transfers += 1
         return {
             k: jax.device_put(v, self._act_sharding(s, v))
             for k, v in tree.items()
@@ -242,41 +315,51 @@ class PipelinedModel:
         return states
 
     @staticmethod
-    def _mb_rng(rng, m: int, s: int):
-        """Per-(microbatch, stage) PRNG key. The remat backward MUST derive
+    def _mb_rng(rng, m: int, c: int):
+        """Per-(microbatch, chunk) PRNG key. The remat backward MUST derive
         the identical key as the forward sweep so recomputed dropout masks
-        match — this is the single derivation point."""
-        return (jax.random.fold_in(rng, m * 131 + s)
+        match — this is the single derivation point. (With interleave==1
+        the chunk index IS the historical stage index, so keys — and
+        therefore dropout masks and trained weights — are bit-identical
+        to the pre-schedule-knob engine.)"""
+        return (jax.random.fold_in(rng, m * 131 + c)
                 if rng is not None else None)
 
-    def _live_after(self, s: int) -> set:
+    def _live_after(self, c: int) -> set:
+        """Tensor ids that must cross the c -> c+1 chunk boundary."""
         needed = {self.logits_id}
-        for later in self.stages[s + 1:]:
+        for later in self.chunks[c + 1:]:
             for op in later:
                 for t in op.layer.inputs:
                     needed.add(t.tensor_id)
         return needed
 
-    def _stage_apply(self, s: int, training: bool):
-        """The pure stage function: acts-in -> (acts-out, aux-loss sum)."""
-        stage_ops = self.stages[s]
-        mesh = self.submeshes[s]
-        needed = self._live_after(s)
+    def _chunk_apply(self, c: int, training: bool, mesh=None):
+        """The pure chunk function: acts-in -> (acts-out, aux-loss sum).
+        ``mesh`` defaults to the hosting stage's submesh; the compiled
+        engine passes ``False`` (no mesh: ops lower without sharding
+        constraints — every stage is a single device there)."""
+        chunk_ops = self.chunks[c]
+        if mesh is None:
+            mesh = self.submeshes[self.chunk_stage(c)]
+        elif mesh is False:
+            mesh = None
+        needed = self._live_after(c)
 
         cdt = self.compute_dtype
         from ..runtime.compiler import cast_op_params, make_caster
 
         cast = make_caster(cdt)
 
-        def fwd(stage_params, acts: Dict[int, jax.Array], rng):
+        def fwd(chunk_params, acts: Dict[int, jax.Array], rng):
             ctx = LowerCtx(mesh=mesh, training=training, aux_losses=[],
                            compute_dtype=cdt)
             acts = {k: cast(v) for k, v in acts.items()}
-            for oi, op in enumerate(stage_ops):
+            for oi, op in enumerate(chunk_ops):
                 ctx.rng = (jax.random.fold_in(rng, oi)
                            if rng is not None else None)
                 ins = [acts[t.tensor_id] for t in op.layer.inputs]
-                p = cast_op_params(cast, op, stage_params.get(op.name, {}),
+                p = cast_op_params(cast, op, chunk_params.get(op.name, {}),
                                    cdt)
                 outs = op.forward(ctx, ins, p)
                 for out, t in zip(outs, op.layer.outputs):
@@ -291,37 +374,44 @@ class PipelinedModel:
 
         return fwd
 
-    def _make_stage_fwd(self, s: int, training: bool):
-        fwd = self._stage_apply(s, training)
+    def _chunk_params(self, c: int) -> Dict:
+        """The hosting stage's param subtree restricted to chunk c."""
+        sp = self.stage_params[self.chunk_stage(c)]
+        return {op.name: sp[op.name] for op in self.chunks[c]
+                if op.name in sp}
+
+    def _make_chunk_fwd(self, c: int, training: bool):
+        fwd = self._chunk_apply(c, training)
         if not training:
             return jax.jit(lambda p, a: fwd(p, a, None))
         return jax.jit(fwd)
 
-    def _make_stage_bwd(self, s: int):
-        """One compiled backward per stage: recomputes the stage forward
+    def _make_chunk_bwd(self, c: int):
+        """One compiled backward per chunk: recomputes the chunk forward
         inside the jit (rematerialization) and pulls cotangents back
         through it, so no per-op residuals ever leave the program."""
-        fwd = self._stage_apply(s, training=True)
+        fwd = self._chunk_apply(c, training=True)
 
         @jax.jit
-        def bwd(stage_params, acts_in, rng, d_out, d_aux):
-            _, vjp = jax.vjp(lambda p, a: fwd(p, a, rng), stage_params, acts_in)
+        def bwd(chunk_params, acts_in, rng, d_out, d_aux):
+            _, vjp = jax.vjp(lambda p, a: fwd(p, a, rng), chunk_params,
+                             acts_in)
             dparams, dacts = vjp((d_out, d_aux))
             return dparams, dacts
 
         return bwd
 
-    def _make_last_stage_bwd(self):
+    def _make_last_chunk_bwd(self):
         """The pipeline tail as ONE compiled program: recompute the last
-        stage's forward, compute the loss, and pull cotangents back — no
+        chunk's forward, compute the loss, and pull cotangents back — no
         separate logits fetch, loss dispatch, or zero-cotangent fill."""
-        S = len(self.stages)
-        fwd = self._stage_apply(S - 1, training=True)
+        C = len(self.chunks)
+        fwd = self._chunk_apply(C - 1, training=True)
         loss_fn = self.loss_fn
         logits_id = self.logits_id
 
         @jax.jit
-        def bwd_last(stage_params, acts_in, rng, y, cot):
+        def bwd_last(chunk_params, acts_in, rng, y, cot):
             def f(p, a):
                 out, aux = fwd(p, a, rng)
                 logits = out[logits_id]
@@ -331,7 +421,7 @@ class PipelinedModel:
                 return loss + aux, (loss, aux, logits)
 
             _, vjp, (loss, aux, logits) = jax.vjp(
-                f, stage_params, acts_in, has_aux=True
+                f, chunk_params, acts_in, has_aux=True
             )
             dparams, dacts = vjp(cot)
             return loss, aux, logits, dparams, dacts
@@ -339,22 +429,27 @@ class PipelinedModel:
         return bwd_last
 
     def _make_stage_update(self, s: int):
+        """Jitted per-stage optimizer update. Hyperparameters (lr/alpha)
+        enter as a TRACED argument read fresh per call — mirroring
+        runtime/compiler.py's ``hyper`` — so LR schedules take effect
+        without retracing (pjit caches by the underlying function, so a
+        're-jit' would silently reuse the stale executable)."""
         opt = self.optimizer
         wd = self.stage_wd[s]
 
         @jax.jit
-        def upd(stage_params, grads, opt_state):
-            return opt.update(stage_params, grads, opt_state, wd)
+        def upd(stage_params, grads, opt_state, hyper):
+            return opt.update(stage_params, grads, opt_state, wd, hyper)
 
         return upd
 
     # ------------------------------------------------------------------ #
     def train_step(self, rng, xs: Sequence[jax.Array], y: jax.Array,
                    sync: bool = True):
-        """One pipelined training step.
+        """One pipelined training step, replaying ``self.schedule``.
 
         ``sync=True`` (default) fetches the scalar loss to host — which
-        fences the step and exposes the GPipe bubble. ``sync=False``
+        fences the step and exposes the schedule bubble. ``sync=False``
         returns the per-microbatch device scalars instead
         (``(loss_parts, aux_parts)``, combine as
         ``(sum(map(float, loss_parts)) + sum(map(float, aux_parts))) / M``)
@@ -364,99 +459,123 @@ class PipelinedModel:
         """
         M = self.cfg.num_microbatches
         S = len(self.stages)
+        C = len(self.chunks)
         assert xs[0].shape[0] % M == 0, (
             f"batch {xs[0].shape[0]} not divisible by microbatches {M}"
         )
+        self.step_dispatches = 0
+        self.step_transfers = 0
         xs_mb = [jnp.split(jnp.asarray(x), M, axis=0) for x in xs]
         y_mb = jnp.split(jnp.asarray(y), M, axis=0)
         inv_m = 1.0 / M
         cot = jnp.asarray(inv_m)  # every microbatch's loss (and each
-        daux = cot                # stage's aux term) carries 1/M weight
+        daux = cot                # chunk's aux term) carries 1/M weight
         grad_acc: List[Any] = [None] * S
 
-        def acc(s, dparams):
-            grad_acc[s] = (dparams if grad_acc[s] is None
-                           else self._acc(grad_acc[s], dparams))
+        def acc_stage(s, dparams):
+            # chunk grads land in the stage accumulator keyed by op name;
+            # chunks of one stage have disjoint op names, so a plain merge
+            # is exact — the jitted tree-add only fires when the SAME
+            # chunk's grads accumulate across microbatches
+            if grad_acc[s] is None:
+                grad_acc[s] = dict(dparams)
+                return
+            overlap = {k: v for k, v in dparams.items() if k in grad_acc[s]}
+            fresh = {k: v for k, v in dparams.items()
+                     if k not in grad_acc[s]}
+            if overlap:
+                self.step_dispatches += 1
+                summed = self._acc(
+                    {k: grad_acc[s][k] for k in overlap}, overlap)
+                grad_acc[s].update(summed)
+            grad_acc[s].update(fresh)
 
-        # ---- forward sweep; the pipeline TAIL (last stage's forward, the
-        # loss, and the last stage's backward) is one compiled program, so
-        # the turnaround needs no logits fetch / separate loss dispatch.
-        # Async dispatch pipelines stages across submeshes: microbatch m+1's
-        # stage-s program is enqueued while m runs on stage s+1's devices.
-        # Non-remat (default): jax.vjp over the jitted stage function — the
-        # forward runs as one compiled program whose residuals stay on the
-        # stage's devices, and the transpose is a second cached compiled
-        # program. Remat: only stage-boundary activations are kept and the
-        # compiled backward replays the forward.
         remat = self.cfg.remat
-        stage_in = [[None] * S for _ in range(M)]
-        vjps = [[None] * S for _ in range(M)]
-        losses, aux_mb, logits_mb = [None] * M, [None] * M, [None] * M
-        dacts_tail = [None] * M
-        for m in range(M):
-            acts = self._ship(
-                0, {tid: mb[m] for tid, mb in zip(self.input_ids, xs_mb)}
-            )
-            aux_terms = []
-            for s in range(S - 1):
-                mrng = self._mb_rng(rng, m, s)
-                if remat:
-                    stage_in[m][s] = acts
-                    acts, aux = self._stage_fwd[s](
-                        self.stage_params[s], acts, mrng)
-                else:
-                    (acts, aux), vjps[m][s] = jax.vjp(
-                        lambda p, a, _f=self._stage_fwd[s], _r=mrng:
-                            _f(p, a, _r),
-                        self.stage_params[s], acts,
-                    )
-                aux_terms.append(aux)
-                acts = self._ship(s + 1, acts)
-            mrng = self._mb_rng(rng, m, S - 1)
-            ym = jax.device_put(y_mb[m], self._act_sharding(S - 1, y_mb[m]))
-            loss, aux, logits, dparams, dacts = self._bwd_last(
-                self.stage_params[S - 1], acts, mrng, ym, cot
-            )
-            acc(S - 1, dparams)
-            aux_terms.append(aux)
-            # per-stage aux scalars live on different submeshes; combined on
-            # host at the end (eager adds across device sets are not allowed)
-            losses[m] = loss
-            aux_mb[m] = aux_terms
-            logits_mb[m] = logits
-            if S > 1:
-                dacts_tail[m] = self._ship(S - 2, dacts)
+        # per-(chunk, mb) in-flight state; everything is freed (popped)
+        # the moment its consumer runs, so the live set follows the
+        # schedule — the 1F1B memory bound
+        fwd_buf: Dict[Tuple[int, int], Dict] = {}   # shipped chunk inputs
+        saved_in: Dict[Tuple[int, int], Dict] = {}  # remat: saved inputs
+        vjps: Dict[Tuple[int, int], Any] = {}       # non-remat: vjp closures
+        dacts_buf: Dict[Tuple[int, int], Dict] = {}  # incoming cotangents
+        losses: List[Any] = [None] * M
+        aux_terms: Dict[Tuple[int, int], Any] = {}  # (mb, chunk) -> scalar
+        logits_mb: List[Any] = [None] * M
 
-        # ---- backward sweep over the remaining stages (reverse order per
-        # microbatch; each compiled backward replays its stage's forward
-        # with the SAME per-stage rng)
-        for m in range(M):
-            dacts = dacts_tail[m]
-            for s in reversed(range(S - 1)):
-                if remat:
-                    mrng = self._mb_rng(rng, m, s)
-                    dparams, dacts = self._stage_bwd[s](
-                        self.stage_params[s], stage_in[m][s], mrng,
-                        dacts, daux,
-                    )
-                else:
-                    dparams, dacts = vjps[m][s]((dacts, daux))
-                    vjps[m][s] = None  # free residuals
-                if s > 0:
-                    dacts = self._ship(s - 1, dacts)
-                acc(s, dparams)
+        def inputs_for(m: int) -> Dict:
+            return self._ship(
+                0, {tid: mb[m] for tid, mb in zip(self.input_ids, xs_mb)})
+
+        for row in self.schedule.ticks:
+            for s, a in enumerate(row):
+                if a is None:
+                    continue
+                c, m = a.chunk, a.mb
+                mrng = self._mb_rng(rng, m, c)
+                if a.kind == "F":
+                    acts = (inputs_for(m) if c == 0
+                            else fwd_buf.pop((c, m)))
+                    self.step_dispatches += 1
+                    if remat:
+                        saved_in[(c, m)] = acts
+                        out, aux = self._chunk_fwd[c](
+                            self._chunk_params(c), acts, mrng)
+                    else:
+                        (out, aux), vjps[(c, m)] = jax.vjp(
+                            lambda p, a_, _f=self._chunk_fwd[c], _r=mrng:
+                                _f(p, a_, _r),
+                            self._chunk_params(c), acts,
+                        )
+                    aux_terms[(m, c)] = aux
+                    fwd_buf[(c + 1, m)] = self._ship(
+                        self.chunk_stage(c + 1), out)
+                elif a.kind == "FB":
+                    acts = (inputs_for(m) if c == 0
+                            else fwd_buf.pop((c, m)))
+                    ym = jax.device_put(
+                        y_mb[m], self._act_sharding(s, y_mb[m]))
+                    self.step_dispatches += 1
+                    loss, aux, logits, dparams, dacts = self._bwd_last(
+                        self._chunk_params(c), acts, mrng, ym, cot)
+                    acc_stage(s, dparams)
+                    aux_terms[(m, c)] = aux
+                    losses[m] = loss
+                    logits_mb[m] = logits
+                    if c > 0:
+                        dacts_buf[(c - 1, m)] = self._ship(
+                            self.chunk_stage(c - 1), dacts)
+                else:  # backward
+                    dacts = dacts_buf.pop((c, m))
+                    self.step_dispatches += 1
+                    if remat:
+                        dparams, dacts = self._chunk_bwd[c](
+                            self._chunk_params(c), saved_in.pop((c, m)),
+                            mrng, dacts, daux)
+                    else:
+                        dparams, dacts = vjps.pop((c, m))((dacts, daux))
+                    acc_stage(s, dparams)
+                    if c > 0:
+                        dacts_buf[(c - 1, m)] = self._ship(
+                            self.chunk_stage(c - 1), dacts)
 
         # ---- per-stage optimizer update on each submesh
+        hyper = self.optimizer.hyperparams()
         for s in range(S):
+            self.step_dispatches += 1
             self.stage_params[s], self.stage_opt_state[s] = \
                 self._stage_update[s](self.stage_params[s], grad_acc[s],
-                                      self.stage_opt_state[s])
+                                      self.stage_opt_state[s], hyper)
 
+        # flatten aux in (microbatch-major, chunk-ascending) order — the
+        # historical host combine order, so the reported loss is
+        # bit-identical across schedules and engines
+        aux_flat = [aux_terms[(m, c)] for m in range(M) for c in range(C)
+                    if (m, c) in aux_terms]
         if not sync:
-            return losses, [a for terms in aux_mb for a in terms]
+            return losses, aux_flat
         loss = float(
             sum(jax.device_get(l) for l in losses)
-            + sum(jax.device_get(a) for terms in aux_mb for a in terms)
+            + sum(jax.device_get(a) for a in aux_flat)
         ) * inv_m
         bm = {}
         if self.metrics_fn is not None:
@@ -467,14 +586,103 @@ class PipelinedModel:
         return loss, bm
 
     def forward_only(self, xs: Sequence[jax.Array]):
-        acts = self._ship(
-            0, {tid: jnp.asarray(x) for tid, x in zip(self.input_ids, xs)}
-        )
-        for s in range(len(self.stages)):
-            acts, _ = self._stage_fwd_eval[s](self.stage_params[s], acts)
-            if s < len(self.stages) - 1:
-                acts = self._ship(s + 1, acts)
-        return acts[self.logits_id]
+        # the dispatch/transfer counters report the most recent TRAIN
+        # step (profiling.pipeline_report's contract); an eval pass
+        # must not inflate them
+        saved = (self.step_dispatches, self.step_transfers)
+        try:
+            acts = self._ship(
+                0, {tid: jnp.asarray(x)
+                    for tid, x in zip(self.input_ids, xs)}
+            )
+            for c in range(len(self.chunks)):
+                acts, _ = self._chunk_fwd_eval[c](self._chunk_params(c),
+                                                  acts)
+                if c < len(self.chunks) - 1:
+                    acts = self._ship(self.chunk_stage(c + 1), acts)
+            return acts[self.logits_id]
+        finally:
+            self.step_dispatches, self.step_transfers = saved
+
+    # ------------------------------------------------------ observability
+    def _boundary_mb_bytes(self, mb_size: int) -> List[int]:
+        """Per-chunk input bytes for ONE microbatch (chunk 0 = the model
+        inputs; chunk c>0 = the c-1 -> c boundary tensors), at logical
+        (unsharded) sizes."""
+        tid_dims: Dict[int, Tuple] = {}
+        tid_item: Dict[int, int] = {}
+        for chunk in self.chunks:
+            for op in chunk:
+                for t in list(op.layer.inputs) + list(op.layer.outputs):
+                    tid_dims[t.tensor_id] = tuple(t.dims)
+                    try:
+                        tid_item[t.tensor_id] = t.dtype.itemsize()
+                    except Exception:
+                        tid_item[t.tensor_id] = 4
+
+        def nbytes(tid: int) -> int:
+            dims = tid_dims.get(tid)
+            if not dims:
+                return 0
+            n = mb_size
+            for d in dims[1:]:
+                n *= d
+            return n * tid_item.get(tid, 4)
+
+        out = [sum(nbytes(t) for t in self.input_ids)]
+        for c in range(len(self.chunks) - 1):
+            out.append(sum(nbytes(t) for t in self._live_after(c)))
+        return out
+
+    def peak_activation_bytes(self, mb_size: Optional[int] = None) -> Dict:
+        """Schedule-implied peak live stage-boundary activation bytes:
+        walk the tick table holding each forward's chunk-input bytes live
+        until its backward consumes them. The comparable metric across
+        schedules and engines (vjp residuals scale with the same live
+        set). Returns {"per_stage": [...], "max": int, "total": int} —
+        ``total`` sums the per-stage peaks (machine-wide worst case;
+        the headline GPipe-vs-1F1B comparison)."""
+        bbytes = self._boundary_mb_bytes(mb_size or 1)
+        S = len(self.stages)
+        live = [0] * S
+        peak = [0] * S
+        for row in self.schedule.ticks:
+            for s, a in enumerate(row):
+                if a is None:
+                    continue
+                b = bbytes[a.chunk]
+                if a.kind == "F":
+                    live[s] += b
+                elif a.kind == "B":
+                    peak[s] = max(peak[s], live[s])
+                    live[s] -= b
+                else:  # FB holds its input for the tick, then releases
+                    peak[s] = max(peak[s], live[s] + b)
+            for s in range(S):
+                peak[s] = max(peak[s], live[s])
+        return {"per_stage": peak, "max": max(peak), "total": sum(peak)}
+
+    def profile(self, mb_size: Optional[int] = None) -> Dict:
+        """One JSON-able record of what this engine executes per step:
+        the schedule summary (bubble fraction, per-stage peak live
+        microbatches), the engine name, measured dispatch/transfer counts
+        from the most recent ``train_step``, and the schedule-implied
+        peak activation bytes. Lands in ``fit_profile["pipeline"]``."""
+        from ..sim.cost_model import OpCostModel
+
+        from .schedule import render_timeline
+
+        rec = schedule_summary(self.schedule,
+                               bwd_ratio=OpCostModel.BWD_FACTOR)
+        rec["engine"] = self.engine_name
+        rec["remat"] = bool(self.cfg.remat)
+        rec["dispatches_per_step"] = self.step_dispatches
+        rec["transfers_per_step"] = self.step_transfers
+        rec["timeline"] = render_timeline(self.schedule)
+        if mb_size:
+            rec["peak_activation_bytes"] = \
+                self.peak_activation_bytes(mb_size)
+        return rec
 
     # convenience: gather all params back to host (checkpointing, tests)
     def all_params(self) -> Dict:
@@ -513,11 +721,13 @@ class PipelinedModel:
         cm.opt_state = merged
 
     def refresh_updates(self) -> None:
-        """Re-trace the per-stage optimizer updates after a hyperparameter
-        change (learning-rate schedules): the jitted closures bake the
-        optimizer's attributes in at trace time."""
-        self._stage_update = [self._make_stage_update(s)
-                              for s in range(len(self.stages))]
+        """Historical hook called after a hyperparameter change
+        (learning-rate schedules). No-op by design since the per-stage
+        updates take ``optimizer.hyperparams()`` as a TRACED argument
+        read fresh each step — mutating lr/alpha is already live.
+        Re-jitting here would be a lie: pjit's cache is keyed on the
+        underlying function and would silently reuse the stale
+        executable."""
 
     def sync_from(self, cm) -> None:
         """Re-seed stage params/opt_state from the CompiledModel (after a
@@ -532,3 +742,36 @@ class PipelinedModel:
                         for w, v in cm.params[op.name].items()
                     }
         self.stage_opt_state = self._slice_opt_state(cm.opt_state)
+
+
+def make_pipelined_model(ops, mesh, cfg: PipelineConfig, optimizer,
+                         loss_fn, metrics_fn, input_ids, logits_id,
+                         params, wd_mask, opt_state=None,
+                         compute_dtype=None):
+    """Engine selection: the single-dispatch compiled engine when the
+    (mesh, schedule, optimizer-state) envelope allows, else the
+    host-driven engine. ``cfg.engine`` forces either; forcing
+    ``"compiled"`` outside its envelope raises with the reason."""
+    kw = dict(optimizer=optimizer, loss_fn=loss_fn, metrics_fn=metrics_fn,
+              input_ids=input_ids, logits_id=logits_id, params=params,
+              wd_mask=wd_mask, opt_state=opt_state,
+              compute_dtype=compute_dtype)
+    if cfg.engine not in ("auto", "host", "compiled"):
+        raise ValueError(
+            f"pipeline engine {cfg.engine!r}: expected auto|host|compiled")
+    if cfg.engine == "host":
+        return PipelinedModel(ops, mesh, cfg, **kw)
+    from .pipeline_compiled import (CompiledPipelinedModel,
+                                    compiled_engine_unsupported)
+    reason = compiled_engine_unsupported(mesh, cfg)
+    if reason is None:
+        try:
+            return CompiledPipelinedModel(ops, mesh, cfg, **kw)
+        except NotImplementedError as e:
+            if cfg.engine == "compiled":
+                raise
+            reason = str(e)
+    if cfg.engine == "compiled":
+        raise ValueError(
+            f"pipeline engine 'compiled' unsupported here: {reason}")
+    return PipelinedModel(ops, mesh, cfg, **kw)

@@ -1,0 +1,716 @@
+"""Single-dispatch pipeline engine: the whole schedule as ONE program.
+
+The host-driven engine (:mod:`.pipeline`) issues one program dispatch per
+schedule action — O(stages × microbatches) per train step, each paying
+host-side dispatch latency, with Python-side fences exposing the bubble.
+This engine lowers the ENTIRE warmup/steady/cooldown schedule into one
+jitted SPMD program:
+
+* ``lax.scan`` over schedule ticks; per tick every stage executes its
+  scheduled action (``lax.switch`` over {idle, F, B, FB}, with an inner
+  switch over the per-stage chunk programs — stages are heterogeneous op
+  sub-graphs, not a repeated layer);
+* stage-boundary transfers are **collective permutes** over the mesh's
+  pipe axis inside ``shard_map`` — the ICI hop, expressed where it
+  happens instead of as host-driven ``device_put`` edges;
+* gradients accumulate into a per-stage packed buffer in fixed
+  microbatch order (the same order as the host engine, so per-step
+  losses/grads match bit for bit up to XLA refusion);
+* the per-stage optimizer update runs INSIDE the same program, with the
+  optimizer hyperparameters as traced arguments — one dispatch per train
+  step, O(1) instead of O(stages × microbatches).
+
+Heterogeneous stages under one SPMD program require uniform per-device
+state, so each stage's parameters / optimizer state / boundary
+activations are packed into flat, padded buffers stacked over the pipe
+axis (``(S, L)`` sharded one row per stage — per-device memory stays
+~1/S of the model, exactly like the host engine). float32 leaves pack
+verbatim, bfloat16 upcasts losslessly, int32 bit-casts; anything else
+falls outside the envelope and :func:`make_pipelined_model` falls back
+to the host engine.
+
+Envelope (checked by :func:`compiled_engine_unsupported`):
+
+* one device per stage — every mesh axis except the pipe axis has size 1
+  (the CPU/TPU SPMD partitioner cannot nest GSPMD inside a manual
+  shard_map region on this backend, so dp/tp inside a stage stays with
+  the host engine);
+* schedule ``gpipe`` or ``1f1b`` with ``interleave == 1`` (interleaved
+  virtual stages stay host-driven);
+* backward is remat-by-construction: each backward replays its chunk's
+  forward from the saved packed boundary input — only stage-boundary
+  activations ever live in the scan carry, which is what makes the 1F1B
+  O(num_stages) activation bound real at the buffer level
+  (``saved: (K, A)`` with K = the schedule's peak live count).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..core.machine import mesh_axis_sizes
+from .pipeline import PipelineConfig, PipelinedModel
+
+_PACK_DTYPES = (jnp.float32, jnp.bfloat16, jnp.int32)
+
+
+def compiled_engine_unsupported(mesh: Mesh,
+                                cfg: PipelineConfig) -> Optional[str]:
+    """None when the single-dispatch engine can run on (mesh, cfg); else
+    a one-line reason (the factory's fallback message and the forced-
+    engine error)."""
+    if cfg.schedule not in ("gpipe", "1f1b"):
+        return (f"schedule {cfg.schedule!r} is host-driven "
+                f"(compiled supports gpipe|1f1b)")
+    if cfg.interleave != 1:
+        return "interleaved virtual stages are host-driven"
+    sizes = mesh_axis_sizes(mesh)
+    extra = {a: s for a, s in sizes.items() if a != cfg.axis and s > 1}
+    if extra:
+        return (f"mesh has non-trivial axes {extra} besides "
+                f"'{cfg.axis}' — one device per stage required")
+    if sizes.get(cfg.axis, 1) < 2:
+        return f"mesh {cfg.axis} axis has degree < 2"
+    return None
+
+
+# ------------------------------------------------------------- packing
+def _leaf_segments(tree) -> Tuple[List[Tuple], Any, int]:
+    """(segments, treedef, total_f32_len) for a pytree of arrays/specs.
+    Each segment is (offset, length, shape, dtype). Raises
+    NotImplementedError on unpackable dtypes — the factory's fallback
+    trigger."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    segs = []
+    off = 0
+    for l in leaves:
+        dt = jnp.dtype(l.dtype)
+        if dt not in _PACK_DTYPES:
+            raise NotImplementedError(
+                f"cannot pack dtype {dt} into the single-dispatch "
+                f"engine's f32 buffers")
+        n = int(np.prod(l.shape)) if l.shape else 1
+        segs.append((off, n, tuple(l.shape), dt))
+        off += n
+    return segs, treedef, off
+
+
+def _pack(leaves, segs, total: int) -> jax.Array:
+    """Flatten leaves into one (total,) f32 buffer. bf16 upcasts
+    (lossless), int32 bit-casts (exact); ``float0`` leaves — the vjp
+    cotangents of integer boundary tensors (MoE routing indices crossing
+    a stage cut) — carry no information and pack as zeros."""
+    parts = []
+    used = 0
+    for l, (off, n, shape, dt) in zip(leaves, segs):
+        if jnp.dtype(getattr(l, "dtype", jnp.float32)) == \
+                jax.dtypes.float0:
+            parts.append(jnp.zeros((n,), jnp.float32))
+            used += n
+            continue
+        v = jnp.reshape(l, (-1,)) if l.shape else jnp.reshape(l, (1,))
+        if dt == jnp.bfloat16:
+            v = v.astype(jnp.float32)
+        elif dt == jnp.int32:
+            v = jax.lax.bitcast_convert_type(v, jnp.float32)
+        parts.append(v)
+        used += n
+    if total > used:
+        parts.append(jnp.zeros((total - used,), jnp.float32))
+    return jnp.concatenate(parts) if parts else jnp.zeros((total,),
+                                                          jnp.float32)
+
+
+def _unpack(buf: jax.Array, segs, treedef, cotangent: bool = False):
+    """Inverse of :func:`_pack`. With ``cotangent=True`` integer
+    segments yield ``float0`` zeros — the only cotangent type jax.vjp
+    accepts for integer primal outputs."""
+    leaves = []
+    for off, n, shape, dt in segs:
+        if cotangent and dt == jnp.int32:
+            leaves.append(np.zeros(shape, jax.dtypes.float0))
+            continue
+        v = jax.lax.dynamic_slice_in_dim(buf, off, n)
+        if dt == jnp.bfloat16:
+            v = v.astype(jnp.bfloat16)
+        elif dt == jnp.int32:
+            v = jax.lax.bitcast_convert_type(v, jnp.int32)
+        leaves.append(jnp.reshape(v, shape))
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+# ------------------------------------------------------------- tables
+_IDLE, _F, _B, _FB = 0, 1, 2, 3
+
+
+def _build_tables(sched) -> Dict[str, np.ndarray]:
+    """Static per-(tick, stage) control tables driving the scan body:
+    action kind/microbatch, edge-buffer read/write slots (round-robin
+    over the max in-flight count per direction; the scratch slot R
+    absorbs ticks with no arrival), and the saved-input ring slot."""
+    S, T = sched.num_stages, sched.num_ticks
+    kinds = np.zeros((T, S), np.int32)
+    mbs = np.zeros((T, S), np.int32)
+    karr = {"F": _F, "B": _B, "FB": _FB}
+    for t, row in enumerate(sched.ticks):
+        for s, a in enumerate(row):
+            if a is not None:
+                kinds[t, s] = karr[a.kind]
+                mbs[t, s] = a.mb
+    # edge-buffer slot assignment: FIFO arrival/consumption per edge
+    # (validate_buffers guarantees in-order consumption), so slot =
+    # sequence index mod R is collision-free
+    arr_f = [0] * S
+    use_f = [0] * S
+    arr_b = [0] * S
+    use_b = [0] * S
+    wf = np.full((T, S), -1, np.int32)
+    rf = np.zeros((T, S), np.int32)
+    wb = np.full((T, S), -1, np.int32)
+    rb = np.zeros((T, S), np.int32)
+    # a value produced at tick t arrives (via the permute in the carry)
+    # at the START of tick t+1 on the neighbor
+    for t, row in enumerate(sched.ticks):
+        if t > 0:
+            prev = sched.ticks[t - 1]
+            for s, a in enumerate(prev):
+                if a is None:
+                    continue
+                if a.kind == "F" and s + 1 < S:
+                    wf[t, s + 1] = arr_f[s + 1]
+                    arr_f[s + 1] += 1
+                if a.kind in ("B", "FB") and s - 1 >= 0:
+                    wb[t, s - 1] = arr_b[s - 1]
+                    arr_b[s - 1] += 1
+        for s, a in enumerate(row):
+            if a is None:
+                continue
+            if a.kind in ("F", "FB") and s > 0:
+                rf[t, s] = use_f[s]
+                use_f[s] += 1
+            if a.kind == "B":
+                rb[t, s] = use_b[s]
+                use_b[s] += 1
+    return dict(kinds=kinds, mbs=mbs, wf=wf, rf=rf, wb=wb, rb=rb)
+
+
+def _slot_mod(tables: Dict[str, np.ndarray], sched) -> Dict[str, Any]:
+    """Finalize slot tables: compute per-direction ring sizes R from the
+    schedule's max in-flight counts (an exact replay of pending values
+    over the tick table), reduce sequence indices mod R, and point
+    no-arrival ticks at the scratch slot R."""
+    S = sched.num_stages
+    pend_f = [0] * S
+    pend_b = [0] * S
+    R_f = R_b = 1
+    for t, row in enumerate(sched.ticks):
+        for s, a in enumerate(row):
+            if a is None:
+                continue
+            if a.kind in ("F", "FB") and s > 0:
+                pend_f[s] -= 1
+            if a.kind == "B" and s < S - 1:
+                pend_b[s] -= 1
+        for s, a in enumerate(row):
+            if a is None:
+                continue
+            if a.kind == "F" and s + 1 < S:
+                pend_f[s + 1] += 1
+                R_f = max(R_f, pend_f[s + 1])
+            if a.kind in ("B", "FB") and s - 1 >= 0:
+                pend_b[s - 1] += 1
+                R_b = max(R_b, pend_b[s - 1])
+    out = dict(tables)
+    out["wf"] = np.where(tables["wf"] >= 0, tables["wf"] % R_f, R_f)
+    out["rf"] = tables["rf"] % R_f
+    out["wb"] = np.where(tables["wb"] >= 0, tables["wb"] % R_b, R_b)
+    out["rb"] = tables["rb"] % R_b
+    out["R_f"], out["R_b"] = R_f, R_b
+    return out
+
+
+class CompiledPipelinedModel(PipelinedModel):
+    """Single-dispatch engine: train_step = ONE jitted program.
+
+    Extends the host engine (which provides stage splitting, parameter
+    placement, the per-chunk programs used by ``forward_only``/eval, and
+    the sync/checkpoint surface); the packed buffers used by the
+    compiled step are (re)built lazily from ``stage_params`` /
+    ``stage_opt_state`` on the first ``train_step`` after construction
+    or any ``sync_from``, so external weight surgery (checkpoint
+    restore, recompile carry-over) flows in naturally.
+    """
+
+    engine_name = "compiled"
+
+    # class-level defaults: the stage_params/stage_opt_state property
+    # setters fire during the BASE __init__, before this subclass's
+    # __init__ body runs, so the state they touch must already resolve
+    _packed = None
+    _views_stale = False
+
+    def __init__(self, ops, mesh, cfg: PipelineConfig, **kw):
+        reason = compiled_engine_unsupported(mesh, cfg)
+        if reason is not None:
+            raise NotImplementedError(reason)
+        super().__init__(ops, mesh, cfg, **kw)
+        S = len(self.stages)
+        pipe_index = list(mesh.axis_names).index(cfg.axis)
+        flat = np.moveaxis(mesh.devices, pipe_index, 0).reshape(S)
+        self._pmesh = Mesh(flat, ("pipe",))
+        # static packing metadata (raises NotImplementedError on
+        # unpackable dtypes BEFORE any device work — the factory's
+        # fallback point)
+        self._param_segs = []   # per stage: (segs, treedef, len)
+        for s in range(S):
+            self._param_segs.append(_leaf_segments(self.stage_params[s]))
+        self._opt_segs = [
+            _leaf_segments(self.stage_opt_state[s]) for s in range(S)]
+        self._Lp = max(seg[2] for seg in self._param_segs)
+        self._Lo = max(max(seg[2] for seg in self._opt_segs), 1)
+        self._tables = _slot_mod(_build_tables(self.schedule),
+                                 self.schedule)
+        self._packed = None       # (theta, opt) device buffers
+        self._views_stale = False
+        self._programs: Dict[Tuple, Any] = {}  # per (mb_shape sig) jit
+        self._boundary_meta = None  # filled per microbatch shape
+
+    # ----------------------------------------------------- pack/unpack
+    def _ensure_packed(self) -> None:
+        if self._packed is not None:
+            return
+        S = len(self.stages)
+        rows_p, rows_o = [], []
+        for s in range(S):
+            psegs, ptd, pn = self._param_segs[s]
+            leaves = jax.tree_util.tree_flatten(
+                self._stage_params_raw[s])[0]
+            rows_p.append(np.asarray(_pack(
+                [jnp.asarray(np.asarray(l)) for l in leaves], psegs,
+                self._Lp)))
+            osegs, otd, on = self._opt_segs[s]
+            oleaves = jax.tree_util.tree_flatten(
+                self._stage_opt_state_raw[s])[0]
+            rows_o.append(np.asarray(_pack(
+                [jnp.asarray(np.asarray(l)) for l in oleaves], osegs,
+                self._Lo)))
+        sh = NamedSharding(self._pmesh, PartitionSpec("pipe"))
+        theta = jax.device_put(np.stack(rows_p), sh)
+        opt = jax.device_put(np.stack(rows_o), sh)
+        self._packed = [theta, opt]
+
+    def _refresh_views(self) -> None:
+        """Unpack the packed training state back into the per-stage
+        dict views (stage_params / stage_opt_state) on their submeshes.
+        Called lazily by every dict-reading access point."""
+        if not self._views_stale or self._packed is None:
+            return
+        self._views_stale = False
+        theta = np.asarray(jax.device_get(self._packed[0]))
+        opt = np.asarray(jax.device_get(self._packed[1]))
+        for s in range(len(self.stages)):
+            psegs, ptd, _ = self._param_segs[s]
+            tree = _unpack(jnp.asarray(theta[s]), psegs, ptd)
+            old = self._stage_params_raw[s]
+            for opn, ws in tree.items():
+                for w, v in ws.items():
+                    old[opn][w] = jax.device_put(
+                        np.asarray(v), old[opn][w].sharding)
+            osegs, otd, _ = self._opt_segs[s]
+            otree = _unpack(jnp.asarray(opt[s]), osegs, otd)
+
+            def place(new_leaf, old_leaf):
+                return jax.device_put(np.asarray(new_leaf),
+                                      old_leaf.sharding)
+
+            self._stage_opt_state_raw[s] = jax.tree_util.tree_map(
+                place, otree, self._stage_opt_state_raw[s])
+
+    # property interposition: dict reads refresh lazily; dict REBINDS
+    # (sync_from, recompile reseeding) invalidate the packed buffers
+    @property
+    def stage_params(self):
+        self._refresh_views()
+        return self._stage_params_raw
+
+    @stage_params.setter
+    def stage_params(self, v):
+        self._stage_params_raw = v
+        self._packed = None
+
+    @property
+    def stage_opt_state(self):
+        self._refresh_views()
+        return self._stage_opt_state_raw
+
+    @stage_opt_state.setter
+    def stage_opt_state(self, v):
+        self._stage_opt_state_raw = v
+        self._packed = None
+
+    def sync_from(self, cm) -> None:
+        super().sync_from(cm)
+        self._packed = None
+        self._views_stale = False
+
+    # ------------------------------------------------------- boundaries
+    def _boundary_segments(self, mb: int):
+        """Per-boundary packed-activation segments at microbatch size
+        ``mb``, derived by chaining jax.eval_shape over the chunk
+        programs (the ONLY reliable source of boundary dtypes under
+        mixed precision / integer pass-through)."""
+        C = len(self.chunks)
+        tid_dims = {}
+        tid_dtype = {}
+        for chunk in self.chunks:
+            for op in chunk:
+                for t in list(op.layer.inputs):
+                    tid_dims[t.tensor_id] = tuple(t.dims)
+                    tid_dtype[t.tensor_id] = t.dtype.to_jnp()
+        acts = {}
+        for tid in self.input_ids:
+            dims = tid_dims[tid]
+            acts[tid] = jax.ShapeDtypeStruct((mb,) + dims[1:],
+                                             tid_dtype[tid])
+        key = jax.random.key(0)
+        segs = []
+        for c in range(C - 1):
+            fwd = self._chunk_apply(c, training=True, mesh=False)
+            params = jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                self._stage_params_raw[self.chunk_stage(c)])
+            cp = {op.name: params[op.name] for op in self.chunks[c]
+                  if op.name in params}
+            out, _aux = jax.eval_shape(fwd, cp, acts, key)
+            segs.append(_leaf_segments(out))
+            acts = out
+        A = max(s[2] for s in segs)
+        return segs, A
+
+    # ---------------------------------------------------------- program
+    def _chunk_params_from(self, theta_row, c: int):
+        s = self.chunk_stage(c)
+        segs, td, _n = self._param_segs[s]
+        return _unpack(theta_row, segs, td)
+
+    def _build_program(self, mb: int, xs_shapes, y_shape, y_dtype,
+                       with_metrics: bool):
+        S = len(self.stages)
+        C = len(self.chunks)
+        M = self.cfg.num_microbatches
+        tb = self._tables
+        bsegs, A = self._boundary_segments(mb)
+        K = max(self.schedule.peak_live(s) for s in range(S))
+        R_f, R_b = tb["R_f"], tb["R_b"]
+        kinds = jnp.asarray(tb["kinds"])
+        mbs_t = jnp.asarray(tb["mbs"])
+        wf = jnp.asarray(tb["wf"])
+        rf = jnp.asarray(tb["rf"])
+        wb = jnp.asarray(tb["wb"])
+        rb = jnp.asarray(tb["rb"])
+        T = tb["kinds"].shape[0]
+        inv_m = 1.0 / M
+        loss_fn = self.loss_fn
+        logits_id = self.logits_id
+        cdt = self.compute_dtype
+        chunk_fns = [self._chunk_apply(c, training=True, mesh=False)
+                     for c in range(C)]
+        # logits shape for the metrics buffer (from the tail chunk)
+        logits_sds = None
+        if with_metrics:
+            acts_spec = _unpack(jnp.zeros((A,), jnp.float32),
+                                bsegs[C - 2][0], bsegs[C - 2][1])
+            params_spec = jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                self._stage_params_raw[S - 1])
+            cp = {op.name: params_spec[op.name]
+                  for op in self.chunks[C - 1] if op.name in params_spec}
+            out, _ = jax.eval_shape(
+                chunk_fns[C - 1],
+                cp,
+                jax.tree_util.tree_map(
+                    lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                    acts_spec),
+                jax.random.key(0))
+            lg = out[logits_id]
+            lg_dt = jnp.float32 if cdt is not None else lg.dtype
+            logits_sds = (lg.shape, lg_dt)
+
+        fwd_perm = [(i, i + 1) for i in range(S - 1)]
+        bwd_perm = [(i + 1, i) for i in range(S - 1)]
+
+        def shard_body(theta, opt, rng, hyper, y_st, *xs_st):
+            # theta: (1, Lp) local row; squeeze to (Lp,)
+            th = theta[0]
+            op_buf = opt[0]
+            sidx = jax.lax.axis_index("pipe")
+            daux = jnp.asarray(inv_m)
+            cot = jnp.asarray(inv_m)
+
+            def inputs_for(m):
+                return {tid: jax.lax.dynamic_index_in_dim(
+                            x, m, 0, keepdims=False)
+                        for tid, x in zip(self.input_ids, xs_st)}
+
+            def mb_rng(m, c):
+                return jax.random.fold_in(rng, m * 131 + c)
+
+            # ---- per-kind branches; uniform operand/result signatures
+            def idle_fn(opr):
+                (m, rfv, rbv, fsl, bsl, saved, gacc, losses, auxes,
+                 logits_b) = opr
+                return (jnp.zeros((A,), jnp.float32),
+                        jnp.zeros((A,), jnp.float32),
+                        saved, gacc, losses, auxes, logits_b)
+
+            def f_fn(opr):
+                (m, rfv, rbv, fsl, bsl, saved, gacc, losses, auxes,
+                 logits_b) = opr
+                inbuf = jax.lax.dynamic_index_in_dim(fsl, rfv, 0,
+                                                     keepdims=False)
+
+                def br(c):
+                    def run(_):
+                        if c == 0:
+                            acts = inputs_for(m)
+                        else:
+                            acts = _unpack(inbuf, bsegs[c - 1][0],
+                                           bsegs[c - 1][1])
+                        out, aux = chunk_fns[c](
+                            self._chunk_params_from(th, c), acts,
+                            mb_rng(m, c))
+                        send = _pack(
+                            jax.tree_util.tree_flatten(out)[0],
+                            bsegs[c][0], A)
+                        return send, jnp.asarray(aux, jnp.float32)
+                    return run
+
+                send_f, aux = jax.lax.switch(
+                    sidx, [br(c) for c in range(C - 1)], 0)
+                # save the packed input for the backward replay (stage 0
+                # replays from xs directly; its slot holds zeros)
+                slot = jnp.mod(m, K)
+                saved = jax.lax.dynamic_update_index_in_dim(
+                    saved, jnp.where(sidx > 0, inbuf,
+                                     jnp.zeros((A,), jnp.float32)),
+                    slot, 0)
+                auxes = auxes.at[m].set(aux)
+                return (send_f, jnp.zeros((A,), jnp.float32), saved,
+                        gacc, losses, auxes, logits_b)
+
+            def b_fn(opr):
+                (m, rfv, rbv, fsl, bsl, saved, gacc, losses, auxes,
+                 logits_b) = opr
+                d_out_buf = jax.lax.dynamic_index_in_dim(
+                    bsl, rbv, 0, keepdims=False)
+                slot = jnp.mod(m, K)
+                saved_in = jax.lax.dynamic_index_in_dim(
+                    saved, slot, 0, keepdims=False)
+
+                def br(c):
+                    def run(_):
+                        if c == 0:
+                            acts_in = inputs_for(m)
+                        else:
+                            acts_in = _unpack(saved_in, bsegs[c - 1][0],
+                                              bsegs[c - 1][1])
+                        d_out = _unpack(d_out_buf, bsegs[c][0],
+                                        bsegs[c][1], cotangent=True)
+                        params_c = self._chunk_params_from(th, c)
+                        _, vjp = jax.vjp(
+                            lambda p, a: chunk_fns[c](p, a,
+                                                      mb_rng(m, c)),
+                            params_c, acts_in)
+                        dparams, dacts = vjp((d_out, daux))
+                        g = _pack(jax.tree_util.tree_flatten(dparams)[0],
+                                  self._param_segs[
+                                      self.chunk_stage(c)][0],
+                                  self._Lp)
+                        if c > 0:
+                            send_b = _pack(
+                                jax.tree_util.tree_flatten(dacts)[0],
+                                bsegs[c - 1][0], A)
+                        else:
+                            send_b = jnp.zeros((A,), jnp.float32)
+                        return send_b, g
+                    return run
+
+                send_b, g = jax.lax.switch(
+                    sidx, [br(c) for c in range(C - 1)], 0)
+                return (jnp.zeros((A,), jnp.float32), send_b, saved,
+                        gacc + g, losses, auxes, logits_b)
+
+            def fb_fn(opr):
+                (m, rfv, rbv, fsl, bsl, saved, gacc, losses, auxes,
+                 logits_b) = opr
+                c = C - 1
+                inbuf = jax.lax.dynamic_index_in_dim(fsl, rfv, 0,
+                                                     keepdims=False)
+                acts_in = _unpack(inbuf, bsegs[c - 1][0], bsegs[c - 1][1])
+                ym = jax.lax.dynamic_index_in_dim(y_st, m, 0,
+                                                  keepdims=False)
+                params_c = self._chunk_params_from(th, c)
+
+                def f(p, a):
+                    out, aux = chunk_fns[c](p, a, mb_rng(m, c))
+                    logits = out[logits_id]
+                    if cdt is not None:
+                        logits = logits.astype(jnp.float32)
+                    loss = loss_fn(logits, ym)
+                    return loss + aux, (loss, aux, logits)
+
+                _, vjp, (loss, aux, logits) = jax.vjp(f, params_c,
+                                                      acts_in,
+                                                      has_aux=True)
+                dparams, dacts = vjp(cot)
+                g = _pack(jax.tree_util.tree_flatten(dparams)[0],
+                          self._param_segs[self.chunk_stage(c)][0],
+                          self._Lp)
+                send_b = _pack(jax.tree_util.tree_flatten(dacts)[0],
+                               bsegs[c - 1][0], A)
+                losses = losses.at[m].set(loss)
+                auxes = auxes.at[m].set(jnp.asarray(aux, jnp.float32))
+                if logits_b is not None:
+                    logits_b = jax.lax.dynamic_update_index_in_dim(
+                        logits_b, logits.astype(logits_b.dtype), m, 0)
+                return (jnp.zeros((A,), jnp.float32), send_b, saved,
+                        gacc + g, losses, auxes, logits_b)
+
+            def tick(carry, t):
+                (fsl, bsl, saved, in_f, in_b, gacc, losses, auxes,
+                 logits_b) = carry
+                # integrate last tick's arrivals (scratch slot R absorbs
+                # no-arrival ticks)
+                fsl = jax.lax.dynamic_update_index_in_dim(
+                    fsl, in_f, wf[t, sidx], 0)
+                bsl = jax.lax.dynamic_update_index_in_dim(
+                    bsl, in_b, wb[t, sidx], 0)
+                opr = (mbs_t[t, sidx], rf[t, sidx], rb[t, sidx], fsl,
+                       bsl, saved, gacc, losses, auxes, logits_b)
+                send_f, send_b, saved, gacc, losses, auxes, logits_b = \
+                    jax.lax.switch(kinds[t, sidx],
+                                   [idle_fn, f_fn, b_fn, fb_fn], opr)
+                in_f2 = jax.lax.ppermute(send_f, "pipe", fwd_perm)
+                in_b2 = jax.lax.ppermute(send_b, "pipe", bwd_perm)
+                return (fsl, bsl, saved, in_f2, in_b2, gacc, losses,
+                        auxes, logits_b), None
+
+            zeros_a = jnp.zeros((A,), jnp.float32)
+            carry0 = (
+                jnp.zeros((R_f + 1, A), jnp.float32),
+                jnp.zeros((R_b + 1, A), jnp.float32),
+                jnp.zeros((K, A), jnp.float32),
+                zeros_a, zeros_a,
+                jnp.zeros((self._Lp,), jnp.float32),
+                jnp.zeros((M,), jnp.float32),
+                jnp.zeros((M,), jnp.float32),
+                (jnp.zeros((M,) + logits_sds[0], logits_sds[1])
+                 if logits_sds is not None else None),
+            )
+            carry, _ = jax.lax.scan(tick, carry0, jnp.arange(T))
+            (_fsl, _bsl, _saved, _inf, _inb, gacc, losses, auxes,
+             logits_b) = carry
+
+            # ---- per-stage optimizer update, inside the same program
+            def upd(s):
+                def run(_):
+                    psegs, ptd, _n = self._param_segs[s]
+                    osegs, otd, _on = self._opt_segs[s]
+                    p = _unpack(th, psegs, ptd)
+                    g = _unpack(gacc, psegs, ptd)
+                    st = _unpack(op_buf, osegs, otd)
+                    new_p, new_st = self.optimizer.update(
+                        p, g, st, self.stage_wd[s], hyper)
+                    return (_pack(jax.tree_util.tree_flatten(new_p)[0],
+                                  psegs, self._Lp),
+                            _pack(jax.tree_util.tree_flatten(new_st)[0],
+                                  osegs, self._Lo))
+                return run
+
+            new_th, new_opt = jax.lax.switch(
+                sidx, [upd(s) for s in range(S)], 0)
+            outs = (new_th[None], new_opt[None], losses[None],
+                    auxes[None])
+            if logits_b is not None:
+                outs = outs + (logits_b[None],)
+            return outs
+
+        P = PartitionSpec
+        rep = P()
+        in_specs = (P("pipe", None), P("pipe", None), rep, rep, rep) \
+            + tuple(rep for _ in xs_shapes)
+        out_specs = (P("pipe", None), P("pipe", None), P("pipe", None),
+                     P("pipe", None))
+        if with_metrics:
+            out_specs = out_specs + (P("pipe"),)
+        fn = shard_map(shard_body, self._pmesh, in_specs=in_specs,
+                       out_specs=out_specs, check_rep=False)
+        return jax.jit(fn, donate_argnums=(0, 1))
+
+    # --------------------------------------------------------- training
+    def train_step(self, rng, xs: Sequence[jax.Array], y: jax.Array,
+                   sync: bool = True):
+        M = self.cfg.num_microbatches
+        S = len(self.stages)
+        C = len(self.chunks)
+        assert xs[0].shape[0] % M == 0, (
+            f"batch {xs[0].shape[0]} not divisible by microbatches {M}")
+        mb = xs[0].shape[0] // M
+        self._ensure_packed()
+        self.step_dispatches = 0
+        self.step_transfers = self.schedule.transfer_edges()
+        rep = NamedSharding(self._pmesh, PartitionSpec())
+
+        def stack(a):
+            a = jnp.asarray(a)
+            return jax.device_put(
+                jnp.reshape(a, (M, a.shape[0] // M) + a.shape[1:]), rep)
+
+        xs_st = [stack(x) for x in xs]
+        y_st = stack(y)
+        self.step_dispatches += len(xs_st) + 1  # input placements
+        with_metrics = self.metrics_fn is not None
+        key = (tuple((tuple(x.shape), str(x.dtype)) for x in xs_st),
+               (tuple(y_st.shape), str(y_st.dtype)), with_metrics)
+        if key not in self._programs:
+            self._programs[key] = self._build_program(
+                mb, [x.shape for x in xs_st], y_st.shape, y_st.dtype,
+                with_metrics)
+        hyper = {k: jnp.asarray(v, jnp.float32)
+                 for k, v in self.optimizer.hyperparams().items()}
+        rng = jax.device_put(rng, rep)
+        out = self._programs[key](self._packed[0], self._packed[1], rng,
+                                  hyper, y_st, *xs_st)
+        self.step_dispatches += 1  # the ONE schedule program
+        theta, opt, losses_all, auxes_all = out[:4]
+        self._packed = [theta, opt]
+        self._views_stale = True
+        losses = [losses_all[S - 1, m] for m in range(M)]
+        # (microbatch-major, chunk-ascending) — the host engines' (and
+        # the historical) loss-combine order, bit for bit
+        aux_flat = [auxes_all[c, m] for m in range(M) for c in range(C)]
+        if not sync:
+            return losses, aux_flat
+        loss = float(
+            sum(jax.device_get(l) for l in losses)
+            + sum(jax.device_get(a) for a in aux_flat)
+        ) / M
+        bm = {}
+        if with_metrics:
+            logits_all = out[4]
+            logits = jnp.concatenate(
+                [jax.device_get(logits_all[S - 1, m]) for m in range(M)],
+                axis=0)
+            bm = self.metrics_fn(logits, jax.device_get(jnp.asarray(y)))
+        return loss, bm
+
+    # the host engine's forward_only / sync_to / all_params read the
+    # dict views; the property getters refresh them from the packed
+    # buffers first, so nothing else to override here.

@@ -26,6 +26,7 @@ from __future__ import annotations
 from typing import Optional
 
 import jax
+from ..utils.compat import pcast, shard_map
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -121,7 +122,7 @@ def ring_attention(
         l0 = jnp.zeros((B, H, Sq), ql.dtype)
         o0 = jnp.zeros((B, H, Sq, D), ql.dtype)
         # mark accumulators as device-varying for shard_map's VMA typing
-        m0, l0, o0 = (jax.lax.pcast(a, (axis,), to="varying") for a in (m0, l0, o0))
+        m0, l0, o0 = (pcast(a, (axis,), to="varying") for a in (m0, l0, o0))
         perm = [(i, (i + 1) % n) for i in range(n)]
 
         def accumulate(s, kb, vb, m, l, o):
@@ -156,7 +157,7 @@ def ring_attention(
         out = o / l[..., None]
         return jnp.einsum("bhqd->bqhd", out)
 
-    fn = jax.shard_map(
+    fn = shard_map(
         body,
         mesh=mesh,
         in_specs=(P(None, axis, None, None),) * 3,
@@ -220,7 +221,7 @@ def ulysses_attention(
         return jax.lax.all_to_all(o, axis, split_axis=1, concat_axis=2,
                                   tiled=True)
 
-    fn = jax.shard_map(
+    fn = shard_map(
         body,
         mesh=mesh,
         in_specs=(P(None, axis, None, None),) * 3,

@@ -837,7 +837,13 @@ class FFModel:
 
                 pipeline = PipelineConfig(
                     num_stages=pipe_deg,
-                    num_microbatches=pipe_microbatches(self.config.batch_size))
+                    num_microbatches=pipe_microbatches(self.config.batch_size),
+                    schedule=self.config.pipeline_schedule,
+                    interleave=(
+                        max(2, int(self.config.pipeline_interleave))
+                        if self.config.pipeline_schedule == "interleaved"
+                        else 1),
+                    remat=self.config.pipeline_remat)
             elif (pipe_deg > 1 and self.pcg_report is not None
                   and "PCG011" not in self.pcg_report.codes()):
                 # the gate ran pre-fusion (strategy names live there);
@@ -867,13 +873,14 @@ class FFModel:
         )
         self.pipelined = None
         if pipeline is not None:
-            from ..parallel.pipeline import PipelinedModel
+            from ..parallel.pipeline import make_pipelined_model
             from .loss import compute_loss
             from .metrics import compute_batch_metrics
 
             cm = self.compiled
+            pipeline = self._resolve_pipeline(pipeline, cm)
             lt, fl = cm.loss_type, cm.from_logits
-            self.pipelined = PipelinedModel(
+            self.pipelined = make_pipelined_model(
                 cm.ops, cm.mesh, pipeline, self.optimizer,
                 loss_fn=lambda lg, y: compute_loss(lt, lg, y, fl),
                 metrics_fn=(lambda lg, y: compute_batch_metrics(
@@ -903,6 +910,78 @@ class FFModel:
         # decision plus the contention probe — tests assert on this so a
         # silent-skip regression (the except-all guard) fails loudly
         self._playoff_record = None
+
+    def _resolve_pipeline(self, pipeline, cm):
+        """Finalize a PipelineConfig against the compiled model:
+
+        * ``config.grad_accum_steps`` folds into the microbatch count
+          (pipelined microbatching IS gradient accumulation — K extra
+          accumulation steps == K x the microbatches, same averaging,
+          same activation budget);
+        * ``schedule="auto"`` resolves through the simulator's schedule
+          cost model — the search's choice when a search ran on this
+          pipe mesh, else an analytical ranking over the compiled ops
+          (sim/simulator.py rank_pipeline_schedules). The per-candidate
+          pricing records land in ``self._pipe_schedule_records``.
+        """
+        import dataclasses as _dc
+
+        cfg = self.config
+        accum = max(1, int(getattr(cfg, "grad_accum_steps", 1)))
+        if accum > 1 and not pipeline.accum_folded:
+            pipeline = _dc.replace(
+                pipeline,
+                num_microbatches=pipeline.num_microbatches * accum,
+                accum_folded=True)
+        self._pipe_schedule_records = []
+        if pipeline.schedule != "auto":
+            return pipeline
+        sr = self.search_result
+        if (sr is not None and getattr(sr, "pipe_schedule", None)
+                and sr.mesh_shape.get("pipe") == pipeline.num_stages):
+            self._pipe_schedule_records = list(
+                getattr(sr, "pipe_schedule_records", []))
+            return _dc.replace(pipeline, schedule=sr.pipe_schedule,
+                               interleave=sr.pipe_interleave)
+        from ..core.machine import mesh_axis_sizes as _mas
+        from ..search.unity import _stage_cut_bytes
+        from ..sim import (OpCostModel, detect_machine_model,
+                           load_machine_model)
+        from ..sim.simulator import (pipeline_schedule_candidates,
+                                     rank_pipeline_schedules,
+                                     single_device_stages)
+
+        machine = (load_machine_model(cfg.machine_model_file)
+                   if cfg.machine_model_file
+                   else detect_machine_model(cm.mesh.devices.size))
+        cost = OpCostModel(machine)
+        t_sub = sum(cost.measure(op).total_time for op in cm.ops)
+        sizes = _mas(cm.mesh)
+        n_ops = len(cm.ops)
+        layers = [op.layer for op in cm.ops]
+        cands = pipeline_schedule_candidates(
+            "auto", getattr(cfg, "pipeline_interleave", 2),
+            pipeline.num_stages, n_ops)
+
+        def cut_fn(nc: int) -> float:
+            return (float("inf") if nc > n_ops
+                    else _stage_cut_bytes(layers, nc))
+
+        kind, v, recs = rank_pipeline_schedules(
+            cands, pipeline.num_stages, pipeline.num_microbatches,
+            t_sub, machine, cut_bytes_fn=cut_fn,
+            data_degree=sizes.get("data", 1),
+            compiled_ok=single_device_stages(sizes, pipeline.axis),
+            bwd_ratio=OpCostModel.BWD_FACTOR)
+        self._pipe_schedule_records = recs
+        if cfg.profiling:
+            ranking = ", ".join(
+                "%s=%.3fms" % (r["schedule"], r["est_step_time"] * 1e3)
+                for r in recs)
+            print(f"[pipeline] auto schedule -> {kind}"
+                  + (f" x{v}" if v > 1 else "") + f" ({ranking})",
+                  flush=True)
+        return _dc.replace(pipeline, schedule=kind, interleave=v)
 
     def _index_params(self) -> None:
         """Parameter index for get/set weights (recompile-safe: drop stale
@@ -1058,7 +1137,8 @@ class FFModel:
                 if pipe > 1:
                     result = _pipe_adjusted(result, self.layers, pipe,
                                             machine, cfg.batch_size,
-                                            fused=cfg.perform_fusion)
+                                            fused=cfg.perform_fusion,
+                                            config=cfg)
             else:
                 # structural variants compete on the pinned mesh too —
                 # each evaluated by the SAME candidate body full_search
@@ -1134,7 +1214,8 @@ class FFModel:
                         elif pipe > 1:
                             dp_r = _pipe_adjusted(dp_r, self.layers, pipe,
                                                   machine, cfg.batch_size,
-                                                  fused=cfg.perform_fusion)
+                                                  fused=cfg.perform_fusion,
+                                                  config=cfg)
                     except RuntimeError:
                         dp_r = None
                     if (dp_r is not None and result.est_step_time
@@ -1692,6 +1773,16 @@ class FFModel:
         self.fit_profile = self._step_loop_profile(
             epoch_records, depth, max_inflight, k)
         if self.pipelined is not None:
+            # per-stage schedule timeline + bubble fraction + measured
+            # dispatch counts (runtime/profiling.pipeline_report)
+            self.fit_profile["pipeline"] = self.pipelined.profile(
+                bs // self.pipelined.cfg.num_microbatches)
+            if self.config.profiling:
+                p = self.fit_profile["pipeline"]
+                print(f"[fit] pipeline {p['engine']}:{p['schedule']} "
+                      f"bubble {p['bubble_fraction']:.3f} "
+                      f"dispatches/step {p['dispatches_per_step']}",
+                      flush=True)
             # keep the CompiledModel view current so checkpoint/eval/
             # get_weights after a pipelined fit see trained weights
             self.pipelined.sync_to(cm)

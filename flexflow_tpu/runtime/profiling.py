@@ -189,8 +189,24 @@ def fit_report(ffmodel) -> Optional[Dict]:
     "prefetch_depth", "max_inflight_steps", "steps_per_dispatch"}``. Each
     epoch record carries ``steps``, ``wall_s``, ``steps_per_s``,
     ``input_wait_s`` (host time on the critical path), ``input_mb_per_s``,
-    ``queue_depth_hist`` and ``dispatch_ahead_occupancy``."""
+    ``queue_depth_hist`` and ``dispatch_ahead_occupancy``. Pipelined
+    fits add a ``"pipeline"`` record (see :func:`pipeline_report`)."""
     return getattr(ffmodel, "fit_profile", None)
+
+
+def pipeline_report(ffmodel) -> Optional[Dict]:
+    """The pipeline engine's record from the last fit (or directly from
+    the live engine when no fit ran yet): schedule name, per-stage tick
+    timeline (``s0 |F0|F1|B0|..|``), analytic bubble fraction, per-stage
+    peak live microbatches, schedule-implied peak activation bytes, the
+    engine in use (``host`` one-dispatch-per-action vs ``compiled``
+    single-dispatch), and measured dispatch/transfer counts from the most
+    recent step. None when the model is not pipelined."""
+    fp = getattr(ffmodel, "fit_profile", None) or {}
+    if "pipeline" in fp:
+        return fp["pipeline"]
+    pm = getattr(ffmodel, "pipelined", None)
+    return pm.profile() if pm is not None else None
 
 
 # -------------------------------------------------------- search observability

@@ -65,7 +65,11 @@ CACHE_VERSION = 2
 # CONTENT. Rehydration validates this before touching any field, so a
 # layout change (or a hand-edited entry) fails with a clear
 # schema-mismatch message instead of a downstream AttributeError.
-PAYLOAD_SCHEMA = 2
+# v3: pipe-prefixed plans carry the schedule the bubble model selected
+# (pipe_schedule/pipe_interleave) — a pre-schedule-knob entry would
+# otherwise rehydrate with an UNDEFINED schedule, so it demotes to a
+# clean, attributed CacheSchemaWarning miss instead.
+PAYLOAD_SCHEMA = 3
 
 # required payload fields and their validators: rehydration checks every
 # one of these BEFORE constructing a GraphSearchResult
@@ -83,6 +87,13 @@ _PAYLOAD_FIELDS = {
     "est_memory": lambda v: isinstance(v, (int, float)),
     "rewrites": lambda v: (isinstance(v, list)
                            and all(isinstance(r, str) for r in v)),
+    # the pipeline schedule dimension (None on un-piped plans)
+    "pipe_schedule": lambda v: v is None or (
+        isinstance(v, str)
+        and v in ("gpipe", "1f1b", "interleaved")),
+    "pipe_interleave": lambda v: (isinstance(v, int)
+                                  and not isinstance(v, bool)
+                                  and v >= 1),
 }
 
 
@@ -118,6 +129,10 @@ _SEARCH_KNOBS = (
     "base_optimize_threshold",
     "zero_optimizer",
     "compute_dtype",
+    # the schedule knob is a selection dimension: _pipe_adjusted ranks
+    # schedules (or pins the requested one) per candidate mesh
+    "pipeline_schedule",
+    "pipeline_interleave",
 )
 
 
@@ -266,6 +281,8 @@ def result_to_payload(result: GraphSearchResult,
         "rewrites": list(result.rewrites),
         "candidates": result.candidates,
         "pruned": result.pruned,
+        "pipe_schedule": result.pipe_schedule,
+        "pipe_interleave": result.pipe_interleave,
     }
     if names_src is not None:
         payload["layer_names"] = [l.name for l in names_src]
@@ -397,6 +414,8 @@ def result_from_payload(payload: Dict, layers, config=None,
             layers=vlayers if rewrites else None,
             candidates=int(payload.get("candidates", 0)),
             pruned=int(payload.get("pruned", 0)),
+            pipe_schedule=payload.get("pipe_schedule"),
+            pipe_interleave=int(payload.get("pipe_interleave", 1)),
         )
     except (KeyError, TypeError, ValueError):
         return None

@@ -68,6 +68,15 @@ class GraphSearchResult:
     candidates: int = 0
     pruned: int = 0
     workers: int = 0
+    # pipeline schedule the bubble model selected for a pipe-prefixed
+    # mesh (None on un-piped results): compile() builds exactly this
+    # schedule, and the strategy cache persists it so a rehydrated plan
+    # never runs with an undefined schedule
+    pipe_schedule: Optional[str] = None
+    pipe_interleave: int = 1
+    # per-candidate pricing records from the schedule ranking (not
+    # persisted; profiling/debug surface)
+    pipe_schedule_records: List = dataclasses.field(default_factory=list)
 
 
 def _ps_sig(ps: ParallelTensorShape) -> Tuple:
@@ -444,7 +453,7 @@ def _evaluate_candidate(
     if pipe > 1:
         r = _pipe_adjusted(r, vlayers, pipe, machine,
                            config.batch_size if config else None,
-                           fused=fusion)
+                           fused=fusion, config=config)
     return r
 
 
@@ -907,51 +916,78 @@ def pipe_microbatches(batch_size: Optional[int]) -> int:
 def _pipe_adjusted(
     r: GraphSearchResult, layers: List[Layer], pipe: int,
     machine: MachineModel, batch_size: Optional[int] = None,
-    fused: bool = False,
+    fused: bool = False, config: Optional[FFConfig] = None,
 ) -> GraphSearchResult:
-    """GPipe bubble cost model for a pipe-prefixed mesh.
+    """Pipeline schedule cost model for a pipe-prefixed mesh.
 
     The inner DP estimated one step of the WHOLE model on the per-stage
     submesh (the non-pipe axes). Pipelining splits that work over ``pipe``
-    stages fed with M microbatches: steady-state step time is
-    ``T * (M + P - 1) / (M * P)`` (the classic GPipe bubble), plus the
-    stage-boundary activation traffic over ICI. Per-device memory drops to
-    ~1/P of the whole-model footprint (each stage holds only its layers).
-    No reference equivalent — PP is reserved but unimplemented upstream
-    (model.h:190-192).
+    stages fed with M microbatches under a SCHEDULE
+    (``config.pipeline_schedule``): each candidate schedule's tick table
+    is priced by :func:`~..sim.simulator.pipeline_schedule_cost` (bubble
+    + boundary ICI traffic + per-dispatch overhead, engine-aware — the
+    single-dispatch compiled engine pays ONE dispatch where the
+    host-driven engine pays O(stages × microbatches)), and ``"auto"``
+    keeps the cheapest (ties resolve to the smaller activation
+    footprint, i.e. 1F1B over GPipe). The chosen schedule rides on the
+    result (``pipe_schedule``/``pipe_interleave``) so compile() — and
+    the strategy cache — execute exactly what was priced. Per-device
+    memory drops to ~1/P of the whole-model footprint (each stage holds
+    only its layers). No reference equivalent — PP is reserved but
+    unimplemented upstream (model.h:190-192).
     """
+    from ..sim.simulator import (pipeline_schedule_candidates,
+                                 rank_pipeline_schedules,
+                                 single_device_stages)
+
     M = pipe_microbatches(batch_size)
-    # a shared-host virtual mesh runs all "stages" on one socket: no
-    # pipeline speedup exists there (same honesty as
-    # machine_model.effective_parallelism for sharding)
-    if machine.effective_parallelism(pipe) > 1.0:
-        bubble = (M + pipe - 1) / (M * pipe)
-    else:
-        bubble = 1.0
+    data_degree = max(1, r.mesh_shape.get("data", 1))
     # boundary traffic from the ACTUAL stage-cut tensors: run the same
     # FLOP-balanced contiguous splitter compile()'s pipeline uses
     # (parallel/pipeline.py split_stages), then charge every tensor that
-    # crosses a stage boundary — forward activation + backward cotangent
+    # crosses a chunk boundary — forward activation + backward cotangent
     # per step. Boundary tensors stay batch-sharded over the inner data
     # axis, so each device moves only its shard.
-    cut_bytes = _stage_cut_bytes(layers, pipe, fused=fused)
-    cut_bytes /= max(1, r.mesh_shape.get("data", 1))
-    bw = machine.chip.ici_link_bandwidth
-    comm = 2.0 * cut_bytes / bw
-    # the GPipe engine is host-driven: every stage×microbatch×direction is
-    # its own program dispatch (parallel/pipeline.py train_step), so the
-    # per-dispatch overhead the chip pays once per fused step is paid
-    # 2·M·P times here — a real cost on tunneled chips and shared hosts
-    comm += 2.0 * M * pipe * machine.chip.step_overhead
+    n_ops = len(layers)
+
+    def cut_fn(chunk_count: int) -> float:
+        if chunk_count > n_ops:
+            return float("inf")  # unsplittable at this granularity
+        return _stage_cut_bytes(layers, chunk_count, fused=fused)
+
+    cands = pipeline_schedule_candidates(
+        getattr(config, "pipeline_schedule", "auto") or "auto",
+        getattr(config, "pipeline_interleave", 2), pipe, n_ops)
+    # the single-dispatch engine needs one device per stage: every
+    # non-pipe axis of the winning mesh must be trivial
+    compiled_ok = single_device_stages(r.mesh_shape)
+    best_kind, best_v, records = rank_pipeline_schedules(
+        cands, pipe, M, r.est_step_time, machine, cut_bytes_fn=cut_fn,
+        data_degree=data_degree, compiled_ok=compiled_ok,
+        bwd_ratio=OpCostModel.BWD_FACTOR)
+    if records:
+        rec = next(x for x in records if x["schedule"] == best_kind
+                   and x["interleave"] == best_v)
+        est = rec["est_step_time"]
+    else:  # no candidate legal (e.g. M too small) — fall back to gpipe
+        best_kind, best_v = "gpipe", 1
+        bubble = ((M + pipe - 1) / (M * pipe)
+                  if machine.effective_parallelism(pipe) > 1.0 else 1.0)
+        est = (r.est_step_time * bubble
+               + 2.0 * cut_fn(pipe) / max(1, data_degree)
+               / machine.chip.ici_link_bandwidth
+               + 2.0 * M * pipe * machine.chip.step_overhead)
     res = GraphSearchResult(
         r.strategies,
         {"pipe": pipe, **r.mesh_shape},
-        r.est_step_time * bubble + comm,
+        est,
         int(r.est_memory / pipe),
         r.states_explored,
         r.mem_lambda,
     )
     res.rewrites, res.layers = r.rewrites, r.layers
+    res.pipe_schedule, res.pipe_interleave = best_kind, best_v
+    res.pipe_schedule_records = records
     return res
 
 

@@ -128,7 +128,10 @@ MEASURE_CALLS = 0
 # sim/simulator.py changes (BWD_FACTORS, roofline terms, collective
 # costs, ...) so cached plans selected under the old model re-search
 # instead of being served forever.
-COST_MODEL_VERSION = 1
+# v2: pipe-prefixed plans priced by the schedule-aware model
+# (sim/simulator.py pipeline_schedule_cost: per-schedule tick replay +
+# engine-aware dispatch overhead) instead of the fixed GPipe bubble.
+COST_MODEL_VERSION = 2
 
 
 class OpCostModel:

@@ -369,6 +369,17 @@ class Simulator:
                     heapq.heappush(heap, (ready[s], s))
         return total + self.machine.chip.step_overhead
 
+    def pipeline_schedule_cost(self, sched, submesh_step_time: float,
+                               cut_bytes: float = 0.0,
+                               data_degree: int = 1,
+                               engine: str = "host",
+                               bwd_ratio: float = 2.0) -> Dict:
+        """Price one pipeline schedule from its tick table (see
+        :func:`pipeline_schedule_cost`)."""
+        return pipeline_schedule_cost(
+            sched, submesh_step_time, self.machine, cut_bytes=cut_bytes,
+            data_degree=data_degree, engine=engine, bwd_ratio=bwd_ratio)
+
     def memory_usage(self, ops: List[Op]) -> MemoryUsage:
         mu = MemoryUsage()
         for op in ops:
@@ -380,6 +391,133 @@ class Simulator:
 
     def fits_memory(self, ops: List[Op]) -> bool:
         return self.memory_usage(ops).total <= self.machine.chip.hbm_capacity
+
+
+# ------------------------------------------------- pipeline schedule model
+def pipeline_schedule_cost(sched, submesh_step_time: float,
+                           machine: MachineModel, cut_bytes: float = 0.0,
+                           data_degree: int = 1, engine: str = "host",
+                           bwd_ratio: float = 2.0) -> Dict:
+    """Analytical step-time/bubble/activation model for ONE pipeline
+    schedule, priced from its tick table (parallel/schedule.py) — the
+    cost model the ``pipeline_schedule="auto"`` knob ranks with, in the
+    spirit of "A Learned Performance Model for TPUs" (PAPERS.md):
+    predict, rank, then let the bench verify.
+
+    * ``submesh_step_time``: one whole-model step on the per-stage
+      submesh (the inner DP's estimate) — the work the schedule splits
+      over stages and microbatches. Per-action costs are uniform
+      (chunk = 1/(S·V) of the model, microbatch = 1/M of the batch), so
+      the tick-synchronous replay reduces to the classic bubble for
+      gpipe/1f1b: ``T·(M+S-1)/(M·S)``.
+    * ``cut_bytes``: stage-boundary bytes per traversal direction (the
+      search's ``_stage_cut_bytes`` over the schedule's chunk count);
+      charged twice (activation + cotangent) over the ICI link shared by
+      ``data_degree`` per-shard streams.
+    * ``engine``: the host engine pays per-action dispatch overhead
+      (O(S·M) dispatches); the single-dispatch compiled engine pays ONE.
+
+    Returns a JSON-able record with ``est_step_time`` plus the memory
+    side of the trade-off (``peak_live_microbatches``), which breaks
+    est-time ties in favor of the smaller activation footprint —
+    that is how ``auto`` prefers 1F1B over GPipe at equal bubble.
+    """
+    S, M, V = sched.num_stages, sched.num_microbatches, sched.interleave
+    tfb = submesh_step_time / (S * V * M)  # one chunk, one microbatch
+    t_f = tfb / (1.0 + bwd_ratio)
+    t_b = tfb - t_f
+    if machine.effective_parallelism(S) > 1.0:
+        compute = sched.step_ticks_cost(t_f, t_b)
+    else:
+        # shared-host virtual mesh: every "stage" time-slices one
+        # socket — no pipeline speedup exists (same honesty as
+        # machine_model.effective_parallelism for sharding)
+        compute = submesh_step_time
+    comm = 2.0 * (cut_bytes / max(1, data_degree)) \
+        / machine.chip.ici_link_bandwidth
+    dispatches = 1 if engine == "compiled" else sched.host_dispatches()
+    overhead = machine.chip.step_overhead * dispatches
+    return {
+        "schedule": sched.kind,
+        "interleave": V,
+        "engine": engine,
+        "est_step_time": compute + comm + overhead,
+        "compute_time": compute,
+        "comm_time": comm,
+        "dispatch_overhead": overhead,
+        "dispatches": dispatches,
+        "bubble_fraction": round(sched.bubble_fraction(bwd_ratio), 4),
+        "peak_live_microbatches": sched.peak_live_total(),
+    }
+
+
+def pipeline_schedule_candidates(requested: str, interleave: int,
+                                 num_stages: int, n_ops: int
+                                 ) -> List[Tuple[str, int]]:
+    """The (schedule, interleave) candidate set for one ranking — the
+    SINGLE construction shared by search-time pricing
+    (unity._pipe_adjusted) and per-compile resolution
+    (FFModel._resolve_pipeline), so the two can never drift. A pinned
+    schedule yields itself; ``auto`` yields gpipe/1f1b plus interleaved
+    when the graph has enough ops for the chunk count."""
+    ilv = max(2, int(interleave))
+    if requested == "auto":
+        cands = [("gpipe", 1), ("1f1b", 1)]
+        if n_ops >= 2 * num_stages * ilv:
+            cands.append(("interleaved", ilv))
+        return cands
+    if requested == "interleaved":
+        return [("interleaved", ilv)]
+    return [(requested, 1)]
+
+
+def single_device_stages(axis_sizes: Dict[str, int],
+                         pipe_axis: str = "pipe") -> bool:
+    """The compiled single-dispatch engine's mesh envelope: every
+    non-pipe axis trivial (one device per stage)."""
+    return all(s == 1 for a, s in axis_sizes.items() if a != pipe_axis)
+
+
+def rank_pipeline_schedules(
+    candidates: List[Tuple[str, int]],
+    num_stages: int,
+    num_microbatches: int,
+    submesh_step_time: float,
+    machine: MachineModel,
+    cut_bytes_fn=None,
+    data_degree: int = 1,
+    compiled_ok: bool = False,
+    bwd_ratio: float = 2.0,
+) -> Tuple[str, int, List[Dict]]:
+    """Rank (schedule, interleave) candidates by the analytical model.
+
+    ``cut_bytes_fn(chunk_count) -> bytes`` supplies boundary traffic per
+    chunk granularity (interleaved pays ~V× more cuts); ``compiled_ok``
+    says whether the single-dispatch engine's envelope holds on the
+    target mesh (it halves the dispatch-overhead story). Ties on
+    est_step_time resolve toward the smaller activation footprint, then
+    lexicographic schedule name — fully deterministic. Returns
+    (best_schedule, best_interleave, all_records)."""
+    from ..parallel.schedule import ScheduleError, build_schedule
+
+    records: List[Dict] = []
+    for kind, V in candidates:
+        try:
+            sched = build_schedule(kind, num_stages, num_microbatches, V)
+        except ScheduleError:
+            continue
+        engine = ("compiled" if compiled_ok and V == 1
+                  and kind in ("gpipe", "1f1b") else "host")
+        cut = cut_bytes_fn(num_stages * V) if cut_bytes_fn else 0.0
+        records.append(pipeline_schedule_cost(
+            sched, submesh_step_time, machine, cut_bytes=cut,
+            data_degree=data_degree, engine=engine, bwd_ratio=bwd_ratio))
+    if not records:
+        return "gpipe", 1, []
+    best = min(records, key=lambda r: (r["est_step_time"],
+                                       r["peak_live_microbatches"],
+                                       r["schedule"]))
+    return best["schedule"], best["interleave"], records
 
 
 def _axis_degree(op: Op, axis: Optional[str]) -> int:

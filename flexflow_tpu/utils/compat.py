@@ -1,0 +1,36 @@
+"""JAX version compatibility shims.
+
+The kernels and manual-collective code target the public ``jax.shard_map``
+API (jax >= 0.5, where replication checking is spelled ``check_vma``).
+Older runtimes only ship ``jax.experimental.shard_map.shard_map`` with the
+``check_rep`` spelling — this module exposes ONE ``shard_map`` that every
+call site imports, so the package runs unmodified on both.
+"""
+
+from __future__ import annotations
+
+import jax
+
+try:
+    pcast = jax.lax.pcast  # jax >= 0.7: varying-type cast
+except AttributeError:
+    def pcast(x, axis_name, to=None):
+        """Identity fallback: ``pcast`` only changes the value's
+        varying-type annotation for the new replication checker; under
+        the experimental API's ``check_rep=False`` there is no such
+        type system and the value itself is unchanged."""
+        return x
+
+try:
+    shard_map = jax.shard_map  # jax >= 0.5: public API
+except AttributeError:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, /, *, mesh, in_specs, out_specs, check_vma=None,
+                  **kw):
+        """``jax.shard_map`` signature adapter over the experimental
+        API: ``check_vma`` (new spelling) maps onto ``check_rep``."""
+        if check_vma is not None:
+            kw.setdefault("check_rep", check_vma)
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, **kw)

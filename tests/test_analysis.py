@@ -407,7 +407,8 @@ def test_truncated_cache_payload_is_clean_miss(tmp_path):
     """A truncated/schema-broken entry demotes to a miss with a
     CacheSchemaWarning — never an AttributeError, never a compile
     failure."""
-    from flexflow_tpu.search.cache import CacheSchemaWarning, load_payload
+    from flexflow_tpu.search.cache import (CACHE_VERSION, CacheSchemaWarning,
+                                           PAYLOAD_SCHEMA, load_payload)
 
     ff = _cached_mlp_model(tmp_path)
     ff.compile(optimizer=SGDOptimizer(lr=0.01),
@@ -423,15 +424,15 @@ def test_truncated_cache_payload_is_clean_miss(tmp_path):
         assert load_payload(str(tmp_path), key) is None
     # valid JSON, missing required payload fields
     with open(p, "w") as f:
-        json.dump({"version": 2, "schema": 2, "key": key,
-                   "result": {"strategies": {}}}, f)
+        json.dump({"version": CACHE_VERSION, "schema": PAYLOAD_SCHEMA,
+                   "key": key, "result": {"strategies": {}}}, f)
     with pytest.warns(CacheSchemaWarning, match="missing required field"):
         assert load_payload(str(tmp_path), key) is None
-    # wrong payload schema version
-    doc = json.loads(blob + blob[len(blob) // 2:]) if False else None
+    # wrong payload schema version (e.g. a pre-schedule-knob entry, which
+    # would otherwise rehydrate with an UNDEFINED pipeline schedule)
     with open(p, "w") as f:
-        json.dump({"version": 2, "schema": 1, "key": key,
-                   "result": {}}, f)
+        json.dump({"version": CACHE_VERSION, "schema": PAYLOAD_SCHEMA - 1,
+                   "key": key, "result": {}}, f)
     with pytest.warns(CacheSchemaWarning, match="payload schema"):
         assert load_payload(str(tmp_path), key) is None
     # end to end: the broken entry never fails the compile

@@ -1,0 +1,162 @@
+"""Pipeline schedule/engine equivalence sweep over the model zoo.
+
+The acceptance contract of the schedule-compiled pipeline engines: for
+every zoo model, every schedule and engine produces the SAME per-step
+losses and trained parameters as the historical sync GPipe path — the
+schedule reorders work, never math (fixed per-stage microbatch gradient
+accumulation order), and the single-dispatch compiled engine issues O(1)
+dispatches while doing it.
+
+The sweep runs on a pipe-only 2-device mesh so the compiled engine's
+envelope holds and every variant executes numerically identical
+single-device stage programs; the composite-mesh (pipe x data) cases are
+covered by tests/test_pipeline.py.
+
+Budget: the tier-1 gate runs the two models that exercise every distinct
+boundary-packing code path (mlp: plain float chain; moe: integer routing
+tensors crossing the stage cut, float0 cotangents, aux load-balance
+losses on both stages); the rest of the zoo is marked slow (excluded
+from tier-1's `-m 'not slow'`, still in a full `pytest tests/ -m slow`
+run). The big-image CNNs (resnet50/resnext50/inception_v3) are covered
+by the static-analysis zoo sweep and by alexnet here — their pipeline
+compile adds CPU-minutes without a new code path.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer, make_mesh
+from flexflow_tpu.models import zoo_smoke_builders
+from flexflow_tpu.parallel.pipeline import PipelineConfig
+from flexflow_tpu.parallel.schedule import ScheduleError
+from flexflow_tpu.runtime.profiling import _min_vocab_bound, synth_array
+
+BS = 8
+STEPS = 2
+
+# (schedule, interleave, engine) variants checked against gpipe/host
+VARIANTS = [
+    ("1f1b", 1, "host"),
+    ("gpipe", 1, "compiled"),
+    ("1f1b", 1, "compiled"),
+    ("interleaved", 2, "host"),
+]
+
+_FAST = ("mlp", "moe")
+_SLOW = ("transformer", "dlrm", "xdl", "candle_uno", "gpt", "alexnet",
+         "nmt")
+
+
+def _params_np(pm):
+    return {k: {w: np.asarray(v) for w, v in ws.items()}
+            for k, ws in pm.all_params().items()}
+
+
+def _build_and_data(name: str):
+    """Build the zoo model on the pipe-only mesh and synthesize one
+    batch (inputs via the shared synthesizer; labels from the logits
+    shape: 2-D logits -> sparse CE, otherwise MSE)."""
+    builder = zoo_smoke_builders()[name]
+
+    def make(schedule, interleave, engine):
+        # auto-generated layer names embed a process-global counter and
+        # weight init keys off the NAME — pin the counter per build so
+        # every variant constructs identically-named (hence
+        # identically-initialized) layers
+        import itertools
+
+        from flexflow_tpu.core import layer as layer_mod
+
+        layer_mod._layer_ids = itertools.count(10**6)
+        ff = FFModel(FFConfig(batch_size=BS, seed=0))
+        builder(ff, BS)
+        mesh = make_mesh({"pipe": 2}, devices=jax.devices()[:2])
+        logits = ff._final_output()
+        loss = (LossType.SPARSE_CATEGORICAL_CROSSENTROPY
+                if len(logits.dims) == 2
+                else LossType.MEAN_SQUARED_ERROR_AVG_REDUCE)
+        ff.compile(optimizer=SGDOptimizer(lr=0.05), loss_type=loss,
+                   metrics=[], mesh=mesh,
+                   pipeline=PipelineConfig(
+                       num_stages=2, num_microbatches=4,
+                       schedule=schedule, interleave=interleave,
+                       engine=engine))
+        return ff, logits
+
+    ff, logits = make("gpipe", 1, "host")
+    rng = np.random.default_rng(0)
+    bound = _min_vocab_bound(ff.compiled.ops)
+    xs = [jnp.asarray(synth_array(t, rng, int_high=bound))
+          for t in ff.compiled.input_tensors]
+    if len(logits.dims) == 2:
+        y = rng.integers(0, logits.dims[-1], size=(BS, 1)).astype(np.int32)
+    else:
+        y = rng.normal(size=tuple(logits.dims)).astype(np.float32) * 0.1
+    return make, ff, xs, jnp.asarray(y)
+
+
+def _run(ff, xs, y):
+    losses = []
+    for i in range(STEPS):
+        loss, _ = ff.pipelined.train_step(jax.random.key(i), xs, y)
+        assert np.isfinite(loss), loss
+        losses.append(loss)
+    return losses, _params_np(ff.pipelined)
+
+
+def _sweep(name: str):
+    make, ref_ff, xs, y = _build_and_data(name)
+    ref_losses, ref_params = _run(ref_ff, xs, y)
+    assert ref_ff.pipelined.engine_name == "host"
+    # XLA's CPU convolutions reduce over multithreaded partial sums in
+    # nondeterministic order — identical alexnet runs differ ~1e-4 after
+    # two updates (measured run-to-run on the SAME schedule), so conv
+    # models compare at that noise floor; everything else stays tight
+    from flexflow_tpu.ffconst import OpType
+
+    has_conv = any(op.op_type is OpType.CONV2D
+                   for op in ref_ff.compiled.ops)
+    tol = (dict(rtol=2e-3, atol=2e-4) if has_conv
+           else dict(rtol=1e-6, atol=1e-7))
+    ptol = (dict(rtol=2e-2, atol=2e-3) if has_conv
+            else dict(rtol=1e-5, atol=1e-6))
+    for schedule, interleave, engine in VARIANTS:
+        try:
+            ff, _ = make(schedule, interleave, engine)
+        except (ScheduleError, ValueError) as e:
+            # a model too small for the interleaved chunk count is a
+            # legality outcome, not a failure of the equivalence claim
+            assert schedule == "interleaved", (schedule, e)
+            continue
+        if engine == "compiled":
+            assert ff.pipelined.engine_name == "compiled", (
+                f"{name}: compiled engine fell back "
+                f"({schedule}/{engine})")
+            losses, params = _run(ff, xs, y)
+            # O(1) dispatches: 1 program + input placements
+            assert ff.pipelined.step_dispatches <= 2 + len(xs)
+        else:
+            losses, params = _run(ff, xs, y)
+        np.testing.assert_allclose(
+            losses, ref_losses, **tol,
+            err_msg=f"{name} {schedule}/{engine} losses")
+        assert set(params) == set(ref_params)
+        for k in ref_params:
+            for w in ref_params[k]:
+                np.testing.assert_allclose(
+                    params[k][w], ref_params[k][w], **ptol,
+                    err_msg=f"{name} {schedule}/{engine} {k}/{w}")
+
+
+@pytest.mark.parametrize("name", _FAST)
+def test_zoo_schedule_equivalence(name):
+    _sweep(name)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", _SLOW)
+def test_zoo_schedule_equivalence_slow(name):
+    _sweep(name)

@@ -6,6 +6,8 @@ checks numerical equivalence against non-pipelined training.
 """
 
 import numpy as np
+import pytest
+
 import jax
 import jax.numpy as jnp
 
@@ -356,3 +358,225 @@ def test_moe_graph_pipelines():
         losses.append(float(loss))
     assert all(np.isfinite(l) for l in losses)
     assert losses[-1] < losses[0]  # actually learning, not reshuffled junk
+
+
+# ------------------------------------------------------------------- #
+# schedule/engine equivalence + satellite regressions (PR 4)          #
+# ------------------------------------------------------------------- #
+def _train_variant(schedule, engine="host", interleave=1, remat=False,
+                   mesh_shape=None, steps=3, momentum=0.9,
+                   num_microbatches=4):
+    """Train the 3-dense model for a few steps under one
+    (schedule, engine) variant; returns (losses, params)."""
+    bs = 16
+    x, y = _data(n=bs)
+    ff = FFModel(FFConfig(batch_size=bs, seed=0))
+    mesh = None
+    if mesh_shape is None:
+        mesh_shape = {"pipe": 2, "data": 4}
+    from flexflow_tpu import make_mesh
+
+    n = 1
+    for v in mesh_shape.values():
+        n *= v
+    mesh = make_mesh(mesh_shape, devices=jax.devices()[:n])
+    _build(ff, bs)
+    ff.compile(optimizer=SGDOptimizer(lr=0.1, momentum=momentum),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=[], mesh=mesh,
+               pipeline=PipelineConfig(
+                   num_stages=2, num_microbatches=num_microbatches,
+                   schedule=schedule, engine=engine,
+                   interleave=interleave, remat=remat))
+    losses = []
+    for i in range(steps):
+        loss, _ = ff.pipelined.train_step(
+            jax.random.key(i), [jnp.asarray(x)], jnp.asarray(y))
+        losses.append(loss)
+    params = {k: {w: np.asarray(v) for w, v in ws.items()}
+              for k, ws in ff.pipelined.all_params().items()}
+    return ff, losses, params
+
+
+def test_schedules_bit_identical_on_composite_mesh():
+    """1F1B / interleaved / remat reorder work, never math: on the
+    pipe x data mesh every schedule's per-step losses and trained params
+    equal the historical GPipe path bit for bit (same per-stage
+    microbatch accumulation order, same per-(mb, chunk) rng keys)."""
+    _, l_ref, p_ref = _train_variant("gpipe")
+    for kw in (dict(schedule="1f1b"),
+               dict(schedule="1f1b", remat=True),
+               dict(schedule="interleaved", interleave=2)):
+        _, l, p = _train_variant(**kw)
+        assert l == l_ref, (kw, l, l_ref)
+        for k in p_ref:
+            for w in p_ref[k]:
+                np.testing.assert_array_equal(
+                    p[k][w], p_ref[k][w], err_msg=f"{kw} {k}/{w}")
+
+
+def test_compiled_engine_bit_identical_and_single_dispatch():
+    """The single-dispatch engine: ONE jitted program per train step
+    (O(1) dispatches vs O(stages x microbatches)), numerically identical
+    to the host-driven sync GPipe path on the same pipe-only mesh."""
+    ff_ref, l_ref, p_ref = _train_variant(
+        "gpipe", engine="host", mesh_shape={"pipe": 2})
+    assert ff_ref.pipelined.engine_name == "host"
+    host_disp = ff_ref.pipelined.step_dispatches
+    for schedule in ("gpipe", "1f1b"):
+        ff, l, p = _train_variant(
+            schedule, engine="auto", mesh_shape={"pipe": 2})
+        pm = ff.pipelined
+        assert pm.engine_name == "compiled", schedule
+        assert pm.step_dispatches < host_disp
+        assert pm.step_dispatches <= 3  # 1 program + input placements
+        assert l == l_ref, (schedule, l, l_ref)
+        for k in p_ref:
+            for w in p_ref[k]:
+                np.testing.assert_array_equal(
+                    p[k][w], p_ref[k][w], err_msg=f"{schedule} {k}/{w}")
+    # forcing the compiled engine outside its envelope raises with the
+    # reason instead of silently running the wrong engine
+    with pytest.raises(ValueError, match="one device per stage"):
+        _train_variant("1f1b", engine="compiled",
+                       mesh_shape={"pipe": 2, "data": 4}, steps=0)
+
+
+def test_sync_roundtrip_params_and_opt_state():
+    """sync_to/sync_from round trip against the CompiledModel: params
+    AND optimizer state (incl. the zero_optimizer sharded layout)
+    survive engine -> cm -> fresh engine without drift."""
+    bs = 16
+    x, y = _data(n=bs)
+    from flexflow_tpu import make_mesh
+
+    def make(zero):
+        ff = FFModel(FFConfig(batch_size=bs, seed=0, zero_optimizer=zero))
+        _build(ff, bs)
+        ff.compile(optimizer=SGDOptimizer(lr=0.1, momentum=0.9),
+                   loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                   metrics=[], mesh=make_mesh({"pipe": 2, "data": 4}),
+                   pipeline=PipelineConfig(num_stages=2,
+                                           num_microbatches=4,
+                                           schedule="1f1b"))
+        return ff
+
+    for zero in (False, True):
+        ff = make(zero)
+        for i in range(2):
+            ff.pipelined.train_step(jax.random.key(i), [jnp.asarray(x)],
+                                    jnp.asarray(y))
+        pm = ff.pipelined
+        trained = {k: {w: np.asarray(v) for w, v in ws.items()}
+                   for k, ws in pm.all_params().items()}
+        mom = [jax.tree.map(np.asarray, st) for st in pm.stage_opt_state]
+        pm.sync_to(ff.compiled)
+        # cm now holds the trained values (zero layout preserved)
+        for k, ws in trained.items():
+            for w, v in ws.items():
+                np.testing.assert_array_equal(
+                    np.asarray(ff.compiled.params[k][w]), v,
+                    err_msg=f"zero={zero} {k}/{w}")
+        # momentum is non-trivial after 2 steps
+        assert any(np.abs(v).max() > 0
+                   for st in mom for ws in st.values()
+                   for v in ws.values())
+        # fresh engine re-seeded from cm equals the trained engine
+        pm.sync_from(ff.compiled)
+        for s, st in enumerate(pm.stage_opt_state):
+            got = jax.tree.map(np.asarray, st)
+            for opn in mom[s]:
+                for w in mom[s][opn]:
+                    np.testing.assert_array_equal(
+                        got[opn][w], mom[s][opn][w],
+                        err_msg=f"zero={zero} stage{s} {opn}/{w}")
+        for k, ws in trained.items():
+            for w, v in ws.items():
+                np.testing.assert_array_equal(
+                    np.asarray(pm.all_params()[k][w]), v,
+                    err_msg=f"zero={zero} resync {k}/{w}")
+
+
+def test_grad_accum_composes_with_pipeline():
+    """config.grad_accum_steps folds into the schedule's microbatch
+    count: pipelined training with K-fold accumulation equals the
+    single-mesh grad-accum path (same averaging) to float tolerance."""
+    bs = 16
+    x, y = _data(n=bs)
+    from flexflow_tpu import make_mesh
+
+    ff = FFModel(FFConfig(batch_size=bs, seed=0, grad_accum_steps=2))
+    _build(ff, bs)
+    ff.compile(optimizer=SGDOptimizer(lr=0.1),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=[], mesh=make_mesh({"pipe": 2, "data": 4}),
+               pipeline=PipelineConfig(num_stages=2, num_microbatches=2,
+                                       schedule="1f1b"))
+    assert ff.pipelined.cfg.num_microbatches == 4  # 2 x K
+    for i in range(2):
+        ff.pipelined.train_step(jax.random.key(i), [jnp.asarray(x)],
+                                jnp.asarray(y))
+    p_pp = {k: {w: np.asarray(v) for w, v in ws.items()}
+            for k, ws in ff.pipelined.all_params().items()}
+
+    ff2 = FFModel(FFConfig(batch_size=bs, seed=0, grad_accum_steps=4,
+                           mesh_shape={"data": 8}))
+    _build(ff2, bs)
+    ff2.compile(optimizer=SGDOptimizer(lr=0.1),
+                loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                metrics=[])
+    cm = ff2.compiled
+    xb = jax.device_put(x, cm.input_shardings[0])
+    yb = jax.device_put(y, cm.label_sharding)
+    for i in range(2):
+        cm.params, cm.opt_state, _, _ = cm.train_step(
+            cm.params, cm.opt_state, jax.random.key(i), xb, yb)
+    for k in p_pp:
+        for w in p_pp[k]:
+            np.testing.assert_allclose(
+                p_pp[k][w], np.asarray(cm.params[k][w]),
+                rtol=2e-4, atol=2e-5, err_msg=f"{k}/{w}")
+
+
+def test_lr_schedule_live_without_retrace():
+    """Satellite: stage updates take optimizer hyperparams as TRACED
+    arguments, so set_learning_rate is live on the NEXT step without
+    rebuilding any jitted update (refresh_updates is a no-op hook)."""
+    bs = 16
+    x, y = _data(n=bs)
+    from flexflow_tpu import make_mesh
+
+    def make(engine):
+        ff = FFModel(FFConfig(batch_size=bs, seed=0))
+        _build(ff, bs)
+        shape = {"pipe": 2} if engine == "compiled" else \
+            {"pipe": 2, "data": 4}
+        n = 2 if engine == "compiled" else 8
+        ff.compile(optimizer=SGDOptimizer(lr=0.1),
+                   loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                   metrics=[],
+                   mesh=make_mesh(shape, devices=jax.devices()[:n]),
+                   pipeline=PipelineConfig(num_stages=2,
+                                           num_microbatches=4,
+                                           schedule="1f1b",
+                                           engine=engine))
+        return ff
+
+    for engine in ("host", "compiled"):
+        ff = make(engine)
+        pm = ff.pipelined
+        updates_before = list(getattr(pm, "_stage_update", []))
+        pm.train_step(jax.random.key(0), [jnp.asarray(x)], jnp.asarray(y))
+        ff.set_learning_rate(1e-6)  # ~freezes training if honored
+        assert list(getattr(pm, "_stage_update", [])) == updates_before, \
+            "set_learning_rate rebuilt the jitted stage updates"
+        before = {k: {w: np.asarray(v) for w, v in ws.items()}
+                  for k, ws in pm.all_params().items()}
+        pm.train_step(jax.random.key(1), [jnp.asarray(x)], jnp.asarray(y))
+        after = pm.all_params()
+        max_delta = max(
+            np.abs(before[k][w] - np.asarray(after[k][w])).max()
+            for k in before for w in before[k])
+        assert max_delta < 1e-4, (
+            f"{engine}: lr change not live (max param delta "
+            f"{max_delta})")
