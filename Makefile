@@ -15,14 +15,18 @@
 #   make bench-pipe — pipeline schedule/engine bench (host GPipe vs 1F1B
 #                     vs single-dispatch compiled): dispatch counts, step
 #                     time, peak activation bytes; one JSON line
+#   make obs-report — flight-recorder smoke (obs/): traced pipelined fit
+#                     + serving requests -> one JSON line with the trace
+#                     event counts (schema-validated), the metrics
+#                     snapshot, and the sim-vs-measured divergence block
 
 PY ?= python
 CPU_MESH = JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
 .PHONY: ci native native-check lint pcg-lint test dryrun bench bench-fit \
-        bench-pipe
+        bench-pipe obs-report
 
-ci: native native-check lint test dryrun
+ci: native native-check lint test dryrun obs-report
 
 lint:
 	$(PY) -c "from flexflow_tpu.analysis.hotpath_lint import main; \
@@ -53,3 +57,6 @@ bench-fit:
 
 bench-pipe:
 	$(CPU_MESH) $(PY) tools/pipe_bench.py
+
+obs-report:
+	$(CPU_MESH) $(PY) tools/obs_report.py

@@ -66,6 +66,11 @@ CODE_CATALOG: Dict[str, str] = {
                "maps to a trivial (size-1/absent) mesh axis",
     "LINT003": "float-to-float cast in the step graph (mixed-precision "
                "boundary cast in the hot loop)",
+    # flight recorder (obs/divergence.py) — runtime, not compile-time
+    "OBS001": "sim-vs-measured divergence: the measured step time missed "
+              "the cost model's end-to-end prediction by more than "
+              "config.divergence_threshold — the model steering the "
+              "search no longer matches this machine (warning)",
     # hot-path lint (analysis/hotpath_lint.py) — source-level race/sync
     "HOT000": "unparseable source file (syntax error) — nothing else "
               "could be checked",

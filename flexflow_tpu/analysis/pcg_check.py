@@ -241,6 +241,12 @@ def propagate_strategies(
             continue
         rec["out_pshapes"] = out_shapes
         rec["weight_pshapes"] = weight_shapes
+        # entries the op honored WITHOUT a shape delta (schedule-only
+        # selections like attention's seq ring/a2a choice, or shardings
+        # already realized on the requested dim by inheritance) — the
+        # ablation below must not misread these as dropped. Captured NOW:
+        # the ablation's own propagate calls reset the op's record.
+        honored = set(getattr(op, "honored_strategy_keys", ()) or ())
         # --- per-tensor legality (PCG006/007/008) --------------------
         for i, ps in enumerate(out_shapes):
             _check_pshape(ps, layer, f"output {i}", axis_sizes, report)
@@ -272,6 +278,8 @@ def propagate_strategies(
         # sharding), so the proof an entry took effect is that removing
         # it changes the propagated shapes.
         for key, axis in requested.items():
+            if key in honored:
+                continue  # schedule-only / already-realized: not dropped
             size = axis_sizes.get(axis, 1)
             if size <= 1:
                 # absent/trivial axis: the entry is a silent no-op —

@@ -129,6 +129,22 @@ class FFConfig:
     # profiling / tracing
     profiling: bool = False
     print_freq: int = 10
+    # --- flight recorder (obs/) -------------------------------------------
+    # span tracer: "on" arms the process-wide ring-buffered tracer
+    # (obs/trace.py) — spans across compile/search/cache, the fit/eval
+    # step loop, the pipeline engines, and serving; export with
+    # Tracer.export(path) as Chrome/Perfetto trace-event JSON. "off"
+    # (default) keeps the hot loops span-free (a single flag check).
+    trace: str = "off"
+    # sim-vs-measured divergence (obs/divergence.py), recorded into
+    # fit_profile["divergence"] after each fit: "off" (default, zero
+    # overhead), "e2e" (end-to-end est_step_time vs measured — derived
+    # from counters fit already records), "on" (adds the per-op
+    # cost-model-vs-profile_ops comparison; jits each op once)
+    divergence: str = "off"
+    # |measured/predicted - 1| beyond which the OBS001 warn finding
+    # fires (1.0 = within 2x either way tolerated)
+    divergence_threshold: float = 1.0
     # numerics
     computation_mode: CompMode = CompMode.TRAINING
     # mixed precision: "bfloat16" runs activations/matmuls in bf16 on the
@@ -260,6 +276,12 @@ class FFConfig:
                 cfg.search_overlap_backward_update = False
             elif a == "--profiling":
                 cfg.profiling = True
+            elif a == "--trace":
+                cfg.trace = "on"
+            elif a == "--divergence":
+                cfg.divergence = _next()
+            elif a == "--divergence-threshold":
+                cfg.divergence_threshold = float(_next())
             elif a == "--print-freq":
                 cfg.print_freq = int(_next())
             elif a == "--adoption-margin":

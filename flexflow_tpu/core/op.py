@@ -112,7 +112,16 @@ class Op:
         inherit the partitioning of input 0 on dims they share size with,
         batch dim first; weights replicated. Mirrors the identity
         parallel-dim mapping records most reference ops register.
+
+        ``honored_strategy_keys`` records the entries whose requested
+        effect this propagation realized WITHOUT changing the shapes an
+        ablation would compare — schedule selections (attention's
+        ``seq`` ring/a2a choice) and shardings already realized on the
+        requested dim by inheritance (a downstream conv's ``spatial``).
+        The PCG006 ablation check (analysis/pcg_check.py) consults it so
+        schedule-only entries are not misread as silently dropped.
         """
+        self.honored_strategy_keys = set()
         out_shapes = []
         in0 = input_shapes[0] if input_shapes else None
         for sizes, dtype in self.infer_output_shapes():

@@ -1,0 +1,51 @@
+"""Flight recorder: the unified observability subsystem.
+
+Three zero-dependency parts (motivated by the paper's predict→measure
+loop — a profiling-guided search is only trustworthy when its
+predictions stay observable at runtime; cf. "A Learned Performance
+Model for Tensor Processing Units", arXiv:2008.01040, and FlexFlow's
+``--profiling``/Legion Prof per-op device timing, arXiv:1807.05358):
+
+* :mod:`.trace` — thread-safe ring-buffered **span tracer** emitting
+  Chrome/Perfetto trace-event JSON. ~Free when disabled
+  (``config.trace=off``, the default); spans cover compile (search,
+  validation, lowering, cache hit/miss), the fit/eval step loop
+  (dispatch, input wait, host sync, recompile checks), the pipeline
+  engines, and serving (one span tree per request).
+* :mod:`.metrics` — named counters / gauges / histograms in one
+  process-wide **registry** with JSON and Prometheus-text export, fed
+  by the Prefetcher, the dispatch-ahead window, the strategy cache,
+  recompile triggers, the serving engine, and the pipeline engines.
+* :mod:`.divergence` — **sim-vs-measured** comparison: the search /
+  simulator's ``est_step_time`` and per-op cost-model times vs measured
+  wall times, recorded as a ``divergence`` section of ``fit_report()``
+  and raising the coded finding OBS001 (warn) past a configurable
+  threshold.
+
+``runtime/profiling.py`` is the façade re-exporting this module's
+public surface next to the historical profiling exports;
+``tools/obs_report.py`` renders the one-line JSON summary.
+"""
+
+from .trace import (  # noqa: F401
+    Tracer,
+    configure_tracer,
+    span,
+    trace_enabled,
+    tracer,
+    validate_chrome_trace,
+)
+from .metrics import (  # noqa: F401
+    Counter,
+    EpochThroughput,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    metrics_registry,
+)
+from .divergence import (  # noqa: F401
+    divergence_report,
+    maybe_record_divergence,
+    predicted_step_time,
+    record_divergence,
+)

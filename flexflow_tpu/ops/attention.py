@@ -208,6 +208,10 @@ class MultiHeadAttention(Op):
                 self.seq_mode = ("a2a" if mode == "a2a"
                                  and self.num_heads % deg == 0 else "ring")
                 out_shapes[0] = out_shapes[0].partitioned(1, deg, sax)
+                # the entry selects the SP communication schedule even
+                # when the seq dim arrived already sharded (downstream
+                # layers) — honored, though shapes may not change
+                self.honored_strategy_keys.add("seq")
         return out_shapes, weight_shapes
 
     def flops(self) -> float:

@@ -137,6 +137,14 @@ class Conv2D(Op):
                     and in_h // deg > self.kernel[0] // 2):
                 out_shapes[0] = out_shapes[0].with_dim(
                     2, ParallelDim(out_h, deg, sp_axis))
+                self.honored_strategy_keys.add("spatial")
+            elif (deg > 1 and len(out_shapes[0].dims) == 4
+                  and out_shapes[0].dims[2].axis == sp_axis):
+                # the requested H-sharding arrived already realized via
+                # the input (an upstream spatially-sharded conv/pool):
+                # the entry and the executed plan agree — honored, no
+                # shape delta for the ablation check to see
+                self.honored_strategy_keys.add("spatial")
         return out_shapes, weight_shapes
 
     def flops(self) -> float:

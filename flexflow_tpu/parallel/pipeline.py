@@ -506,7 +506,11 @@ class PipelinedModel:
             return self._ship(
                 0, {tid: mb[m] for tid, mb in zip(self.input_ids, xs_mb)})
 
-        for row in self.schedule.ticks:
+        from ..obs.trace import tracer as _obs_tracer
+
+        _tr = _obs_tracer()
+        for ti, row in enumerate(self.schedule.ticks):
+            _t_tick = _tr.now() if _tr.enabled else 0.0
             for s, a in enumerate(row):
                 if a is None:
                     continue
@@ -557,6 +561,16 @@ class PipelinedModel:
                     if c > 0:
                         dacts_buf[(c - 1, m)] = self._ship(
                             self.chunk_stage(c - 1), dacts)
+            if _tr.enabled:
+                # tick replay trace: one span per schedule row with the
+                # actions it dispatched (host-side issue time)
+                _tr.complete(
+                    "pipe.tick", _t_tick, _tr.now() - _t_tick,
+                    cat="pipeline",
+                    args={"tick": ti,
+                          "actions": [f"s{s}:{a.kind}{a.mb}"
+                                      for s, a in enumerate(row)
+                                      if a is not None]})
 
         # ---- per-stage optimizer update on each submesh
         hyper = self.optimizer.hyperparams()
@@ -565,6 +579,7 @@ class PipelinedModel:
             self.stage_params[s], self.stage_opt_state[s] = \
                 self._stage_update[s](self.stage_params[s], grad_acc[s],
                                       self.stage_opt_state[s], hyper)
+        self._feed_step_metrics()
 
         # flatten aux in (microbatch-major, chunk-ascending) order — the
         # historical host combine order, so the reported loss is
@@ -605,6 +620,19 @@ class PipelinedModel:
             self.step_dispatches, self.step_transfers = saved
 
     # ------------------------------------------------------ observability
+    def _feed_step_metrics(self) -> None:
+        """Mirror the per-step dispatch/transfer counters into the
+        process metrics registry (obs/metrics.py) — the pipeline's
+        bubble/dispatch series next to the fit/serving counters, one
+        scrape for the whole system."""
+        from ..obs.metrics import metrics_registry
+
+        reg = metrics_registry()
+        reg.counter("pipeline.steps").inc()
+        reg.counter("pipeline.dispatches").inc(self.step_dispatches)
+        reg.counter("pipeline.transfers").inc(self.step_transfers)
+        reg.gauge("pipeline.dispatches_per_step").set(self.step_dispatches)
+
     def _boundary_mb_bytes(self, mb_size: int) -> List[int]:
         """Per-chunk input bytes for ONE microbatch (chunk 0 = the model
         inputs; chunk c>0 = the c-1 -> c boundary tensors), at logical
@@ -679,6 +707,10 @@ class PipelinedModel:
         rec["dispatches_per_step"] = self.step_dispatches
         rec["transfers_per_step"] = self.step_transfers
         rec["timeline"] = render_timeline(self.schedule)
+        from ..obs.metrics import metrics_registry
+
+        metrics_registry().gauge("pipeline.bubble_fraction").set(
+            rec.get("bubble_fraction", 0.0))
         if mb_size:
             rec["peak_activation_bytes"] = \
                 self.peak_activation_bytes(mb_size)

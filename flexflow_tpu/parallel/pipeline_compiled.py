@@ -686,9 +686,20 @@ class CompiledPipelinedModel(PipelinedModel):
         hyper = {k: jnp.asarray(v, jnp.float32)
                  for k, v in self.optimizer.hyperparams().items()}
         rng = jax.device_put(rng, rep)
-        out = self._programs[key](self._packed[0], self._packed[1], rng,
-                                  hyper, y_st, *xs_st)
+        # flight recorder: the whole warmup/steady/cooldown schedule is
+        # ONE program — record its few dispatches as one annotated span
+        # (schedule metadata in args) instead of a span per tick
+        from ..obs.trace import span as _obs_span
+
+        with _obs_span("pipe.step.compiled", cat="pipeline",
+                       schedule=self.cfg.schedule,
+                       interleave=self.cfg.interleave,
+                       stages=S, microbatches=M,
+                       dispatches=self.step_dispatches + 1):
+            out = self._programs[key](self._packed[0], self._packed[1],
+                                      rng, hyper, y_st, *xs_st)
         self.step_dispatches += 1  # the ONE schedule program
+        self._feed_step_metrics()
         theta, opt, losses_all, auxes_all = out[:4]
         self._packed = [theta, opt]
         self._views_stale = True

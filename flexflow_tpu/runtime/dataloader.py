@@ -34,6 +34,8 @@ import numpy as np
 import jax
 from jax.sharding import NamedSharding, PartitionSpec
 
+from ..obs.trace import tracer
+
 
 def _put(batch: np.ndarray, sharding: Optional[NamedSharding]) -> jax.Array:
     """Place a host batch: sharded placement routes through the
@@ -292,14 +294,22 @@ class Prefetcher:
         when ``n_steps > 1``."""
         self.group.reset(reshuffle)
         plan = self._plan()
+        tr = tracer()
+        # span name/cat track whichever loop drives us (fit vs eval) so
+        # the trace agrees with the registry series the stats feed
+        pfx = self.stats.prefix if self.stats is not None else "fit"
         if self.depth == 0:
             for k in plan:
                 t0 = time.perf_counter()
                 host = self.group.assemble_host(k)
+                wait = time.perf_counter() - t0
                 if self.stats is not None:
                     # serial mode: the whole inline assembly IS the wait
-                    self.stats.record_wait(time.perf_counter() - t0)
+                    self.stats.record_wait(wait)
                     self.stats.record_depth(0)
+                if tr.enabled:
+                    tr.complete(f"{pfx}.input_wait", t0, wait, cat=pfx,
+                                args={"k": k, "mode": "serial"})
                 yield k, self.group.place(host, k)
             return
         q: queue.Queue = queue.Queue(maxsize=self.depth)
@@ -342,6 +352,10 @@ class Prefetcher:
                     # an input wait)
                     self.stats.record_depth(depth_sample)
                     self.stats.record_wait(wait)
+                if tr.enabled:
+                    tr.complete(f"{pfx}.input_wait", t0, wait, cat=pfx,
+                                args={"depth": depth_sample,
+                                      "mode": "prefetch"})
                 k, host = item
                 yield k, self.group.place(host, k)
         finally:

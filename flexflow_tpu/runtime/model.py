@@ -37,6 +37,8 @@ from ..config import FFConfig, FFIterationConfig
 from ..core.layer import Layer
 from ..core.machine import make_mesh
 from ..core.tensor import Parameter, Tensor
+from ..obs.metrics import metrics_registry
+from ..obs.trace import configure_tracer, span, tracer
 from .compiler import CompiledModel, compile_model
 from .dataloader import DataLoaderGroup, Prefetcher, SingleDataLoader
 from .loss import loss_from_string
@@ -725,6 +727,8 @@ class FFModel:
         ``parallel.pipeline.PipelineConfig`` to train with a GPipe schedule
         over the mesh's pipe axis (no reference equivalent — PP is reserved
         but unimplemented upstream, model.h:190-192)."""
+        configure_tracer(self.config)  # config.trace="on" arms the recorder
+        _t0_compile = time.perf_counter()
         if optimizer is not None:
             self.optimizer = optimizer
         elif self.optimizer is None:
@@ -809,10 +813,11 @@ class FFModel:
 
                 src = ("rewrite" if self._search_layers is not None
                        else "builder")
-                self.pcg_report = _validate_pcg(
-                    compile_layers, self._used_inputs(), strat, vaxes,
-                    protected=frozenset({logits.tensor_id}),
-                    config=self.config, source=src)
+                with span("compile.validate_pcg", cat="compile", source=src):
+                    self.pcg_report = _validate_pcg(
+                        compile_layers, self._used_inputs(), strat, vaxes,
+                        protected=frozenset({logits.tensor_id}),
+                        config=self.config, source=src)
                 self.pcg_report.handle(vmode)
         self._pcg_prevalidated = None
         if self.config.perform_fusion:
@@ -859,18 +864,20 @@ class FFModel:
                     f"idle", severity="warning")
                 if vmode == "warn":
                     print(f"[pcg] {f.format()}", flush=True)
-        self.compiled = compile_model(
-            self.config,
-            compile_layers,
-            self._used_inputs(),
-            logits,
-            self.optimizer,
-            loss_type,
-            mtypes,
-            strategies=strat,
-            mesh=mesh,
-            comp_mode=comp_mode,
-        )
+        with span("compile.lower", cat="compile",
+                  n_layers=len(compile_layers)):
+            self.compiled = compile_model(
+                self.config,
+                compile_layers,
+                self._used_inputs(),
+                logits,
+                self.optimizer,
+                loss_type,
+                mtypes,
+                strategies=strat,
+                mesh=mesh,
+                comp_mode=comp_mode,
+            )
         self.pipelined = None
         if pipeline is not None:
             from ..parallel.pipeline import make_pipelined_model
@@ -880,18 +887,22 @@ class FFModel:
             cm = self.compiled
             pipeline = self._resolve_pipeline(pipeline, cm)
             lt, fl = cm.loss_type, cm.from_logits
-            self.pipelined = make_pipelined_model(
-                cm.ops, cm.mesh, pipeline, self.optimizer,
-                loss_fn=lambda lg, y: compute_loss(lt, lg, y, fl),
-                metrics_fn=(lambda lg, y: compute_batch_metrics(
-                    cm.metrics, lt, lg, y, fl)) if mtypes else None,
-                input_ids=[t.tensor_id for t in self._used_inputs()],
-                logits_id=logits.tensor_id,
-                params=cm.params,
-                wd_mask=cm.wd_mask,
-                opt_state=cm.opt_state,
-                compute_dtype=self.config.compute_dtype,
-            )
+            with span("compile.pipeline", cat="compile",
+                      schedule=pipeline.schedule,
+                      stages=pipeline.num_stages,
+                      microbatches=pipeline.num_microbatches):
+                self.pipelined = make_pipelined_model(
+                    cm.ops, cm.mesh, pipeline, self.optimizer,
+                    loss_fn=lambda lg, y: compute_loss(lt, lg, y, fl),
+                    metrics_fn=(lambda lg, y: compute_batch_metrics(
+                        cm.metrics, lt, lg, y, fl)) if mtypes else None,
+                    input_ids=[t.tensor_id for t in self._used_inputs()],
+                    logits_id=logits.tensor_id,
+                    params=cm.params,
+                    wd_mask=cm.wd_mask,
+                    opt_state=cm.opt_state,
+                    compute_dtype=self.config.compute_dtype,
+                )
         # graph exports requested via flags (reference: --compgraph /
         # --taskgraph dumps written right after compile, model.cc:3666-3674)
         if self.config.export_strategy_computation_graph_file:
@@ -910,6 +921,11 @@ class FFModel:
         # decision plus the contention probe — tests assert on this so a
         # silent-skip regression (the except-all guard) fails loudly
         self._playoff_record = None
+        tracer().complete(
+            "compile", _t0_compile, time.perf_counter() - _t0_compile,
+            cat="compile",
+            args={"n_ops": len(self.compiled.ops),
+                  "pipelined": self.pipelined is not None})
 
     def _resolve_pipeline(self, pipeline, cm):
         """Finalize a PipelineConfig against the compiled model:
@@ -1320,6 +1336,19 @@ class FFModel:
             "mesh_shape": dict(result.mesh_shape),
             "est_step_time": result.est_step_time,
         }
+        # flight recorder: the search phase as one span + the cache
+        # outcome as a counter series (hit/miss/refresh/off)
+        tracer().complete(
+            "compile.search", t_start,
+            self.search_profile["search_time_s"], cat="compile",
+            args={"cache": cache_label,
+                  "candidates": self.search_profile["candidates"],
+                  "pruned": self.search_profile["pruned"],
+                  "mesh": dict(result.mesh_shape),
+                  "est_step_time": result.est_step_time})
+        metrics_registry().counter(f"search.cache.{cache_label}").inc()
+        metrics_registry().gauge("search.est_step_time_s").set(
+            result.est_step_time)
         if self.config.profiling:
             rw = getattr(result, "rewrites", None)
             p = self.search_profile
@@ -1642,6 +1671,10 @@ class FFModel:
         the lax.scan multi-step executable. Per-epoch throughput counters
         land in ``self.fit_profile``."""
         assert self.compiled is not None, "call compile() first"
+        _tr = configure_tracer(self.config)
+        from ..obs.divergence import divergence_mode
+
+        divergence_mode(self.config)  # typo fails BEFORE training, not after
         if guard is not None and self.pipelined is not None:
             raise ValueError("TrainingGuard does not support pipelined "
                              "models yet (stage state lives off the "
@@ -1682,6 +1715,9 @@ class FFModel:
             loss_accum = None  # device-side; NaN/inf in ANY batch survives
             inflight = collections.deque()
             for nk, batch in pf.epoch():
+                # span per step: host-side dispatch + window control time
+                # (one flag check when tracing is off)
+                _ts = _tr.now() if _tr.enabled else 0.0
                 if self.pipelined is not None:
                     loss, bm = self.pipelined.train_step(
                         self._next_rng(), batch[:-1], batch[-1]
@@ -1737,10 +1773,16 @@ class FFModel:
                     if (recompile_state.iteration + 1) % ci == 0:
                         src = prev_loss if prev_loss is not None else loss
                         recompile_state.last_metric = float(src)  # hotpath: sync-ok (throttled to check_interval; reads the PREVIOUS step's already-ready loss)
-                    if recompile_on_condition(self, recompile_state):
+                    with span("fit.recompile_check", cat="fit"):
+                        fired = recompile_on_condition(self, recompile_state)
+                    if fired:
                         cm = self.compiled
                 prev_loss = loss
-            pm.flush()  # the epoch-boundary host sync (device-side accum)
+                if _tr.enabled:
+                    _tr.complete("fit.step", _ts, _tr.now() - _ts,
+                                 cat="fit", args={"k": nk})
+            with span("fit.host_sync", cat="fit", epoch=epoch):
+                pm.flush()  # the epoch-boundary host sync (device-side accum)
             epoch_records.append(stats.finish())
             if self.config.profiling:
                 r = epoch_records[-1]
@@ -1786,6 +1828,10 @@ class FFModel:
             # keep the CompiledModel view current so checkpoint/eval/
             # get_weights after a pipelined fit see trained weights
             self.pipelined.sync_to(cm)
+        # sim-vs-measured divergence (config.divergence; obs/divergence.py)
+        from ..obs.divergence import maybe_record_divergence
+
+        maybe_record_divergence(self)
         return history
 
     def eval(self, x, y, batch_size: Optional[int] = None, verbose: bool = True) -> PerfMetrics:
@@ -1794,24 +1840,29 @@ class FFModel:
         device-side metric accumulation with one sync at the end; the
         throughput record lands in ``self.eval_profile``."""
         assert self.compiled is not None
+        _tr = configure_tracer(self.config)
         cm = self.compiled
         xs = x if isinstance(x, (list, tuple)) else [x]
         bs = batch_size or self.config.batch_size
         group = self._make_loader_group(xs, y, bs, cm, shuffle=False)
         depth, max_inflight, _ = self._step_loop_knobs(cm)
         batch_nbytes = group.batch_nbytes
-        stats = EpochThroughput()
+        stats = EpochThroughput(prefix="eval")  # eval.* registry series
         pf = Prefetcher(group, depth, stats=stats)
         pm = PerfMetrics()
         inflight = collections.deque()
         for _nk, batch in pf.epoch(reshuffle=False):
+            _ts = _tr.now() if _tr.enabled else 0.0
             loss, logits, bm = cm.eval_step(
                 cm.params, *batch,
                 seq_length=self.iter_config.seq_length)
             pm.accumulate(bm)
             self._advance_window(stats, inflight, loss, 1, batch_nbytes,
                                  max_inflight)
-        pm.flush()
+            if _tr.enabled:
+                _tr.complete("eval.step", _ts, _tr.now() - _ts, cat="eval")
+        with span("eval.host_sync", cat="eval"):
+            pm.flush()
         self.eval_profile = self._step_loop_profile(
             [stats.finish()], depth, max_inflight, 1)
         if self.config.profiling:

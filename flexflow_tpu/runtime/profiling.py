@@ -24,6 +24,14 @@ TPU-native equivalents of the reference's observability surface
   ahead train loop (steps/s, host-input-wait seconds, prefetch queue-depth
   histogram, dispatch-ahead occupancy), recorded by ``FFModel.fit``/
   ``eval`` into ``FFModel.fit_profile``/``eval_profile``.
+
+This module is also the **façade over the flight recorder**
+(:mod:`..obs`): the span tracer (:class:`Tracer`/:func:`span`, Chrome
+trace-event JSON via ``Tracer.export``), the metrics registry
+(:func:`metrics_registry`, JSON + Prometheus-text export), and
+sim-vs-measured divergence tracking (:func:`divergence_report`,
+``fit_profile["divergence"]``, OBS001) are all re-exported here so one
+import serves the whole observability surface.
 """
 
 from __future__ import annotations
@@ -35,6 +43,29 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+# --- flight-recorder façade (obs/): tracer + metrics + divergence ---------
+from ..obs.divergence import (  # noqa: F401
+    divergence_report,
+    maybe_record_divergence,
+    predicted_step_time,
+    record_divergence,
+)
+from ..obs.metrics import (  # noqa: F401
+    Counter,
+    EpochThroughput,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    metrics_registry,
+)
+from ..obs.trace import (  # noqa: F401
+    Tracer,
+    configure_tracer,
+    span,
+    trace_enabled,
+    tracer,
+    validate_chrome_trace,
+)
 from ..utils.dot import DotFile
 
 
@@ -129,58 +160,9 @@ def profile_ops(ffmodel, iters: int = 10, warmup: int = 2) -> List[Dict]:
 
 
 # ----------------------------------------------------- step-loop observability
-class EpochThroughput:
-    """Per-epoch counters of the fit/eval step loop (the observability
-    half of the async input pipeline): how fast steps dispatched, how long
-    the loop sat waiting for host input, how full the prefetch queue ran,
-    and how deep the dispatch-ahead window actually was.
-
-    The fit loop drives it; :class:`~.dataloader.Prefetcher` feeds the
-    wait/depth counters. ``finish()`` renders one JSON-able record.
-    """
-
-    def __init__(self):
-        self.steps = 0
-        self.input_wait_s = 0.0
-        self.depth_hist: Dict[int, int] = {}
-        self._inflight_sum = 0
-        self._inflight_obs = 0
-        self.input_bytes = 0
-        self._t0 = time.perf_counter()
-
-    def record_wait(self, seconds: float) -> None:
-        """Time the consumer spent blocked on host batch assembly/transfer
-        (serial mode: the whole inline assembly; prefetch mode: queue-get
-        block time — ~0 when the pipeline keeps up)."""
-        self.input_wait_s += seconds
-
-    def record_depth(self, depth: int) -> None:
-        """Prefetch queue depth sampled at each batch request."""
-        self.depth_hist[depth] = self.depth_hist.get(depth, 0) + 1
-
-    def record_inflight(self, n: int) -> None:
-        """Dispatch-ahead window size observed when a step was issued."""
-        self._inflight_sum += n
-        self._inflight_obs += 1
-
-    def record_steps(self, n: int, nbytes: int = 0) -> None:
-        self.steps += n
-        self.input_bytes += nbytes
-
-    def finish(self) -> Dict:
-        wall = time.perf_counter() - self._t0
-        occ = (self._inflight_sum / self._inflight_obs
-               if self._inflight_obs else 0.0)
-        return {
-            "steps": self.steps,
-            "wall_s": round(wall, 6),
-            "steps_per_s": round(self.steps / wall, 3) if wall > 0 else 0.0,
-            "input_wait_s": round(self.input_wait_s, 6),
-            "input_mb_per_s": round(
-                self.input_bytes / wall / 2**20, 3) if wall > 0 else 0.0,
-            "queue_depth_hist": dict(sorted(self.depth_hist.items())),
-            "dispatch_ahead_occupancy": round(occ, 3),
-        }
+# EpochThroughput moved to obs/metrics.py (re-exported above): the per-
+# epoch fit_profile record is unchanged, but every sample now also feeds
+# the process-wide metrics registry ("fit.*" series).
 
 
 def fit_report(ffmodel) -> Optional[Dict]:
@@ -190,7 +172,10 @@ def fit_report(ffmodel) -> Optional[Dict]:
     epoch record carries ``steps``, ``wall_s``, ``steps_per_s``,
     ``input_wait_s`` (host time on the critical path), ``input_mb_per_s``,
     ``queue_depth_hist`` and ``dispatch_ahead_occupancy``. Pipelined
-    fits add a ``"pipeline"`` record (see :func:`pipeline_report`)."""
+    fits add a ``"pipeline"`` record (see :func:`pipeline_report`);
+    with ``config.divergence`` enabled a ``"divergence"`` record
+    (sim-vs-measured step-time and per-op ratios — see
+    :func:`divergence_report`) rides along too."""
     return getattr(ffmodel, "fit_profile", None)
 
 
@@ -270,7 +255,7 @@ def export_task_graph(ffmodel, path: str, fmt: str = "dot") -> None:
     machine = detect_machine_model(cm.mesh.devices.size)
     sim = Simulator(machine, OpCostModel(machine))
     total = sim.simulate_runtime(cm.ops)
-    tasks = sim._last_tasks  # start times filled by the replay
+    tasks = sim.last_tasks()  # start times filled by the replay
     edges = [(d, i) for i, t in enumerate(tasks) for d in t.deps]
     try:
         from ..native_bridge import available, transitive_reduction

@@ -64,6 +64,15 @@ def recompile_on_condition(ffmodel, state: RecompileState) -> bool:
     state.iteration += 1
     if not state.trigger():
         return False
+    from ..obs.metrics import metrics_registry
+    from ..obs.trace import tracer
+
+    # flight recorder: recompiles are rare and expensive — every fire is
+    # a counter tick plus a trace marker so a recompile storm is visible
+    metrics_registry().counter("recompile.triggers").inc()
+    tracer().instant("recompile.trigger", cat="fit",
+                     iteration=state.iteration,
+                     recompilations=state.recompilations)
     cm = ffmodel.compiled
     old_params = {}
     old_iteration = 0

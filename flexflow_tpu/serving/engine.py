@@ -26,6 +26,9 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..obs.metrics import metrics_registry
+from ..obs.trace import VIRTUAL_TID_BASE, tracer
+
 
 class _PyBatcher:
     """Pure-Python fallback with NativeBatcher's exact semantics."""
@@ -173,14 +176,17 @@ class ModelInstance:
 
 
 class InferenceRequest:
-    """A queued request: per-input rows + a Future for the result."""
+    """A queued request: per-input rows + a Future for the result.
+    ``t_enqueue`` anchors the request's span tree (obs/trace.py) and the
+    queue-wait latency metric."""
 
-    __slots__ = ("inputs", "future", "request_id")
+    __slots__ = ("inputs", "future", "request_id", "t_enqueue")
 
     def __init__(self, request_id: int, inputs: Sequence[np.ndarray]):
         self.request_id = request_id
         self.inputs = [np.asarray(a) for a in inputs]
         self.future: Future = Future()
+        self.t_enqueue = time.perf_counter()
 
 
 class InferenceEngine:
@@ -388,6 +394,10 @@ class InferenceEngine:
         with self._mu:
             self._requests[model][req.request_id] = req
         self._batchers[model].submit(req.request_id)
+        reg = metrics_registry()
+        reg.counter("serving.requests").inc()
+        reg.histogram("serving.queue_depth").observe(
+            self._batchers[model].pending())
         return req.future
 
     def infer(self, model: str, inputs: Sequence[np.ndarray],
@@ -398,6 +408,7 @@ class InferenceEngine:
     def _worker(self, name: str, idx: int = 0) -> None:
         inst = self._models[name][idx]
         batcher = self._batchers[name]
+        reg = metrics_registry()
         while True:
             ids = batcher.next_batch()
             if ids is None:
@@ -407,19 +418,65 @@ class InferenceEngine:
                         if i in self._requests[name]]
             if not reqs:
                 continue
+            t_pickup = time.perf_counter()
             try:
                 stacked = [
                     np.concatenate([r.inputs[k] for r in reqs], axis=0)
                     for k in range(inst.n_inputs)
                 ]
+                t_assembled = time.perf_counter()
                 outs = inst.infer(stacked)[0]
+                t_infer = time.perf_counter()
                 row = 0
+                ends = []
                 for r in reqs:
                     cnt = r.inputs[0].shape[0]
                     r.future.set_result(outs[row:row + cnt][0]
                                         if cnt == 1 else outs[row:row + cnt])
                     row += cnt
+                    ends.append(time.perf_counter())
+                reg.counter("serving.batches").inc()
+                reg.histogram("serving.batch_size").observe(row)
+                reg.histogram("serving.infer_s").observe(t_infer - t_assembled)
+                for r, t_end in zip(reqs, ends):
+                    reg.histogram("serving.queue_wait_s").observe(
+                        t_pickup - r.t_enqueue)
+                    reg.histogram("serving.e2e_s").observe(
+                        t_end - r.t_enqueue)
+                self._record_request_spans(name, reqs, t_pickup,
+                                           t_assembled, t_infer, ends)
             except Exception as e:  # surface per-request, keep serving
+                reg.counter("serving.errors").inc()
                 for r in reqs:
                     if not r.future.done():
                         r.future.set_exception(e)
+
+    @staticmethod
+    def _record_request_spans(model: str, reqs, t_pickup, t_assembled,
+                              t_infer, ends) -> None:
+        """One span tree per request, each on its own virtual track
+        (obs/trace.py VIRTUAL_TID_BASE) so request spans never partially
+        overlap: request ⊃ queue_wait → batch_assembly → infer → reply.
+        Batch-level phases repeat inside every member request's tree —
+        the per-request read ("where did MY latency go") is the point."""
+        tr = tracer()
+        if not tr.enabled:
+            return
+        for r, t_end in zip(reqs, ends):
+            # request_id is unique for the engine's lifetime: every
+            # request gets its OWN track, so concurrent requests can
+            # never partially overlap on a shared tid (the invariant
+            # validate_chrome_trace enforces)
+            tid = VIRTUAL_TID_BASE + r.request_id
+            args = {"model": model, "request_id": r.request_id}
+            tr.complete("serving.request", r.t_enqueue,
+                        t_end - r.t_enqueue, cat="serving", tid=tid,
+                        args=args)
+            tr.complete("serving.queue_wait", r.t_enqueue,
+                        t_pickup - r.t_enqueue, cat="serving", tid=tid)
+            tr.complete("serving.batch_assembly", t_pickup,
+                        t_assembled - t_pickup, cat="serving", tid=tid)
+            tr.complete("serving.infer", t_assembled, t_infer - t_assembled,
+                        cat="serving", tid=tid)
+            tr.complete("serving.reply", t_infer, t_end - t_infer,
+                        cat="serving", tid=tid)

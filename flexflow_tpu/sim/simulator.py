@@ -369,6 +369,12 @@ class Simulator:
                     heapq.heappush(heap, (ready[s], s))
         return total + self.machine.chip.step_overhead
 
+    def last_tasks(self) -> List[SimTask]:
+        """The SimTask list from the most recent :meth:`simulate_runtime`
+        (start/ready times filled by the replay) — the public accessor
+        the task-graph export reads. Empty before any simulation."""
+        return list(getattr(self, "_last_tasks", ()))
+
     def pipeline_schedule_cost(self, sched, submesh_step_time: float,
                                cut_bytes: float = 0.0,
                                data_degree: int = 1,

@@ -112,6 +112,59 @@ def test_dropped_entry_masked_by_inherited_axis_fires_pcg006():
     assert codes == ["PCG006"], report.format()
 
 
+def test_schedule_only_seq_entry_is_not_pcg006():
+    """PCG006 false-positive regression: a downstream attention layer's
+    {"seq": axis} entry produces NO shape delta (the seq dim arrives
+    already sharded from the previous layer) but still selects the
+    ring/a2a communication schedule — honored, not dropped. Was a
+    known-red compile failure on the transformer zoo model."""
+    from flexflow_tpu.models.transformer import (TransformerConfig,
+                                                 build_transformer)
+
+    ff = FFModel(FFConfig(batch_size=8))
+    build_transformer(ff, 8,
+                      TransformerConfig(hidden_size=32, num_heads=4,
+                                        num_layers=2, sequence_length=16),
+                      seq_axis="seq", seq_mode="a2a")
+    strat = {l.name: l.attrs["strategy"] for l in ff.layers
+             if l.attrs.get("strategy")}
+    report = _validate(ff, strat, {"data": 2, "seq": 4})
+    assert report.ok(), report.format()
+
+
+def test_already_realized_spatial_entry_is_not_pcg006():
+    """PCG006 false-positive regression: a second conv's
+    {"spatial": axis} request arrives ALREADY realized on the H dim
+    (inherited through conv->pool) — the stored and executed plans
+    agree, so the ablation's no-shape-delta must not read as dropped.
+    A spatial request the op genuinely cannot realize still fires."""
+    from flexflow_tpu import ActiMode
+
+    def conv_stack(ff):
+        x = ff.create_tensor((8, 3, 16, 16), DataType.FLOAT, name="img")
+        t = ff.conv2d(x, 8, 3, 3, 1, 1, 1, 1, ActiMode.RELU, name="sc1")
+        t = ff.pool2d(t, 2, 2, 2, 2, 0, 0, name="sp1")
+        t = ff.conv2d(t, 16, 3, 3, 1, 1, 1, 1, name="sc2")
+        t = ff.flat(t)
+        t = ff.dense(t, 5, name="shead")
+        ff.softmax(t)
+
+    ff = FFModel(FFConfig(batch_size=8))
+    conv_stack(ff)
+    report = _validate(ff, {"sc1": {"spatial": "model"},
+                            "sc2": {"spatial": "model"}},
+                       {"data": 2, "model": 4})
+    assert report.ok(), report.format()
+    # negative control: requesting a DIFFERENT axis than the realized
+    # one is a genuine divergence and must still fire
+    ff2 = FFModel(FFConfig(batch_size=8))
+    conv_stack(ff2)
+    bad = _validate(ff2, {"sc1": {"spatial": "model"},
+                          "sc2": {"spatial": "data"}},
+                    {"data": 2, "model": 4})
+    assert "PCG006" in [f.code for f in bad.errors], bad.format()
+
+
 def test_cycle_injection_fires_pcg001():
     ff = _build("mlp")
     layers = list(ff.layers)

@@ -322,9 +322,13 @@ def test_flash_autotune_records_xla_ratio(monkeypatch, tmp_path):
                 cache_path=p)
     entry = fa._TUNE_CACHE[(64, 64, 8, False)]
     assert entry["block_q"] in (16, 32)
-    # interpret-mode kernel loses to jitted XLA by orders of magnitude —
-    # the ratio is recorded and correctly denies engagement
-    assert entry["xla_ratio"] is not None and entry["xla_ratio"] < 1.0
+    # recorded-fields assertion, NOT a wall-clock comparison: asserting
+    # the interpret-mode kernel loses to XLA (< 1.0) was timing-flaky
+    # under full-suite load on a saturated host. What matters is that
+    # the ratio was measured and persisted, and that engagement asks
+    # proven() (which needs ratio >= 1.0) rather than mere presence.
+    assert isinstance(entry["xla_ratio"], float) and entry["xla_ratio"] > 0
+    assert fa.proven(64, 64, 8) == (entry["xla_ratio"] >= 1.0)
     with open(p) as f:
         data = json.load(f)
     data["128x128x8x0"] = 64  # legacy bare-int entry

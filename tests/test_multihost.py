@@ -144,6 +144,7 @@ def test_two_process_training_matches_single_process():
         for pid in (0, 1)
     ]
     outs = []
+    results = []
     for p in procs:
         try:
             out, err = p.communicate(timeout=420)
@@ -151,6 +152,15 @@ def test_two_process_training_matches_single_process():
             for q in procs:
                 q.kill()
             raise
+        results.append((p, out, err))
+    for p, out, err in results:
+        if p.returncode != 0 and \
+                "Multiprocess computations aren't implemented" in err:
+            # this jaxlib's CPU backend has no cross-process runtime —
+            # the test needs real multi-host hardware (TPU pod / GPU
+            # cluster), not a red tier-1 entry on the CPU mesh
+            pytest.skip("multiprocess computations not implemented on "
+                        "this CPU backend")
         assert p.returncode == 0, f"worker failed:\n{out}\n{err[-2000:]}"
         outs.append(out)
 

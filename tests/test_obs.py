@@ -1,0 +1,302 @@
+"""Flight-recorder tests (obs/): span tracer on/off + Chrome trace
+schema, metrics registry merge/export round-trips, sim-vs-measured
+divergence on a small fit, and the serving request span tree."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+from flexflow_tpu.models.mlp import build_mlp
+from flexflow_tpu.obs.metrics import MetricsRegistry, metrics_registry
+from flexflow_tpu.obs.trace import (VIRTUAL_TID_BASE, Tracer,
+                                    configure_tracer, span, tracer,
+                                    validate_chrome_trace)
+
+
+@pytest.fixture()
+def armed_tracer():
+    """Fresh, ENABLED global tracer for a test; disarmed afterwards so
+    unrelated tests keep their zero-overhead fast path."""
+    tr = tracer()
+    was = tr.enabled
+    tr.enabled = True
+    tr.clear()
+    yield tr
+    tr.clear()
+    tr.enabled = was
+
+
+def _mlp(n_hidden=(16,), **cfg):
+    ff = FFModel(FFConfig(batch_size=16, seed=0, **cfg))
+    build_mlp(ff, 16, in_dim=8, hidden_dims=n_hidden, num_classes=4)
+    ff.compile(optimizer=SGDOptimizer(lr=0.05),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=[])
+    return ff
+
+
+def _data(n=64):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, 8)).astype(np.float32)
+    y = rng.integers(0, 4, size=(n, 1)).astype(np.int32)
+    return x, y
+
+
+# ------------------------------------------------------------------ tracer
+def test_disabled_tracer_records_nothing_and_is_cheap():
+    tr = tracer()
+    assert not tr.enabled  # the process default
+    before = tr.event_count()
+    t0 = time.perf_counter()
+    for _ in range(100_000):
+        with span("noop", cat="test", i=1):
+            pass
+    elapsed = time.perf_counter() - t0
+    assert tr.event_count() == before
+    # ~free: one flag check + a shared no-op context manager. 100k calls
+    # in far under a second even on a loaded CI host (loose bound — the
+    # point is no per-call allocation/locking, not a precise number).
+    assert elapsed < 2.0, f"disabled span() too slow: {elapsed:.3f}s"
+
+
+def test_span_events_have_required_fields_and_nest(armed_tracer, tmp_path):
+    with span("outer", cat="test", k=1):
+        with span("inner", cat="test"):
+            pass
+        with span("inner2", cat="test"):
+            pass
+    armed_tracer.instant("marker", cat="test", x=2)
+    p = str(tmp_path / "trace.json")
+    n = armed_tracer.export(p)
+    payload = json.load(open(p))
+    assert n == 4 and len(payload["traceEvents"]) == 4
+    for ev in payload["traceEvents"]:
+        for field in ("name", "ph", "ts", "pid", "tid"):
+            assert field in ev, ev
+    assert validate_chrome_trace(payload) == []
+    # outer must CONTAIN both inners on the same track
+    evs = {e["name"]: e for e in payload["traceEvents"]}
+    out, inn = evs["outer"], evs["inner"]
+    assert out["ph"] == "X" and evs["marker"]["ph"] == "i"
+    assert out["ts"] <= inn["ts"]
+    assert inn["ts"] + inn["dur"] <= out["ts"] + out["dur"] + 0.05
+    assert out["tid"] == inn["tid"]
+
+
+def test_validate_chrome_trace_rejects_partial_overlap():
+    bad = {"traceEvents": [
+        {"name": "a", "ph": "X", "ts": 0.0, "dur": 10.0, "pid": 1, "tid": 1},
+        {"name": "b", "ph": "X", "ts": 5.0, "dur": 10.0, "pid": 1, "tid": 1},
+    ]}
+    assert validate_chrome_trace(bad) != []
+    assert validate_chrome_trace({"traceEvents": [{"ph": "X"}]}) != []
+    assert validate_chrome_trace([]) != []
+
+
+def test_tracer_ring_buffer_bounds_memory():
+    tr = Tracer(enabled=True, capacity=8)
+    for i in range(50):
+        tr.complete(f"e{i}", tr.now(), 0.0, cat="test")
+    assert tr.event_count() == 8
+    assert tr.events()[0]["name"] == "e42"  # oldest fell off
+
+
+def test_configure_tracer_mode_guard():
+    with pytest.raises(ValueError, match="trace="):
+        configure_tracer(FFConfig(batch_size=8, trace="bogus"))
+
+
+def test_fit_and_compile_emit_spans(armed_tracer):
+    ff = _mlp(trace="on")
+    x, y = _data()
+    ff.fit(x, y, epochs=1, verbose=False)
+    names = {e["name"] for e in armed_tracer.events()}
+    assert {"compile", "compile.lower", "compile.validate_pcg",
+            "fit.step", "fit.host_sync", "fit.input_wait"} <= names
+    assert validate_chrome_trace(
+        {"traceEvents": armed_tracer.events()}) == []
+
+
+# ----------------------------------------------------------------- metrics
+def test_registry_counter_gauge_histogram_round_trip():
+    reg = MetricsRegistry()
+    reg.counter("a.count").inc()
+    reg.counter("a.count").inc(2)
+    reg.gauge("a.gauge").set(1.5)
+    for v in range(10):
+        reg.histogram("a.lat").observe(v / 10.0)
+    doc = reg.to_json()
+    assert doc["a.count"] == 3
+    assert doc["a.gauge"] == 1.5
+    assert doc["a.lat"]["count"] == 10
+    assert 0.0 <= doc["a.lat"]["p50"] <= doc["a.lat"]["p99"] <= 0.9
+    # JSON round trip (histogram keeps count/sum/min/max)
+    back = MetricsRegistry.from_json(json.loads(json.dumps(doc)))
+    assert back.to_json()["a.count"] == 3
+    assert back.to_json()["a.lat"]["count"] == 10
+    # Prometheus text exposition
+    text = reg.to_prometheus()
+    assert "# TYPE flexflow_a_count counter" in text
+    assert "# TYPE flexflow_a_gauge gauge" in text
+    assert 'flexflow_a_lat{quantile="0.5"}' in text
+    assert "flexflow_a_lat_count 10" in text
+
+
+def test_registry_merge():
+    a, b = MetricsRegistry(), MetricsRegistry()
+    a.counter("c").inc(2)
+    b.counter("c").inc(3)
+    b.gauge("g").set(7.0)
+    a.histogram("h").observe(1.0)
+    b.histogram("h").observe(3.0)
+    a.merge(b)
+    doc = a.to_json()
+    assert doc["c"] == 5 and doc["g"] == 7.0
+    assert doc["h"]["count"] == 2 and doc["h"]["sum"] == 4.0
+    # type mismatch is an error, not silent data corruption
+    c = MetricsRegistry()
+    c.gauge("c").set(1.0)
+    with pytest.raises(TypeError):
+        a.merge(c)
+
+
+def test_fit_feeds_registry_counters():
+    before = metrics_registry().counter("fit.steps").value
+    ff = _mlp()
+    x, y = _data()
+    ff.fit(x, y, epochs=2, verbose=False)
+    after = metrics_registry().counter("fit.steps").value
+    assert after - before == 8  # 64 samples / 16 batch * 2 epochs
+
+
+# -------------------------------------------------------------- divergence
+def test_divergence_record_on_two_op_mlp_fit():
+    ff = _mlp(n_hidden=(), divergence="on")  # dense + softmax: 2 ops
+    assert len(ff.compiled.ops) == 2
+    x, y = _data()
+    ff.fit(x, y, epochs=2, verbose=False)
+    from flexflow_tpu.runtime.profiling import divergence_report
+
+    d = divergence_report(ff)
+    assert d is not None
+    assert d["source"] in ("search", "schedule_model", "simulator")
+    assert d["predicted_step_s"] > 0 and d["measured_step_s"] > 0
+    assert d["e2e_ratio"] == pytest.approx(
+        d["measured_step_s"] / d["predicted_step_s"], rel=1e-3)
+    assert len(d["epoch_ratios"]) == 2
+    names = {r["name"] for r in d["per_op"]}
+    assert names == {op.name for op in ff.compiled.ops}
+    for r in d["per_op"]:
+        assert r["measured_ms"] >= 0 and r["ratio"] is not None
+
+
+def test_divergence_obs001_fires_past_threshold(capsys):
+    # threshold 0: ANY measurable error fires the warn-level finding
+    ff = _mlp(n_hidden=(), divergence="e2e", divergence_threshold=0.0)
+    x, y = _data()
+    ff.fit(x, y, epochs=1, verbose=False)
+    d = ff.fit_profile["divergence"]
+    assert d["threshold"] == 0.0
+    assert d["findings"] and d["findings"][0]["code"] == "OBS001"
+    assert d["findings"][0]["severity"] == "warning"
+    assert ff.obs_report is not None and not ff.obs_report.errors
+    assert "OBS001" in capsys.readouterr().out
+    # e2e mode skips the expensive per-op comparison
+    assert "per_op" not in d
+
+
+def test_stale_obs001_cleared_by_next_fit(capsys):
+    # regression: fit #1 fires OBS001; fit #2 with divergence off (or
+    # nothing to compare) must not leave the previous verdict attached
+    ff = _mlp(n_hidden=(), divergence="e2e", divergence_threshold=0.0)
+    x, y = _data()
+    ff.fit(x, y, epochs=1, verbose=False)
+    assert ff.obs_report is not None
+    ff.config.divergence = "off"
+    ff.fit(x, y, epochs=1, verbose=False)
+    assert ff.obs_report is None
+
+
+def test_divergence_off_by_default_and_mode_guard():
+    ff = _mlp()
+    x, y = _data()
+    ff.fit(x, y, epochs=1, verbose=False)
+    assert "divergence" not in ff.fit_profile
+    ff2 = _mlp(divergence="bogus")
+    with pytest.raises(ValueError, match="divergence="):
+        ff2.fit(x, y, epochs=1, verbose=False)
+
+
+def test_obs001_in_code_catalog():
+    from flexflow_tpu.analysis import CODE_CATALOG
+
+    assert "OBS001" in CODE_CATALOG
+
+
+# ----------------------------------------------------------------- serving
+def test_serving_request_span_tree(armed_tracer):
+    from flexflow_tpu.serving.engine import InferenceEngine
+
+    ff = FFModel(FFConfig(batch_size=8, seed=0))
+    build_mlp(ff, 8, in_dim=8, hidden_dims=(16,), num_classes=4)
+    ff.compile(optimizer=None, loss_type=None, metrics=[])
+    eng = InferenceEngine(batch_timeout_s=0.002)
+    eng.register_ffmodel(ff, name="obs_serve")
+    rng = np.random.default_rng(0)
+    for _ in range(3):
+        out = eng.infer("obs_serve",
+                        [rng.normal(size=(8,)).astype(np.float32)])
+        assert out.shape == (4,)
+    eng.stop()
+    evs = [e for e in armed_tracer.events() if e.get("cat") == "serving"]
+    # one tree per request, each on its own virtual track
+    tracks = {}
+    for e in evs:
+        assert e["tid"] >= VIRTUAL_TID_BASE
+        tracks.setdefault(e["tid"], []).append(e)
+    assert len(tracks) == 3
+    for tid, tes in tracks.items():
+        by_name = {e["name"]: e for e in tes}
+        assert set(by_name) == {"serving.request", "serving.queue_wait",
+                                "serving.batch_assembly", "serving.infer",
+                                "serving.reply"}
+        req = by_name["serving.request"]
+        end = req["ts"] + req["dur"]
+        for name, e in by_name.items():
+            if name == "serving.request":
+                continue
+            assert e["ts"] >= req["ts"] - 0.05
+            assert e["ts"] + e["dur"] <= end + 0.05, name
+    assert validate_chrome_trace({"traceEvents": evs}) == []
+    reg = metrics_registry()
+    assert reg.counter("serving.requests").value >= 3
+    assert reg.histogram("serving.queue_wait_s").count >= 3
+
+
+# -------------------------------------------------------------- obs_report
+def test_obs_report_tool_smoke():
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "obs_report", os.path.join(os.path.dirname(__file__), os.pardir,
+                                   "tools", "obs_report.py"))
+    obs_report = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(obs_report)
+    tr = tracer()
+    was = tr.enabled
+    try:
+        out = obs_report.run_report(samples=32, epochs=2, requests=2)
+    finally:
+        tr.enabled = was  # the tool arms the global tracer
+        tr.clear()
+    assert out["exit"] == 0, out
+    assert out["trace"]["events"] > 0 and out["trace"]["valid"]
+    assert out["divergence"]["e2e_ratio"] and out["divergence"]["per_op"]
+    assert out["pipeline"]["schedule"] in ("gpipe", "1f1b", "interleaved")
+    assert "fit.steps" in out["metrics"]
+    assert "serving.requests" in out["metrics"]
+    json.dumps(out)  # one-line-JSON-able

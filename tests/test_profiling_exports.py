@@ -63,6 +63,43 @@ def test_profile_ops_records():
     assert dense and all(r["flops"] > 0 for r in dense)
 
 
+def test_profiling_facade_reexports_flight_recorder():
+    """runtime/profiling.py is the façade over obs/: the tracer, the
+    metrics registry, and the divergence API are importable from the one
+    historical profiling module — and are the SAME objects."""
+    from flexflow_tpu import obs
+    from flexflow_tpu.runtime import profiling
+
+    assert profiling.Tracer is obs.Tracer
+    assert profiling.tracer() is obs.tracer()
+    assert profiling.span is obs.span
+    assert profiling.configure_tracer is obs.configure_tracer
+    assert profiling.validate_chrome_trace is obs.validate_chrome_trace
+    assert profiling.MetricsRegistry is obs.MetricsRegistry
+    assert profiling.metrics_registry() is obs.metrics_registry()
+    assert profiling.EpochThroughput is obs.EpochThroughput
+    assert profiling.divergence_report is obs.divergence_report
+    assert profiling.record_divergence is obs.record_divergence
+    assert profiling.predicted_step_time is obs.predicted_step_time
+
+
+def test_simulator_last_tasks_public_accessor():
+    """export_task_graph no longer reaches into Simulator._last_tasks;
+    the public accessor returns the replay-filled task list."""
+    from flexflow_tpu.sim import OpCostModel, Simulator, detect_machine_model
+
+    ff = _model()
+    machine = detect_machine_model(ff.compiled.mesh.devices.size)
+    sim = Simulator(machine, OpCostModel(machine))
+    assert sim.last_tasks() == []  # nothing simulated yet
+    sim.simulate_runtime(ff.compiled.ops)
+    tasks = sim.last_tasks()
+    assert tasks and any(t.name == "grad_sync" for t in tasks)
+    # a COPY of the list: mutating it cannot corrupt the simulator state
+    tasks.clear()
+    assert sim.last_tasks()
+
+
 def test_recursive_logger_indents(caplog):
     import logging
 

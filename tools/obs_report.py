@@ -1,0 +1,168 @@
+#!/usr/bin/env python
+"""Flight-recorder report: one JSON line over a traced, pipelined fit.
+
+Exercises the full observability surface end to end — the CI smoke for
+``flexflow_tpu/obs/`` and the bench-trend record:
+
+* compiles a 2-stage **pipelined** MLP (pipe x data mesh) and fits it
+  with the span tracer armed (``config.trace=on``) and divergence
+  tracking in full per-op mode (``config.divergence=on``);
+* serves a few requests through the :class:`InferenceEngine` so the
+  serving span trees + queue/latency metrics populate;
+* exports the trace buffer as Chrome trace-event JSON and validates it
+  (``obs.trace.validate_chrome_trace``: required fields + span nesting);
+* prints ONE line::
+
+    {"trace": {"events": N, "by_cat": {...}, "valid": true, "path": ...},
+     "metrics": {...full registry snapshot...},
+     "divergence": {"e2e_ratio": ..., "per_op": [...], ...},
+     "pipeline": {"schedule": ..., "engine": ..., "dispatches_per_step": ...},
+     "exit": 0}
+
+Exit status 1 when the trace fails validation, the divergence block is
+missing, or the serving/fit counters did not populate.
+
+Usage::
+
+    python tools/obs_report.py                 # default smoke workload
+    python tools/obs_report.py --epochs 4 --samples 256
+    python tools/obs_report.py --trace-out /tmp/ff_trace.json
+    python tools/obs_report.py --prometheus    # also dump the scrape text
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+# hermetic multi-device CPU mesh when launched standalone (mirrors
+# tests/conftest.py; a real TPU/GPU environment overrides via env)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def _fit_pipelined(samples: int, epochs: int) -> dict:
+    """2-stage pipelined MLP fit with trace + divergence armed; returns
+    the fit report (throughput + pipeline + divergence records)."""
+    from flexflow_tpu import (DataType, FFConfig, FFModel, LossType,
+                              SGDOptimizer, make_mesh)
+    from flexflow_tpu.runtime.profiling import fit_report
+
+    bs = 16
+    mesh_shape = {"pipe": 2, "data": 4}
+    cfg = FFConfig(batch_size=bs, seed=0, trace="on", divergence="on",
+                   mesh_shape=mesh_shape)
+    ff = FFModel(cfg)
+    x = ff.create_tensor((bs, 16), DataType.FLOAT, name="obs_x")
+    t = ff.dense(x, 32, name="obs_fc1")
+    t = ff.relu(t, name="obs_act")
+    t = ff.dense(t, 4, name="obs_head")
+    ff.softmax(t, name="obs_sm")
+    # an explicit mesh object: compile() auto-enables the pipeline
+    # engine from the mesh's pipe axis (stage count = pipe degree)
+    ff.compile(optimizer=SGDOptimizer(lr=0.05),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=[], mesh=make_mesh(mesh_shape))
+    assert ff.pipelined is not None, "pipe mesh did not enable the engine"
+    rng = np.random.default_rng(0)
+    xs = rng.normal(size=(samples, 16)).astype(np.float32)
+    w = rng.normal(size=(16, 4)).astype(np.float32)
+    ys = np.argmax(xs @ w, axis=1).astype(np.int32).reshape(-1, 1)
+    ff.fit(xs, ys, epochs=epochs, verbose=False)
+    return fit_report(ff) or {}
+
+
+def _serve_smoke(requests: int) -> int:
+    """A few requests through the engine so serving spans/metrics fire."""
+    from flexflow_tpu import FFConfig, FFModel
+    from flexflow_tpu.models.mlp import build_mlp
+    from flexflow_tpu.serving.engine import InferenceEngine
+
+    ff = FFModel(FFConfig(batch_size=8, seed=0))
+    build_mlp(ff, 8, in_dim=8, hidden_dims=(16,), num_classes=4)
+    ff.compile(optimizer=None, loss_type=None, metrics=[])
+    eng = InferenceEngine(batch_timeout_s=0.002)
+    eng.register_ffmodel(ff, name="obs_mlp")
+    rng = np.random.default_rng(0)
+    for _ in range(requests):
+        out = eng.infer("obs_mlp", [rng.normal(size=(8,)).astype(np.float32)])
+        assert out.shape == (4,), out.shape
+    eng.stop()
+    return requests
+
+
+def run_report(samples: int = 64, epochs: int = 2, requests: int = 4,
+               trace_out: str = "") -> dict:
+    from flexflow_tpu.obs.metrics import metrics_registry
+    from flexflow_tpu.obs.trace import (configure_tracer, tracer,
+                                        validate_chrome_trace)
+
+    configure_tracer(enabled=True)
+    report = _fit_pipelined(samples, epochs)
+    _serve_smoke(requests)
+
+    tr = tracer()
+    path = trace_out or os.path.join(tempfile.gettempdir(),
+                                     "flexflow_obs_trace.json")
+    n_events = tr.export(path)
+    with open(path) as f:
+        problems = validate_chrome_trace(json.load(f))
+
+    snapshot = metrics_registry().to_json()
+    divergence = report.get("divergence") or {}
+    pipeline = report.get("pipeline") or {}
+    missing = [k for k in ("fit.steps", "serving.requests")
+               if k not in snapshot]
+    ok = (n_events > 0 and not problems and not missing
+          and bool(divergence.get("e2e_ratio"))
+          and divergence.get("per_op"))
+    return {
+        "trace": {
+            "events": n_events,
+            "by_cat": tr.counts_by_cat(),
+            "valid": not problems,
+            "problems": problems[:5],
+            "path": path,
+        },
+        "metrics": snapshot,
+        "divergence": divergence,
+        "pipeline": {k: pipeline.get(k) for k in
+                     ("schedule", "engine", "dispatches_per_step",
+                      "bubble_fraction")} if pipeline else {},
+        "steps_per_s": report.get("steps_per_s"),
+        "missing_metrics": missing,
+        "exit": 0 if ok else 1,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--samples", type=int, default=64)
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--requests", type=int, default=4)
+    ap.add_argument("--trace-out", default="",
+                    help="write the Chrome trace here (default: tmpdir)")
+    ap.add_argument("--prometheus", action="store_true",
+                    help="also print the Prometheus text exposition")
+    ns = ap.parse_args(argv)
+    out = run_report(samples=ns.samples, epochs=ns.epochs,
+                     requests=ns.requests, trace_out=ns.trace_out)
+    print(json.dumps(out, sort_keys=True))
+    if ns.prometheus:
+        from flexflow_tpu.obs.metrics import metrics_registry
+
+        sys.stderr.write(metrics_registry().to_prometheus())
+    return out["exit"]
+
+
+if __name__ == "__main__":
+    sys.exit(main())
