@@ -7,6 +7,13 @@
 #                   package source + bytecode-compile every module
 #   make pcg-lint — PCG validator + strategy linter over the model zoo;
 #                   one JSON line (tools/pcg_lint.py)
+#   make audit    — program audit (jaxpr-level AUD0xx checks: donation,
+#                   baked consts, callbacks, accumulator precision,
+#                   collective legality, retrace risk) over every zoo
+#                   model's compiled step executables + the caller-side
+#                   donated-reuse lint; one JSON line incl. audit/compile
+#                   wall-time ratio (budget < 5%); exit 1 on any
+#                   error-level finding (tools/program_audit.py)
 #   make test     — full suite on the virtual 8-device CPU mesh
 #   make dryrun   — compile+run one training step per parallelism mode
 #   make bench    — the benchmark (real chip when present, CPU fallback)
@@ -23,10 +30,10 @@
 PY ?= python
 CPU_MESH = JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-.PHONY: ci native native-check lint pcg-lint test dryrun bench bench-fit \
-        bench-pipe obs-report
+.PHONY: ci native native-check lint pcg-lint audit test dryrun bench \
+        bench-fit bench-pipe obs-report
 
-ci: native native-check lint test dryrun obs-report
+ci: native native-check lint test dryrun obs-report audit
 
 lint:
 	$(PY) -c "from flexflow_tpu.analysis.hotpath_lint import main; \
@@ -35,6 +42,9 @@ lint:
 
 pcg-lint:
 	$(CPU_MESH) $(PY) tools/pcg_lint.py --hotpath
+
+audit:
+	$(CPU_MESH) $(PY) tools/program_audit.py
 
 native:
 	$(MAKE) -C native -s

@@ -13,22 +13,40 @@ Three passes, all runnable without executing a training step:
 * :func:`lint_hotpaths <.hotpath_lint.lint_paths>`
   (:mod:`.hotpath_lint`) — AST ``HOT0xx`` race/sync lint over the
   package source itself; the ``make lint`` gate.
+
+A fourth pass runs *after* lowering: :func:`audit_compiled_model`
+(:mod:`.program_audit`) walks the ClosedJaxpr of every compiled step
+executable — donation coverage, baked constants, host callbacks,
+accumulator precision, collective legality, retrace risk — with
+``AUD0xx`` codes, wired into ``FFModel.compile()`` via
+``config.audit_programs``. Suppression pragmas for every pass share one
+grammar (:mod:`.pragmas`).
 """
 
 from .findings import (CODE_CATALOG, Finding, PCGValidationError,
-                       ValidationReport, layer_provenance,
-                       report_to_json_line)
+                       ProgramAuditError, ValidationReport,
+                       layer_provenance, report_to_json_line)
 from .hotpath_lint import lint_paths as lint_hotpaths
 from .hotpath_lint import lint_source as lint_hotpath_source
 from .pcg_check import propagate_strategies, validate_pcg
+from .program_audit import (ExecutableSpec, audit_closed_jaxpr,
+                            audit_compiled_model, audit_spec,
+                            audit_traced, lint_donated_reuse)
 from .strategy_lint import lint_strategy
 
 __all__ = [
     "CODE_CATALOG",
+    "ExecutableSpec",
     "Finding",
     "PCGValidationError",
+    "ProgramAuditError",
     "ValidationReport",
+    "audit_closed_jaxpr",
+    "audit_compiled_model",
+    "audit_spec",
+    "audit_traced",
     "layer_provenance",
+    "lint_donated_reuse",
     "lint_hotpath_source",
     "lint_hotpaths",
     "lint_strategy",

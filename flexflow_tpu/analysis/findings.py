@@ -71,6 +71,40 @@ CODE_CATALOG: Dict[str, str] = {
               "the cost model's end-to-end prediction by more than "
               "config.divergence_threshold — the model steering the "
               "search no longer matches this machine (warning)",
+    "PCG016": "non-positive tensor dimension: a declared shape has a "
+              "dim <= 0 (e.g. a conv/pool window larger than its input "
+              "— the size formula goes negative and downstream sizes "
+              "silently multiply back positive); the program cannot "
+              "execute",
+    # program audit (analysis/program_audit.py) — post-lowering jaxpr
+    # checks over every compiled step executable
+    "AUD000": "program could not be traced for audit — the audit was "
+              "skipped for this executable (warning; the first real "
+              "dispatch surfaces the underlying error with full "
+              "context)",
+    "AUD001": "large closed-over constant baked into a compiled program: "
+              "the array rides inside the executable (replicated per "
+              "compile, invisible to donation) instead of arriving as an "
+              "argument",
+    "AUD002": "donation coverage: a large traced argument with a "
+              "matching output aval is not in donate_argnums (peak HBM "
+              "pays for both buffers), or a caller reuses a buffer it "
+              "already donated",
+    "AUD003": "host callback (pure_callback / io_callback / "
+              "jax.debug.print) inside a step program — a device-to-host "
+              "round-trip on every dispatch",
+    "AUD004": "accumulator precision: a loop-carried accumulator "
+              "round-trips through bf16/f16 at the jaxpr level — the "
+              "lowered reality behind LINT003's source-level casts "
+              "(gradient/metric sums lose low bits every iteration)",
+    "AUD005": "collective legality inside shard_map: a ppermute partner "
+              "table is not a (partial) permutation, or collective "
+              "sequences disagree across lax.switch branches — "
+              "cross-host deadlock the moment processes disagree",
+    "AUD006": "retrace risk: a traced scalar closure is baked into a "
+              "step program (mutating it silently reuses the stale "
+              "executable — jit only re-traces on argument changes), or "
+              "a static argument value is unhashable",
     # hot-path lint (analysis/hotpath_lint.py) — source-level race/sync
     "HOT000": "unparseable source file (syntax error) — nothing else "
               "could be checked",
@@ -127,6 +161,9 @@ class ValidationReport:
 
     findings: List[Finding] = dataclasses.field(default_factory=list)
     source: str = "builder"  # "builder" | "cache" | "rewrite" | path
+    # which gate produced the report: "pcg" (graph passes) or "audit"
+    # (program audit) — picks the print prefix and the error class
+    tag: str = "pcg"
 
     def add(self, code: str, message: str, *, severity: str = "error",
             layer=None, **kw) -> Finding:
@@ -174,17 +211,19 @@ class ValidationReport:
         }
 
     def handle(self, mode: str, printer=print) -> None:
-        """Apply a ``config.validate_pcg`` mode: ``"error"`` raises
-        :class:`PCGValidationError` when any error-severity finding
-        exists (warnings stay silent on the report object); ``"warn"``
-        prints everything; ``"off"`` is a no-op."""
+        """Apply a gate mode (``config.validate_pcg`` /
+        ``config.audit_programs``): ``"error"`` raises the gate's coded
+        error when any error-severity finding exists (warnings stay
+        silent on the report object); ``"warn"`` prints everything;
+        ``"off"`` is a no-op."""
         if mode == "off":
             return
         if mode == "error" and self.errors:
-            raise PCGValidationError(self)
+            raise (ProgramAuditError if self.tag == "audit"
+                   else PCGValidationError)(self)
         if mode == "warn" and self.findings:
             for f in self.findings:
-                printer(f"[pcg] {f.format()}", flush=True)
+                printer(f"[{self.tag}] {f.format()}", flush=True)
 
 
 class PCGValidationError(ValueError):
@@ -192,13 +231,23 @@ class PCGValidationError(ValueError):
     the message leads with the first error (code + layer provenance) so
     the one-line traceback is already actionable."""
 
+    _WHAT = "PCG validation failed"
+
     def __init__(self, report: ValidationReport):
         self.report = report
         errs = report.errors
         head = errs[0].format() if errs else report.format()
         more = f" (+{len(errs) - 1} more)" if len(errs) > 1 else ""
         super().__init__(
-            f"PCG validation failed [{report.source}]: {head}{more}")
+            f"{self._WHAT} [{report.source}]: {head}{more}")
+
+
+class ProgramAuditError(PCGValidationError):
+    """A program-audit gate failure (AUD0xx codes). Subclasses
+    :class:`PCGValidationError` so existing except-clauses around
+    compile() keep catching every analysis gate."""
+
+    _WHAT = "program audit failed"
 
 
 def layer_provenance(layer) -> str:

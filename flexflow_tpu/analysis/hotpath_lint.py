@@ -24,7 +24,10 @@ created two source-level hazard classes no runtime test reliably catches:
 Intentional syncs are annotated in source with a pragma comment on the
 same line: ``# hotpath: sync-ok (<reason>)`` for HOT001/002 and
 ``# hotpath: lock-ok (<reason>)`` for HOT003. The pragma IS the review
-trail: every suppression names its reason.
+trail: every suppression names its reason — the shared grammar lives in
+:mod:`.pragmas` (one parser for this pass and the program auditor's
+``# audit: ...`` suppressions), and a pragma without a reason does not
+suppress.
 
 Thread rules (HOT002/003) are scoped to ``runtime/`` — the input
 pipeline and step loop layer. The serving engine's workers
@@ -46,6 +49,7 @@ import os
 import sys
 from typing import Dict, List, Optional, Sequence, Set
 
+from . import pragmas
 from .findings import Finding
 
 # the pipeline tail program (`self._bwd_last(...)`) marks the schedule
@@ -55,6 +59,9 @@ STEP_CALLS = {"train_step", "eval_step", "train_k_steps", "_bwd_last"}
 SYNC_ATTR_CALLS = {"block_until_ready", "item", "tolist"}
 SYNC_NAME_CALLS = {"float"}
 SYNC_NP_CALLS = {"asarray", "array"}
+# suppression tokens under the shared '# hotpath: <token> (reason)'
+# grammar (analysis/pragmas.py)
+PRAGMA_TOOL = "hotpath"
 SYNC_PRAGMA = "hotpath: sync-ok"
 LOCK_PRAGMA = "hotpath: lock-ok"
 # directories (relative to the package root) where thread-target rules
@@ -111,8 +118,10 @@ def _inside_with(node: ast.AST, stop: ast.AST) -> bool:
 
 
 def _has_pragma(lines: Sequence[str], node: ast.AST, pragma: str) -> bool:
-    ln = getattr(node, "lineno", 0)
-    return 0 < ln <= len(lines) and pragma in lines[ln - 1]
+    """``pragma`` is the legacy "tool: token" string; parsing/validation
+    (reason required) is the shared grammar in :mod:`.pragmas`."""
+    tool, _, token = pragma.partition(": ")
+    return pragmas.line_has(lines, getattr(node, "lineno", 0), tool, token)
 
 
 def _rooted_at(expr: ast.AST, aliases: Set[str]) -> bool:

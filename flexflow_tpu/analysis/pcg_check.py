@@ -68,8 +68,30 @@ def _input_pshapes(input_tensors, axis_sizes: Dict[str, int],
 def check_graph(layers: Sequence, input_tensors: Sequence,
                 protected: frozenset, report: ValidationReport) -> bool:
     """Well-formedness: producer order (PCG001), dangling refs (PCG002),
-    dead layers (PCG003, warning). Returns False when the graph is too
-    broken for the propagation walk to be meaningful."""
+    dead layers (PCG003, warning), non-positive dims (PCG016). Returns
+    False when the graph is too broken for the propagation walk to be
+    meaningful."""
+    # non-positive declared dims (PCG016): the size formulas are plain
+    # integer arithmetic ((H + 2p - k)//s + 1 goes NEGATIVE when the
+    # window exceeds the input), and two negative spatial dims multiply
+    # back into a plausible flat size — the program then dies deep in
+    # lowering with a shape error nowhere near the bad layer. Caught
+    # here with provenance instead.
+    for layer in layers:
+        for t in layer.outputs:
+            if any(int(d) < 1 for d in t.dims):
+                report.add(
+                    "PCG016",
+                    f"output tensor '{t.name}' has non-positive dim(s) "
+                    f"{tuple(t.dims)} — a window/stride larger than its "
+                    f"input upstream; the program cannot execute",
+                    layer=layer)
+    for t in input_tensors:
+        if any(int(d) < 1 for d in t.dims):
+            report.add(
+                "PCG016",
+                f"input tensor '{t.name}' has non-positive dim(s) "
+                f"{tuple(t.dims)}")
     available = {t.tensor_id for t in input_tensors}
     produced_by: Dict[int, object] = {}
     later_producers: Dict[int, object] = {}

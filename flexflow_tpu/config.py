@@ -118,6 +118,21 @@ class FFConfig:
     # every finding and proceeds (a corrupt cached strategy is treated
     # as a miss); "off" restores the unchecked historical behavior.
     validate_pcg: str = "error"
+    # program-audit gate (analysis/program_audit.py): after lowering,
+    # every compiled step executable's jaxpr is statically audited —
+    # donation coverage, baked-in constants, host callbacks, accumulator
+    # precision, collective legality inside shard_map, retrace risk —
+    # with AUD0xx-coded findings. "error" (default) raises on any
+    # error-severity finding; "warn" prints everything and proceeds;
+    # "off" skips the walk. The audit traces through jit's AOT API, so
+    # its trace is shared with the first real dispatch (paid once).
+    audit_programs: str = "error"
+    # AUD001: closed-over constants at or above this many bytes are
+    # reported (below it, a baked table is cheaper than an argument)
+    audit_const_bytes: int = 1 << 20
+    # AUD002: non-donated arguments at or above this many bytes with a
+    # matching output aval are reported
+    audit_donate_bytes: int = 1 << 20
     substitution_json_path: Optional[str] = None
     machine_model_file: Optional[str] = None
     export_strategy_file: Optional[str] = None
@@ -302,6 +317,12 @@ class FFConfig:
                 cfg.search_cache_dir = _next()
             elif a == "--validate-pcg":
                 cfg.validate_pcg = _next()
+            elif a == "--audit-programs":
+                cfg.audit_programs = _next()
+            elif a == "--audit-const-bytes":
+                cfg.audit_const_bytes = int(_next())
+            elif a == "--audit-donate-bytes":
+                cfg.audit_donate_bytes = int(_next())
             elif a == "--substitution-json":
                 cfg.substitution_json_path = _next()
             elif a == "--machine-model-file":

@@ -45,7 +45,12 @@ def build_resnet50(ff: FFModel, batch_size: int, num_classes: int = 1000,
             stride = 2 if (stage > 0 and i == 0) else 1
             t = _bottleneck(ff, t, in_ch, ch, stride, use_bn, f"s{stage}b{i}")
             in_ch = 4 * ch
-    t = ff.pool2d(t, 7, 7, 1, 1, 0, 0, PoolType.AVG)
+    # final avg-pool adapts to the feature map (AdaptiveAvgPool
+    # semantics): at the reference 229px the map is 8x8 and the window
+    # stays 7; at smaller smoke sizes a fixed 7 would exceed the input
+    # and the size formula goes negative (PCG016)
+    k = min(7, t.dims[2], t.dims[3])
+    t = ff.pool2d(t, k, k, 1, 1, 0, 0, PoolType.AVG)
     t = ff.flat(t)
     t = ff.dense(t, num_classes)
     t = ff.softmax(t)

@@ -42,7 +42,10 @@ def build_resnext50(ff: FFModel, batch_size: int, num_classes: int = 1000,
             t = _resnext_block(ff, t, stride, ch, cardinality, in_ch,
                                f"s{stage}b{i}")
             in_ch = 2 * ch
-    t = ff.pool2d(t, 7, 7, 1, 1, 0, 0, PoolType.AVG)
+    # final avg-pool adapts to the feature map (see models/resnet.py):
+    # a fixed 7x7 window exceeds the map at small smoke sizes (PCG016)
+    k = min(7, t.dims[2], t.dims[3])
+    t = ff.pool2d(t, k, k, 1, 1, 0, 0, PoolType.AVG)
     t = ff.flat(t)
     t = ff.dense(t, num_classes, name="logits")
     t = ff.softmax(t)

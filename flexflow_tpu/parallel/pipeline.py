@@ -152,7 +152,13 @@ class PipelinedModel:
     def __init__(self, ops, mesh: Mesh, cfg: PipelineConfig, optimizer,
                  loss_fn, metrics_fn, input_ids: List[int], logits_id: int,
                  params: Dict, wd_mask: Dict, opt_state=None,
-                 compute_dtype=None):
+                 compute_dtype=None, audit_config=None):
+        # program-audit gate config (FFConfig or None): the compiled
+        # engine audits each schedule program it builds when
+        # audit_config.audit_programs says so; the host engine has no
+        # monolithic program to audit, so it only stores the handle
+        self.audit_config = audit_config
+        self.audit_report = None
         axis_sizes = mesh_axis_sizes(mesh)
         if cfg.axis not in axis_sizes:
             raise ValueError(f"mesh has no '{cfg.axis}' axis for pipelining")
@@ -779,7 +785,7 @@ class PipelinedModel:
 def make_pipelined_model(ops, mesh, cfg: PipelineConfig, optimizer,
                          loss_fn, metrics_fn, input_ids, logits_id,
                          params, wd_mask, opt_state=None,
-                         compute_dtype=None):
+                         compute_dtype=None, audit_config=None):
     """Engine selection: the single-dispatch compiled engine when the
     (mesh, schedule, optimizer-state) envelope allows, else the
     host-driven engine. ``cfg.engine`` forces either; forcing
@@ -787,7 +793,7 @@ def make_pipelined_model(ops, mesh, cfg: PipelineConfig, optimizer,
     kw = dict(optimizer=optimizer, loss_fn=loss_fn, metrics_fn=metrics_fn,
               input_ids=input_ids, logits_id=logits_id, params=params,
               wd_mask=wd_mask, opt_state=opt_state,
-              compute_dtype=compute_dtype)
+              compute_dtype=compute_dtype, audit_config=audit_config)
     if cfg.engine not in ("auto", "host", "compiled"):
         raise ValueError(
             f"pipeline engine {cfg.engine!r}: expected auto|host|compiled")

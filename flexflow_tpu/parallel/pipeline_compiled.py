@@ -416,7 +416,6 @@ class CompiledPipelinedModel(PipelinedModel):
         wb = jnp.asarray(tb["wb"])
         rb = jnp.asarray(tb["rb"])
         T = tb["kinds"].shape[0]
-        inv_m = 1.0 / M
         loss_fn = self.loss_fn
         logits_id = self.logits_id
         cdt = self.compute_dtype
@@ -446,13 +445,16 @@ class CompiledPipelinedModel(PipelinedModel):
         fwd_perm = [(i, i + 1) for i in range(S - 1)]
         bwd_perm = [(i + 1, i) for i in range(S - 1)]
 
-        def shard_body(theta, opt, rng, hyper, y_st, *xs_st):
+        def shard_body(theta, opt, rng, hyper, inv_m_t, y_st, *xs_st):
             # theta: (1, Lp) local row; squeeze to (Lp,)
             th = theta[0]
             op_buf = opt[0]
             sidx = jax.lax.axis_index("pipe")
-            daux = jnp.asarray(inv_m)
-            cot = jnp.asarray(inv_m)
+            # 1/M arrives as a TRACED argument (not a closure): a baked
+            # scalar closure is exactly the AUD006 retrace hazard the
+            # program audit flags, and the traced form is bit-identical
+            daux = inv_m_t
+            cot = inv_m_t
 
             def inputs_for(m):
                 return {tid: jax.lax.dynamic_index_in_dim(
@@ -644,7 +646,7 @@ class CompiledPipelinedModel(PipelinedModel):
 
         P = PartitionSpec
         rep = P()
-        in_specs = (P("pipe", None), P("pipe", None), rep, rep, rep) \
+        in_specs = (P("pipe", None), P("pipe", None), rep, rep, rep, rep) \
             + tuple(rep for _ in xs_shapes)
         out_specs = (P("pipe", None), P("pipe", None), P("pipe", None),
                      P("pipe", None))
@@ -653,6 +655,51 @@ class CompiledPipelinedModel(PipelinedModel):
         fn = shard_map(shard_body, self._pmesh, in_specs=in_specs,
                        out_specs=out_specs, check_rep=False)
         return jax.jit(fn, donate_argnums=(0, 1))
+
+    # ----------------------------------------------------------- audit
+    def _audit_program(self, key, args) -> None:
+        """Program-audit one freshly built schedule program
+        (analysis/program_audit.py; mode from the compile()'s FFConfig
+        threaded through ``audit_config``). The shard_map body is where
+        the ppermute partner tables and the per-stage lax.switch
+        programs live — AUD005's deadlock class. Tracing here is shared
+        with the dispatch that follows (jit AOT cache)."""
+        cfg = self.audit_config
+        mode = (getattr(cfg, "audit_programs", "off") or "off") \
+            if cfg is not None else "off"
+        if mode == "off":
+            return
+        from ..analysis.findings import ValidationReport
+        from ..analysis.program_audit import audit_traced
+        from ..obs.metrics import metrics_registry
+        from ..obs.trace import span as _obs_span
+
+        pname = f"pipeline.{self.cfg.schedule}"
+        try:
+            with _obs_span("pipe.audit", cat="pipeline",
+                           schedule=self.cfg.schedule):
+                traced = self._programs[key].trace(*args)
+        except Exception as e:  # noqa: BLE001 — audit must not mask dispatch
+            # AUD000 contract: a trace failure is recorded, never
+            # silently dropped (audit_report would otherwise keep the
+            # PREVIOUS program's clean report and read as a clean audit
+            # of THIS one); the dispatch below surfaces the real error
+            report = ValidationReport(source="pipeline", tag="audit")
+            report.programs = {pname: {"trace_failed": True}}
+            report.add(
+                "AUD000",
+                f"program '{pname}' could not be traced for audit: "
+                f"{type(e).__name__}: {e}",
+                severity="warning")
+        else:
+            report = audit_traced(pname, traced, config=cfg,
+                                  source="pipeline")
+        self.audit_report = report
+        reg = metrics_registry()
+        reg.counter("audit.programs").inc()
+        reg.counter("audit.errors").inc(len(report.errors))
+        reg.counter("audit.warnings").inc(len(report.warnings))
+        report.handle(mode)
 
     # --------------------------------------------------------- training
     def train_step(self, rng, xs: Sequence[jax.Array], y: jax.Array,
@@ -679,13 +726,22 @@ class CompiledPipelinedModel(PipelinedModel):
         with_metrics = self.metrics_fn is not None
         key = (tuple((tuple(x.shape), str(x.dtype)) for x in xs_st),
                (tuple(y_st.shape), str(y_st.dtype)), with_metrics)
-        if key not in self._programs:
+        new_program = key not in self._programs
+        if new_program:
             self._programs[key] = self._build_program(
                 mb, [x.shape for x in xs_st], y_st.shape, y_st.dtype,
                 with_metrics)
         hyper = {k: jnp.asarray(v, jnp.float32)
                  for k, v in self.optimizer.hyperparams().items()}
+        inv_m = jnp.asarray(1.0 / M, jnp.float32)
         rng = jax.device_put(rng, rep)
+        if new_program:
+            # program-audit gate on the freshly built schedule program
+            # (ppermute tables, switch-branch collective agreement, ...);
+            # the AOT trace it takes is the one the dispatch below replays
+            self._audit_program(
+                key, (self._packed[0], self._packed[1], rng, hyper,
+                      inv_m, y_st) + tuple(xs_st))
         # flight recorder: the whole warmup/steady/cooldown schedule is
         # ONE program — record its few dispatches as one annotated span
         # (schedule metadata in args) instead of a span per tick
@@ -697,7 +753,7 @@ class CompiledPipelinedModel(PipelinedModel):
                        stages=S, microbatches=M,
                        dispatches=self.step_dispatches + 1):
             out = self._programs[key](self._packed[0], self._packed[1],
-                                      rng, hyper, y_st, *xs_st)
+                                      rng, hyper, inv_m, y_st, *xs_st)
         self.step_dispatches += 1  # the ONE schedule program
         self._feed_step_metrics()
         theta, opt, losses_all, auxes_all = out[:4]

@@ -79,6 +79,11 @@ class CompiledModel:
     # (learning-rate schedules): the compiled step bakes them in at trace
     # time. Set by compile_model; costs one XLA compile per call.
     refresh_train_step: Any = None
+    # program-audit handles (analysis/program_audit.ExecutableSpec): the
+    # jitted step executables plus abstract example arguments matching a
+    # real call, so the compile() audit gate's AOT trace is shared with
+    # the first dispatch instead of being paid twice
+    audit_exec: Optional[List[Any]] = None
 
 
 def toposort_layers(layers: List[Layer]) -> List[Layer]:
@@ -595,20 +600,74 @@ def compile_model(
     jit_train = None
     jit_train_k = None
     jit_grad = None
+    _train_exec = None
     if optimizer is not None and loss_type is not None:
-        jit_train = _wrap_train(
-            jax.jit(train_step, static_argnums=0, donate_argnums=(2, 3)))
+        _train_exec = jax.jit(train_step, static_argnums=0,
+                              donate_argnums=(2, 3))
+        jit_train = _wrap_train(_train_exec)
         # one executable per distinct super size (the leading dim is part
         # of the trace shape) — the Prefetcher's plan only uses power-of-
         # two sizes up to k, so at most log2(k) entries compile
         jit_train_k = _wrap_train(
             jax.jit(train_k_steps, static_argnums=0, donate_argnums=(2, 3)))
         jit_grad = _wrap(jax.jit(grad_step, static_argnums=0))
-    jit_eval = _wrap(jax.jit(eval_step, static_argnums=0))
+    # ---- AUD002-driven donation: the eval label buffer -------------------
+    # For dense losses the label tensor's aval equals the logits output's
+    # aval (label-matches-logits convention, model.cc:3085), so XLA can
+    # write the eval logits straight into the label's buffer. The eval
+    # loop builds a fresh label per step and never reads it after the
+    # call (the audit's caller-reuse check keeps it that way), so
+    # donation is safe and outputs are bit-identical — aliasing never
+    # changes values, XLA inserts copies where ordering requires. Sparse
+    # labels ((B, 1) int32) have no matching output and stay undonated.
+    _donate_eval: Tuple[int, ...] = ()
+    if label_tensor is not None:
+        _logits_out_dtype = (jnp.float32 if cdt is not None
+                             else pshapes[logits_id].dtype.to_jnp())
+        if (tuple(label_tensor.dims) == tuple(logits_tensor.dims)
+                and label_tensor.dtype.to_jnp() == _logits_out_dtype):
+            # y is positional arg 2 + n_inputs of eval_step
+            _donate_eval = (2 + n_inputs,)
+    _eval_exec = jax.jit(eval_step, static_argnums=0,
+                         donate_argnums=_donate_eval)
+    jit_eval = _wrap(_eval_exec)
     _jit_fwd = jax.jit(forward_fn, static_argnames=("seq_length",))
 
     def jit_forward(params, *xs, seq_length: int = -1):
         return _jit_fwd(params, *xs, seq_length=seq_length)
+
+    # ---- program-audit handles (analysis/program_audit.py) ---------------
+    # abstract example arguments with the SAME avals as a real dispatch:
+    # the audit gate traces through jit's AOT API, and matching avals
+    # mean that trace is the one the first real call replays
+    from ..analysis.program_audit import ExecutableSpec
+
+    def _sds(a):
+        return (jax.ShapeDtypeStruct(a.shape, a.dtype)
+                if hasattr(a, "shape") and hasattr(a, "dtype") else a)
+
+    _params_sds = jax.tree_util.tree_map(_sds, params)
+    _batch_sds = [jax.ShapeDtypeStruct(tuple(t.dims), t.dtype.to_jnp())
+                  for t in input_tensors]
+    if label_tensor is not None:
+        _batch_sds.append(jax.ShapeDtypeStruct(
+            tuple(label_tensor.dims), label_tensor.dtype.to_jnp()))
+        audit_exec = [ExecutableSpec(
+            "eval_step", _eval_exec, (-1, _params_sds, *_batch_sds),
+            static_args={"seq_length": -1})]
+    else:
+        # inference-only compile (no loss/label): eval_step cannot be
+        # traced without a label aval, and the program such callers
+        # actually dispatch is the forward pass — audit that instead
+        audit_exec = [ExecutableSpec(
+            "forward", _jit_fwd, (_params_sds, *_batch_sds))]
+    if _train_exec is not None:
+        _opt_sds = jax.tree_util.tree_map(_sds, opt_state)
+        audit_exec.insert(0, ExecutableSpec(
+            "train_step", _train_exec,
+            (-1, optimizer.hyperparams(), _params_sds, _opt_sds,
+             jax.random.key(config.seed), *_batch_sds),
+            static_args={"seq_length": -1}))
 
     cm = CompiledModel(
         config=config,
@@ -634,6 +693,7 @@ def compile_model(
         raw_forward=forward_fn,
         from_logits=from_logits,
         tensor_pshapes=pshapes,
+        audit_exec=audit_exec,
     )
 
     def _refresh_train_step():

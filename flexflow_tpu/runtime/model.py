@@ -15,6 +15,7 @@ drive it.
 from __future__ import annotations
 
 import collections
+import sys
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
@@ -77,6 +78,12 @@ class FFModel:
         # (config.validate_pcg); None when the gate is off
         self.pcg_report = None
         self._pcg_prevalidated = None  # cache-hit report handoff
+        # analysis.ValidationReport from the last compile()'s program
+        # audit (config.audit_programs, analysis/program_audit.py);
+        # None when the gate is off. audit_profile carries the gate's
+        # wall time + per-program stats for the <5%-of-compile budget.
+        self.audit_report = None
+        self.audit_profile = None
         self._search_strategies: Dict[str, Dict[str, str]] = {}
         self.iter_config = FFIterationConfig()
         self._param_index: Dict[int, Tuple[str, str]] = {}  # tensor_id -> (op, weight)
@@ -866,18 +873,38 @@ class FFModel:
                     print(f"[pcg] {f.format()}", flush=True)
         with span("compile.lower", cat="compile",
                   n_layers=len(compile_layers)):
-            self.compiled = compile_model(
-                self.config,
-                compile_layers,
-                self._used_inputs(),
-                logits,
-                self.optimizer,
-                loss_type,
-                mtypes,
-                strategies=strat,
-                mesh=mesh,
-                comp_mode=comp_mode,
-            )
+            try:
+                self.compiled = compile_model(
+                    self.config,
+                    compile_layers,
+                    self._used_inputs(),
+                    logits,
+                    self.optimizer,
+                    loss_type,
+                    mtypes,
+                    strategies=strat,
+                    mesh=mesh,
+                    comp_mode=comp_mode,
+                )
+            except Exception:
+                # gate ordering: under validate_pcg="warn" an error-
+                # severity finding proceeds by contract, but when
+                # tracing/lowering then dies the user must see the CODED
+                # finding that predicted it next to the raw JAX error.
+                # The original exception type is preserved (the failure
+                # may be unrelated — OOM, a user-callback bug — and
+                # callers catch specific types); the coded findings are
+                # printed as context instead of rewriting the exception.
+                if self.pcg_report is not None and self.pcg_report.errors:
+                    print(
+                        f"[pcg] compile failed after validate_pcg='warn' "
+                        f"proceeded past {len(self.pcg_report.errors)} "
+                        f"error-severity finding(s) — likely the cause:",
+                        file=sys.stderr, flush=True)
+                    for f in self.pcg_report.errors:
+                        print(f"[pcg] {f.format()}", file=sys.stderr,
+                              flush=True)
+                raise
         self.pipelined = None
         if pipeline is not None:
             from ..parallel.pipeline import make_pipelined_model
@@ -902,7 +929,57 @@ class FFModel:
                     wd_mask=cm.wd_mask,
                     opt_state=cm.opt_state,
                     compute_dtype=self.config.compute_dtype,
+                    audit_config=self.config,
                 )
+        # --- program-audit gate (analysis/program_audit.py): what we
+        # actually hand to XLA — the jaxprs of the jitted step
+        # executables — statically checked for donation coverage, baked
+        # constants, host callbacks, accumulator precision, collective
+        # legality and retrace risk, with AUD0xx-coded findings. Runs on
+        # EVERY compile, including cache-rehydrated strategies (the same
+        # trust boundary _validate_cached enforces pre-lowering). The
+        # pipeline/serving engines audit their own programs at build
+        # time with the same config.
+        self.audit_report = None
+        self.audit_profile = None
+        amode = self._audit_mode()
+        if amode != "off" and self.compiled is not None:
+            from ..analysis.program_audit import audit_compiled_model
+
+            _t0_audit = time.perf_counter()
+            asrc = ("cache" if (self.search_profile or {}).get("cache")
+                    == "hit" else "builder")
+            # with a pipeline engine active, fit() dispatches the
+            # engine's own (already audited) schedule programs and
+            # cm.train_step never runs — tracing it here would be cost
+            # no first dispatch ever amortizes
+            _skip = ("train_step",) if self.pipelined is not None else ()
+            with span("compile.audit", cat="compile", source=asrc):
+                self.audit_report = audit_compiled_model(
+                    self.compiled, config=self.config, source=asrc,
+                    skip=_skip)
+            _dt_audit = time.perf_counter() - _t0_audit
+            _progs = dict(getattr(self.audit_report, "programs", {}) or {})
+            self.audit_profile = {
+                "wall_time_s": _dt_audit,
+                # the gate's own marginal cost: the AOT traces (trace_s)
+                # are shared with the first dispatch via jit's trace
+                # cache, so only the jaxpr walk is true overhead
+                "walk_s": sum(p.get("walk_s", 0.0)
+                              for p in _progs.values()),
+                "trace_s": sum(p.get("trace_s", 0.0)
+                               for p in _progs.values()),
+                "programs": _progs,
+            }
+            reg = metrics_registry()
+            reg.counter("audit.programs").inc(
+                len(self.audit_profile["programs"]))
+            reg.counter("audit.errors").inc(
+                len(self.audit_report.errors))
+            reg.counter("audit.warnings").inc(
+                len(self.audit_report.warnings))
+            reg.histogram("audit.wall_time_s").observe(_dt_audit)
+            self.audit_report.handle(amode)
         # graph exports requested via flags (reference: --compgraph /
         # --taskgraph dumps written right after compile, model.cc:3666-3674)
         if self.config.export_strategy_computation_graph_file:
@@ -1279,6 +1356,16 @@ class FFModel:
         if mode not in ("error", "warn", "off"):
             raise ValueError(
                 f"validate_pcg={mode!r}: expected 'error', 'warn' or "
+                "'off'")
+        return mode
+
+    def _audit_mode(self) -> str:
+        """The config.audit_programs gate mode, with the same typo guard
+        the other gates get."""
+        mode = getattr(self.config, "audit_programs", "error") or "off"
+        if mode not in ("error", "warn", "off"):
+            raise ValueError(
+                f"audit_programs={mode!r}: expected 'error', 'warn' or "
                 "'off'")
         return mode
 

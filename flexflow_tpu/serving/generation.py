@@ -103,6 +103,66 @@ class Generator:
                         f"embedding capacity {cap} ({op.name})")
         self._step = jax.jit(self._block_step, donate_argnums=(2,))
         self._exec_params_cache = None  # (id(params), cast copy)
+        # program-audit gate (analysis/program_audit.py) over the decode
+        # step at its steady-state (B, 1) shape. The KV cache is donated
+        # (exact aval alias with the new cache); `params` has no
+        # matching output and the cast copy is reused across steps, so
+        # the audit proves nothing further is safely donatable here.
+        self.audit_report = None
+        self._maybe_audit()
+
+    def _maybe_audit(self) -> None:
+        cfg = self._cm.config
+        mode = getattr(cfg, "audit_programs", "off") or "off"
+        if mode == "off":
+            return
+        from ..analysis.program_audit import audit_traced
+
+        cdt = self._compute_dtype()
+        cache_dt = cdt or jnp.float32
+
+        def _sds(a):
+            dt = (cache_dt if cdt is not None
+                  and jnp.issubdtype(a.dtype, jnp.floating) else a.dtype)
+            return jax.ShapeDtypeStruct(a.shape, dt)
+
+        params_sds = jax.tree_util.tree_map(_sds, self._cm.params)
+        tokens_sds = jax.ShapeDtypeStruct((self.batch_size, 1), jnp.int32)
+        cache_sds = {
+            op.name: tuple(jax.ShapeDtypeStruct(
+                (self.batch_size, self.max_length, op.num_heads,
+                 op.head_dim), cache_dt) for _ in range(2))
+            for op in self._attn_ops}
+        offset_sds = jax.ShapeDtypeStruct((), jnp.int32)
+        try:
+            traced = self._step.trace(params_sds, tokens_sds, cache_sds,
+                                      offset_sds)
+        except Exception as e:  # noqa: BLE001 — audit must not mask decode
+            # AUD000 contract: record the trace failure instead of
+            # leaving audit_report empty-but-clean-looking; the first
+            # real decode surfaces the true error with full context
+            from ..analysis.findings import ValidationReport
+
+            report = ValidationReport(source="serving", tag="audit")
+            report.programs = {"serving.decode_step":
+                               {"trace_failed": True}}
+            report.add(
+                "AUD000",
+                f"program 'serving.decode_step' could not be traced for "
+                f"audit: {type(e).__name__}: {e}",
+                severity="warning")
+            self.audit_report = report
+            report.handle(mode)
+            return
+        self.audit_report = audit_traced(
+            "serving.decode_step", traced, config=cfg, source="serving")
+        from ..obs.metrics import metrics_registry
+
+        reg = metrics_registry()
+        reg.counter("audit.programs").inc()
+        reg.counter("audit.errors").inc(len(self.audit_report.errors))
+        reg.counter("audit.warnings").inc(len(self.audit_report.warnings))
+        self.audit_report.handle(mode)
 
     def _exec_params(self):
         """Params in the decode compute dtype. bf16: cast ONCE per params
