@@ -5,6 +5,13 @@
 #   make native   — build the C++ helpers (scheduler/batcher/sim engine)
 #   make lint     — static checks: hot-path race/sync lint over the
 #                   package source + bytecode-compile every module
+#   make concurrency-lint — whole-package concurrency audit (CCY0xx:
+#                   thread-role inference, unguarded shared mutation,
+#                   ABBA lock cycles, blocking under a lock, Condition
+#                   discipline, thread leaks, guarded-by inconsistency)
+#                   + reasonless-pragma hygiene; one JSON line
+#                   (tools/concurrency_lint.py); exit 1 on any error
+#                   finding or decorative suppression
 #   make pcg-lint — PCG validator + strategy linter over the model zoo;
 #                   one JSON line (tools/pcg_lint.py)
 #   make audit    — program audit (jaxpr-level AUD0xx checks: donation,
@@ -30,15 +37,18 @@
 PY ?= python
 CPU_MESH = JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-.PHONY: ci native native-check lint pcg-lint audit test dryrun bench \
-        bench-fit bench-pipe obs-report
+.PHONY: ci native native-check lint concurrency-lint pcg-lint audit \
+        test dryrun bench bench-fit bench-pipe obs-report
 
-ci: native native-check lint test dryrun obs-report audit
+ci: native native-check lint concurrency-lint test dryrun obs-report audit
 
 lint:
 	$(PY) -c "from flexflow_tpu.analysis.hotpath_lint import main; \
 	  raise SystemExit(main(['flexflow_tpu']))"
 	$(PY) -m compileall -q flexflow_tpu tools
+
+concurrency-lint:
+	$(PY) tools/concurrency_lint.py
 
 pcg-lint:
 	$(CPU_MESH) $(PY) tools/pcg_lint.py --hotpath

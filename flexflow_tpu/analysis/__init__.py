@@ -1,6 +1,6 @@
 """Static analysis for the PCG pipeline: validator, linter, hot-path lint.
 
-Three passes, all runnable without executing a training step:
+Four passes, all runnable without executing a training step:
 
 * :func:`validate_pcg` (:mod:`.pcg_check`) — graph well-formedness +
   sharding legality with ``PCG0xx`` codes and layer provenance; wired
@@ -12,9 +12,18 @@ Three passes, all runnable without executing a training step:
   ``utils/dot.annotate_findings``.
 * :func:`lint_hotpaths <.hotpath_lint.lint_paths>`
   (:mod:`.hotpath_lint`) — AST ``HOT0xx`` race/sync lint over the
-  package source itself; the ``make lint`` gate.
+  package source itself; the ``make lint`` gate. Its worker-thread
+  rules (HOT002/003) are scoped by the concurrency auditor's
+  thread-role model, not a directory allowlist.
+* :func:`check_concurrency <.concurrency_check.check_package>`
+  (:mod:`.concurrency_check`) — whole-package concurrency audit:
+  thread-role inference rooted at every ``Thread(target=...)`` spawn,
+  shared-state escape analysis, interprocedural lock-context tracking;
+  ``CCY0xx`` findings (unguarded shared mutation, ABBA lock cycles,
+  blocking under a lock, Condition discipline, thread leaks, guarded-by
+  inconsistency); the ``make concurrency-lint`` gate.
 
-A fourth pass runs *after* lowering: :func:`audit_compiled_model`
+A fifth pass runs *after* lowering: :func:`audit_compiled_model`
 (:mod:`.program_audit`) walks the ClosedJaxpr of every compiled step
 executable — donation coverage, baked constants, host callbacks,
 accumulator precision, collective legality, retrace risk — with
@@ -23,9 +32,12 @@ accumulator precision, collective legality, retrace risk — with
 grammar (:mod:`.pragmas`).
 """
 
-from .findings import (CODE_CATALOG, Finding, PCGValidationError,
-                       ProgramAuditError, ValidationReport,
-                       layer_provenance, report_to_json_line)
+from .concurrency_check import check_package as check_concurrency
+from .concurrency_check import check_source as check_concurrency_source
+from .findings import (CODE_CATALOG, ConcurrencyAuditError, Finding,
+                       PCGValidationError, ProgramAuditError,
+                       ValidationReport, layer_provenance,
+                       report_to_json_line)
 from .hotpath_lint import lint_paths as lint_hotpaths
 from .hotpath_lint import lint_source as lint_hotpath_source
 from .pcg_check import propagate_strategies, validate_pcg
@@ -36,6 +48,7 @@ from .strategy_lint import lint_strategy
 
 __all__ = [
     "CODE_CATALOG",
+    "ConcurrencyAuditError",
     "ExecutableSpec",
     "Finding",
     "PCGValidationError",
@@ -45,6 +58,8 @@ __all__ = [
     "audit_compiled_model",
     "audit_spec",
     "audit_traced",
+    "check_concurrency",
+    "check_concurrency_source",
     "layer_provenance",
     "lint_donated_reuse",
     "lint_hotpath_source",

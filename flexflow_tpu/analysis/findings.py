@@ -105,6 +105,28 @@ CODE_CATALOG: Dict[str, str] = {
               "step program (mutating it silently reuses the stale "
               "executable — jit only re-traces on argument changes), or "
               "a static argument value is unhashable",
+    # concurrency auditor (analysis/concurrency_check.py) — whole-package
+    # thread-role / lock-graph / shared-state checks
+    "CCY000": "unparseable module (syntax error) — excluded from the "
+              "concurrency audit",
+    "CCY001": "unguarded shared mutation: a field reachable from two or "
+              "more thread roles is written with no lock held (error), "
+              "or read outside the lock that guards its writes "
+              "(warning)",
+    "CCY002": "lock-acquisition-order cycle: two locks are taken in "
+              "opposite orders on different paths — two threads "
+              "interleaving at the ends deadlock (ABBA)",
+    "CCY003": "blocking call while holding a lock: queue get/put, "
+              "thread/queue join, event wait, host sync or sleep inside "
+              "a lock region stalls every thread needing that lock",
+    "CCY004": "Condition discipline violation: wait() without an "
+              "enclosing predicate loop, or wait/notify outside the "
+              "condition's lock",
+    "CCY005": "thread leak: a started thread with no join path and no "
+              "stop-event — shutdown cannot reclaim it",
+    "CCY006": "guarded-by inconsistency: the same field is guarded by "
+              "DIFFERENT locks at different sites, so the regions do "
+              "not exclude each other",
     # hot-path lint (analysis/hotpath_lint.py) — source-level race/sync
     "HOT000": "unparseable source file (syntax error) — nothing else "
               "could be checked",
@@ -161,8 +183,9 @@ class ValidationReport:
 
     findings: List[Finding] = dataclasses.field(default_factory=list)
     source: str = "builder"  # "builder" | "cache" | "rewrite" | path
-    # which gate produced the report: "pcg" (graph passes) or "audit"
-    # (program audit) — picks the print prefix and the error class
+    # which gate produced the report: "pcg" (graph passes), "audit"
+    # (program audit) or "concurrency" (whole-package concurrency
+    # audit) — picks the print prefix and the error class
     tag: str = "pcg"
 
     def add(self, code: str, message: str, *, severity: str = "error",
@@ -219,8 +242,7 @@ class ValidationReport:
         if mode == "off":
             return
         if mode == "error" and self.errors:
-            raise (ProgramAuditError if self.tag == "audit"
-                   else PCGValidationError)(self)
+            raise _TAG_ERRORS.get(self.tag, PCGValidationError)(self)
         if mode == "warn" and self.findings:
             for f in self.findings:
                 printer(f"[{self.tag}] {f.format()}", flush=True)
@@ -248,6 +270,19 @@ class ProgramAuditError(PCGValidationError):
     compile() keep catching every analysis gate."""
 
     _WHAT = "program audit failed"
+
+
+class ConcurrencyAuditError(PCGValidationError):
+    """A concurrency-audit gate failure (CCY0xx codes); same subclass
+    rationale as :class:`ProgramAuditError`."""
+
+    _WHAT = "concurrency audit failed"
+
+
+_TAG_ERRORS = {
+    "audit": ProgramAuditError,
+    "concurrency": ConcurrencyAuditError,
+}
 
 
 def layer_provenance(layer) -> str:

@@ -12,14 +12,24 @@ created two source-level hazard classes no runtime test reliably catches:
   throughput. The *step loop* is found structurally: the innermost
   ``for``/``while`` whose body calls one of the step executables.
 * **HOT002 — device work on an input-pipeline worker thread.** Any call
-  into the ``jax`` namespace from a function used as a
-  ``threading.Thread(target=...)`` in ``runtime/`` contends with XLA's
-  execution locks (the exact contention runtime/dataloader.py's design
-  note documents — placement stays on the dispatch thread).
+  into the ``jax`` namespace from a *worker-only* function contends with
+  XLA's execution locks (the exact contention runtime/dataloader.py's
+  design note documents — placement stays on the dispatch thread).
 * **HOT003 — unsynchronized shared-state mutation in a worker thread.**
-  Attribute/subscript stores or augmented assignments in a ``runtime/``
-  thread-target function outside any ``with`` (lock) block and not on a
-  queue — the data-race class a free-running worker introduces.
+  Attribute/subscript stores or augmented assignments in a *worker-only*
+  function outside any ``with`` (lock) block and not on a queue — the
+  data-race class a free-running worker introduces.
+
+*Worker-only* is decided by the concurrency auditor's thread-role model
+(:func:`.concurrency_check.module_worker_functions`): the call graph is
+rooted at every ``threading.Thread(target=...)`` spawn site, and a
+function belongs to the worker scope only when it is reachable from a
+spawn root and NOT from the module's public (main-role) surface. That
+replaces PR 3's directory allowlist — serving workers are no longer
+blanket-exempt (their device inference calls carry reasoned ``sync-ok``
+pragmas where intentional), and helpers shared between the dispatch
+thread and a worker are attributed to both roles instead of being
+misflagged as worker code.
 
 Intentional syncs are annotated in source with a pragma comment on the
 same line: ``# hotpath: sync-ok (<reason>)`` for HOT001/002 and
@@ -28,11 +38,6 @@ trail: every suppression names its reason — the shared grammar lives in
 :mod:`.pragmas` (one parser for this pass and the program auditor's
 ``# audit: ...`` suppressions), and a pragma without a reason does not
 suppress.
-
-Thread rules (HOT002/003) are scoped to ``runtime/`` — the input
-pipeline and step loop layer. The serving engine's workers
-(serving/engine.py) run device inference by design (one worker per model
-instance is its batching architecture), so they are out of scope.
 
 Run as a module for the Makefile's ``lint`` gate::
 
@@ -50,6 +55,7 @@ import sys
 from typing import Dict, List, Optional, Sequence, Set
 
 from . import pragmas
+from .concurrency_check import module_worker_functions
 from .findings import Finding
 
 # the pipeline tail program (`self._bwd_last(...)`) marks the schedule
@@ -64,9 +70,6 @@ SYNC_NP_CALLS = {"asarray", "array"}
 PRAGMA_TOOL = "hotpath"
 SYNC_PRAGMA = "hotpath: sync-ok"
 LOCK_PRAGMA = "hotpath: lock-ok"
-# directories (relative to the package root) where thread-target rules
-# apply; see module docstring for why serving/ is exempt
-THREAD_RULE_DIRS = ("runtime",)
 
 
 def _module_aliases(tree: ast.Module) -> Dict[str, Set[str]]:
@@ -165,32 +168,9 @@ def _step_loops(tree: ast.AST) -> List[ast.AST]:
     return loops
 
 
-def _thread_targets(tree: ast.AST) -> List[ast.FunctionDef]:
-    """FunctionDefs used as ``threading.Thread(target=...)`` in this
-    module (plain names and ``self._method`` attributes both resolve by
-    name)."""
-    wanted: Set[str] = set()
-    for node in ast.walk(tree):
-        if not (isinstance(node, ast.Call)
-                and ((isinstance(node.func, ast.Attribute)
-                      and node.func.attr == "Thread")
-                     or (isinstance(node.func, ast.Name)
-                         and node.func.id == "Thread"))):
-            continue
-        for kw in node.keywords:
-            if kw.arg == "target":
-                if isinstance(kw.value, ast.Name):
-                    wanted.add(kw.value.id)
-                elif isinstance(kw.value, ast.Attribute):
-                    wanted.add(kw.value.attr)
-    return [n for n in ast.walk(tree)
-            if isinstance(n, ast.FunctionDef) and n.name in wanted]
-
-
-def lint_source(src: str, filename: str = "<string>",
-                thread_rules: bool = True) -> List[Finding]:
-    """Lint one module's source. ``thread_rules`` gates HOT002/003 (the
-    caller scopes them to THREAD_RULE_DIRS)."""
+def lint_source(src: str, filename: str = "<string>") -> List[Finding]:
+    """Lint one module's source. HOT002/003 apply to every function the
+    thread-role model classifies as worker-only — no directory scoping."""
     findings: List[Finding] = []
     try:
         tree = ast.parse(src, filename=filename)
@@ -218,51 +198,57 @@ def lint_source(src: str, filename: str = "<string>",
                             f"(annotate '# {SYNC_PRAGMA} (reason)' if "
                             f"intentional)"))
 
-    if not thread_rules:
-        return findings
-
     # --- HOT002/HOT003: worker-thread discipline ---------------------
-    for fn in _thread_targets(tree):
+    # Worker scope comes from the concurrency auditor's role model; its
+    # nodes are a SEPARATE parse of the same source (line numbers match),
+    # so parents are attached per returned function. A nested def that is
+    # itself worker-only appears both as its own entry and inside its
+    # parent's walk — `seen` dedupes by (code, line).
+    if "Thread" not in src:
+        return findings  # no spawn sites -> no worker roles, by construction
+    seen: Set[tuple] = set()
+    for fn, roles in module_worker_functions(src, filename):
+        _attach_parents(fn)
+        label = getattr(fn, "name", "<lambda>")
         for node in ast.walk(fn):
             if isinstance(node, ast.Call):
                 f = node.func
                 if (_rooted_at(f, aliases["jax"])
                         or (isinstance(f, ast.Attribute)
                             and f.attr == "device_put")) \
+                        and ("HOT002", node.lineno) not in seen \
                         and not _has_pragma(lines, node, SYNC_PRAGMA):
+                    seen.add(("HOT002", node.lineno))
                     findings.append(Finding(
                         code="HOT002", severity="error", file=filename,
                         line=node.lineno,
                         message=f"jax/device call in thread worker "
-                                f"'{fn.name}' contends with XLA's "
-                                f"execution locks — keep placement on "
-                                f"the dispatch thread"))
+                                f"'{label}' (roles: {roles}) contends "
+                                f"with XLA's execution locks — keep "
+                                f"placement on the dispatch thread"))
             elif isinstance(node, (ast.Assign, ast.AugAssign)):
                 targets = node.targets if isinstance(node, ast.Assign) \
                     else [node.target]
                 shared = [t for t in targets
                           if isinstance(t, (ast.Attribute, ast.Subscript))]
                 if shared and not _inside_with(node, fn) \
+                        and ("HOT003", node.lineno) not in seen \
                         and not _has_pragma(lines, node, LOCK_PRAGMA):
+                    seen.add(("HOT003", node.lineno))
                     findings.append(Finding(
                         code="HOT003", severity="error", file=filename,
                         line=node.lineno,
                         message=f"shared-state store in thread worker "
-                                f"'{fn.name}' outside any lock — use a "
+                                f"'{label}' outside any lock — use a "
                                 f"queue or hold a lock (annotate "
                                 f"'# {LOCK_PRAGMA} (reason)' if safe)"))
     return findings
 
 
-def lint_file(path: str, package_root: Optional[str] = None
-              ) -> List[Finding]:
+def lint_file(path: str) -> List[Finding]:
     with open(path) as f:
         src = f.read()
-    rel = os.path.relpath(path, package_root) if package_root else path
-    thread_rules = any(
-        rel.replace(os.sep, "/").startswith(d + "/")
-        for d in THREAD_RULE_DIRS) if package_root else True
-    return lint_source(src, filename=path, thread_rules=thread_rules)
+    return lint_source(src, filename=path)
 
 
 def lint_paths(paths: Sequence[str]) -> List[Finding]:
@@ -276,9 +262,7 @@ def lint_paths(paths: Sequence[str]) -> List[Finding]:
             dirnames[:] = [d for d in dirnames if d != "__pycache__"]
             for fn in sorted(filenames):
                 if fn.endswith(".py"):
-                    findings.extend(
-                        lint_file(os.path.join(dirpath, fn),
-                                  package_root=p))
+                    findings.extend(lint_file(os.path.join(dirpath, fn)))
     return findings
 
 

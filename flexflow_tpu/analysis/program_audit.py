@@ -828,9 +828,9 @@ def lint_donated_reuse(src: str, filename: str = "<string>",
                         message=f"'{nm}' was donated to {attr}() at "
                                 f"line {call.lineno} and is read again "
                                 f"here — the buffer is already consumed "
-                                f"(annotate '# audit: donate-ok "
-                                f"(reason)' if this is not a live "
-                                f"read)"))
+                                f"(annotate "
+                                f"'# audit: donate-ok (reason)' "
+                                f"if this is not a live read)"))
                     break
     return findings
 

@@ -326,7 +326,9 @@ class NativeLoader:
     def close(self) -> None:
         if getattr(self, "_h", None):
             self._lib.fftpu_loader_destroy(self._h)
-            self._h = None
+            # single-consumer contract (class docstring): the Prefetcher
+            # worker has joined before the loader is closed/collected
+            self._h = None  # concurrency: race-ok (single-consumer contract, worker joined before close)
 
     def __del__(self):
         try:
@@ -347,16 +349,36 @@ class NativeBatcher:
             raise RuntimeError("native batcher unavailable")
         self._lib = lib
         self.max_batch = int(max_batch)
+        # guards _h and _closed for the NON-blocking entry points, giving
+        # the wrapper _PyBatcher's exact lifecycle semantics: submit fails
+        # fast once closed (an id accepted under this lock is pushed
+        # before close() can flip the flag, so the native drain-then-exit
+        # always covers it), pending()/destroy() can never hand the C API
+        # a NULL or freed handle, and double destroy() is a no-op.
+        # next_batch stays OUTSIDE this lock — it blocks in native code
+        # (the C batcher has its own mutex) and is covered by the engine's
+        # destroy-after-join contract instead.
+        self._hmu = threading.Lock()
+        self._closed = False
         self._h = lib.fftpu_batcher_create(self.max_batch,
                                            int(timeout_s * 1e6))
         if not self._h:
             raise RuntimeError("fftpu_batcher_create failed")
 
     def submit(self, request_id: int) -> None:
-        self._lib.fftpu_batcher_submit(self._h, int(request_id))
+        with self._hmu:
+            if self._closed or not self._h:
+                # a request appended after close() would never be drained
+                # (the workers exit once the queue empties) — fail fast so
+                # the engine can re-submit to the re-armed batcher
+                raise RuntimeError("batcher is closed")
+            self._lib.fftpu_batcher_submit(self._h, int(request_id))
 
     def pending(self) -> int:
-        return int(self._lib.fftpu_batcher_pending(self._h))
+        with self._hmu:
+            if not self._h:
+                return 0
+            return int(self._lib.fftpu_batcher_pending(self._h))
 
     def next_batch(self) -> Optional[List[int]]:
         """Blocks; returns ids, or None once closed and drained.
@@ -365,20 +387,29 @@ class NativeBatcher:
         run one consumer thread per instance against a shared batcher, and
         a shared output buffer would let one consumer's result overwrite
         another's between the native call and the Python read."""
+        h = self._h  # concurrency: race-ok (destroy-after-join: stop() frees the handle only after this consumer thread joined)
+        if not h:
+            return None
         ids = (ctypes.c_int64 * self.max_batch)()
-        n = self._lib.fftpu_batcher_next(self._h, ids)
+        n = self._lib.fftpu_batcher_next(h, ids)
         if n < 0:
             return None
         return list(ids[:n])
 
     def close(self) -> None:
-        if getattr(self, "_h", None):
-            self._lib.fftpu_batcher_close(self._h)
+        with self._hmu:
+            self._closed = True
+            if self._h:
+                self._lib.fftpu_batcher_close(self._h)
 
     def destroy(self) -> None:
-        if getattr(self, "_h", None):
-            self._lib.fftpu_batcher_destroy(self._h)
-            self._h = None
+        # atomic check-and-clear: concurrent stop() calls both reaching
+        # destroy() must not double-free the native handle
+        with self._hmu:
+            h, self._h = self._h, None
+            self._closed = True
+            if h:
+                self._lib.fftpu_batcher_destroy(h)
 
     def __del__(self):
         try:

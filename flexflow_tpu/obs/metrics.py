@@ -42,14 +42,14 @@ class Counter:
         # GIL-atomic enough for stats (float add); a torn read costs one
         # sample of drift, never a crash — the hot step loop must not
         # take a lock per increment
-        self.value += n
+        self.value += n  # concurrency: race-ok (lock-free by design: GIL-atomic add, drift tolerated)
 
     def to_json(self):
         v = self.value
         return int(v) if float(v).is_integer() else v
 
     def merge(self, other: "Counter") -> None:
-        self.value += other.value
+        self.value += other.value  # concurrency: race-ok (merge folds quiesced worker registries)
 
 
 class Gauge:
@@ -87,12 +87,12 @@ class Histogram:
 
     def observe(self, v: float) -> None:
         v = float(v)
-        self.count += 1
-        self.sum += v
+        self.count += 1  # concurrency: race-ok (lock-free by design: GIL-atomic add, drift tolerated)
+        self.sum += v  # concurrency: race-ok (lock-free by design, see count)
         if v < self.min:
-            self.min = v
+            self.min = v  # concurrency: race-ok (lock-free by design, see count)
         if v > self.max:
-            self.max = v
+            self.max = v  # concurrency: race-ok (lock-free by design, see count)
         self._recent.append(v)
 
     def percentile(self, q: float) -> float:
@@ -119,10 +119,10 @@ class Histogram:
         }
 
     def merge(self, other: "Histogram") -> None:
-        self.count += other.count
-        self.sum += other.sum
-        self.min = min(self.min, other.min)
-        self.max = max(self.max, other.max)
+        self.count += other.count  # concurrency: race-ok (merge folds quiesced worker registries)
+        self.sum += other.sum  # concurrency: race-ok (merge folds quiesced registries, see count)
+        self.min = min(self.min, other.min)  # concurrency: race-ok (see count)
+        self.max = max(self.max, other.max)  # concurrency: race-ok (see count)
         for v in other._recent:
             self._recent.append(v)
 

@@ -24,7 +24,7 @@ pulls batches in exactly the sequence the serial path would.
 
 from __future__ import annotations
 
-import queue
+import collections
 import threading
 import time
 from typing import Iterator, List, Optional, Sequence, Tuple
@@ -93,7 +93,9 @@ class SingleDataLoader:
 
     def reset(self) -> None:
         """reference: SingleDataLoader::reset."""
-        self.next_index = 0
+        # epoch handshake: reset() runs before the Prefetcher worker
+        # starts and after it joins — the roles never overlap in time
+        self.next_index = 0  # concurrency: race-ok (epoch handshake: worker joins before reset)
 
     def next_batch_host(self) -> np.ndarray:
         """Host-side batch assembly only (shuffle-perm gather); the
@@ -102,12 +104,15 @@ class SingleDataLoader:
         i = self.next_index
         if i + self.batch_size > self.num_samples:
             i = 0
-            self.next_index = 0
+            # single consumer: either the epoch's Prefetcher worker OR
+            # the serial caller pulls batches, never both concurrently
+            # (the worker joins before the serial path resumes)
+            self.next_index = 0  # concurrency: race-ok (single consumer per epoch, worker joins first)
         if self.perm is not None:
             batch = self.data[self.perm[i : i + self.batch_size]]
         else:
             batch = self.data[i : i + self.batch_size]
-        self.next_index = i + self.batch_size
+        self.next_index = i + self.batch_size  # concurrency: race-ok (single consumer per epoch)
         return batch
 
     def next_batch(self) -> jax.Array:
@@ -221,6 +226,60 @@ class _WorkerError:
 
 
 _DONE = object()
+_CLOSED = object()
+
+
+class _Channel:
+    """Bounded producer/consumer handoff with explicit close.
+
+    The Prefetcher's previous shutdown handshake was a stop Event the
+    worker polled between 50ms-timeout ``queue.put`` attempts — a worker
+    blocked on a full queue noticed consumer abandonment only at the
+    next poll tick, and the sentinel could be dropped without the
+    consumer ever learning the worker was gone. Here ``close()`` wakes
+    BOTH sides deterministically under one Condition: a producer blocked
+    on a full buffer returns ``False`` immediately (stop signal), a
+    consumer blocked on an empty buffer gets :data:`_CLOSED`.
+    """
+
+    def __init__(self, capacity: int):
+        self._cv = threading.Condition()
+        self._items: collections.deque = collections.deque()
+        self._capacity = max(1, int(capacity))
+        self._closed = False
+
+    def put(self, item) -> bool:
+        """Block until there is space; ``False`` once closed (the
+        consumer abandoned the epoch — the producer must stop)."""
+        with self._cv:
+            while len(self._items) >= self._capacity and not self._closed:
+                self._cv.wait()
+            if self._closed:
+                return False
+            self._items.append(item)
+            self._cv.notify_all()
+            return True
+
+    def get(self):
+        """Block until an item arrives; :data:`_CLOSED` once closed and
+        drained."""
+        with self._cv:
+            while not self._items and not self._closed:
+                self._cv.wait()
+            if self._items:
+                item = self._items.popleft()
+                self._cv.notify_all()
+                return item
+            return _CLOSED
+
+    def depth(self) -> int:
+        with self._cv:
+            return len(self._items)
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
 
 
 class Prefetcher:
@@ -312,38 +371,27 @@ class Prefetcher:
                                 args={"k": k, "mode": "serial"})
                 yield k, self.group.place(host, k)
             return
-        q: queue.Queue = queue.Queue(maxsize=self.depth)
-        stop = threading.Event()
-
-        def _offer(item) -> bool:
-            # bounded put that stays responsive to consumer abandonment
-            while not stop.is_set():
-                try:
-                    q.put(item, timeout=0.05)
-                    return True
-                except queue.Full:
-                    continue
-            return False
+        chan = _Channel(self.depth)
 
         def _work():
             try:
                 for k in plan:
-                    if not _offer((k, self.group.assemble_host(k))):
-                        return
-                _offer(_DONE)
+                    if not chan.put((k, self.group.assemble_host(k))):
+                        return  # consumer closed the channel mid-epoch
+                chan.put(_DONE)
             except BaseException as e:  # surfaced on the consumer side
-                _offer(_WorkerError(e))
+                chan.put(_WorkerError(e))
 
         worker = threading.Thread(target=_work, daemon=True,
                                   name="ff-prefetch")
         worker.start()
         try:
             while True:
-                depth_sample = q.qsize()
+                depth_sample = chan.depth()
                 t0 = time.perf_counter()
-                item = q.get()
+                item = chan.get()
                 wait = time.perf_counter() - t0
-                if item is _DONE:
+                if item is _DONE or item is _CLOSED:
                     return
                 if isinstance(item, _WorkerError):
                     raise item.exc
@@ -359,5 +407,8 @@ class Prefetcher:
                 k, host = item
                 yield k, self.group.place(host, k)
         finally:
-            stop.set()
+            # close-then-join: a worker blocked on a full channel wakes
+            # immediately (put returns False) — the generator can be
+            # abandoned mid-epoch without leaking its worker thread
+            chan.close()
             worker.join()

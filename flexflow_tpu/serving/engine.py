@@ -42,6 +42,11 @@ class _PyBatcher:
 
     def submit(self, request_id: int) -> None:
         with self._mu:
+            if self._closed:
+                # a request appended after close() would never be drained
+                # (the workers exit once the queue empties) — fail fast so
+                # the engine can re-submit to the re-armed batcher
+                raise RuntimeError("batcher is closed")
             self._q.append((request_id, time.monotonic()))
             self._mu.notify_all()
 
@@ -208,12 +213,28 @@ class InferenceEngine:
         self._ids = itertools.count()
         self._mu = threading.Lock()
         self._started = False
+        # True for the whole close/join/re-arm sequence of stop():
+        # _start_locked() no-ops while set, so a racing infer_async/start
+        # cannot respawn workers that stop() would then pop and whose
+        # batcher it would swap out from under them (requests submitted
+        # in the window retry and land in the re-armed batcher; the next
+        # infer after stop() spawns the workers that drain them)
+        self._stopping = False
 
     # ---- model repository --------------------------------------------------
+    # Locking discipline (checked statically by analysis/concurrency_check:
+    # CCY001/CCY006 treat _models/_batchers/_requests/_workers/_started as
+    # _mu-guarded): every read or write of the registry dicts holds _mu;
+    # worker join and batcher close/submit happen OUTSIDE _mu so a blocked
+    # thread can never stall the registry (CCY003).
     def register(self, instance: ModelInstance) -> None:
         """Register one instance. Repeated registrations under the same
         name form an instance group — their device sets must be disjoint
         (the placement invariant instance.cc enforces per group)."""
+        with self._mu:
+            self._register_locked(instance)
+
+    def _register_locked(self, instance: ModelInstance) -> None:
         group = self._models.get(instance.name)
         if group:
             # full spec check: a different-topology instance silently
@@ -328,13 +349,17 @@ class InferenceEngine:
                                devices=devices)
 
     def models(self) -> List[str]:
-        return list(self._models)
+        with self._mu:
+            return list(self._models)
 
     def instances(self, name: str) -> List[ModelInstance]:
-        return list(self._models[name])
+        with self._mu:
+            return list(self._models[name])
 
     # ---- lifecycle ---------------------------------------------------------
     def _spawn(self, name: str) -> None:
+        """Caller holds ``self._mu`` (a freshly started worker blocks on
+        the lock until the registry mutation completes)."""
         for idx in range(len(self._models[name])):
             if (name, idx) in self._workers:
                 continue
@@ -343,41 +368,79 @@ class InferenceEngine:
             self._workers[(name, idx)] = t
             t.start()
 
-    def start(self) -> None:
-        if self._started:
+    def _start_locked(self) -> None:
+        if self._started or self._stopping:
             return
         self._started = True
         for name in self._models:
             self._spawn(name)
 
+    def start(self) -> None:
+        with self._mu:
+            self._start_locked()
+
     def stop(self) -> None:
-        for b in self._batchers.values():
+        # snapshot under the lock; close() and join() run OUTSIDE it —
+        # joining a worker stuck in first-call XLA compilation while
+        # holding _mu would freeze every infer_async/register (CCY003)
+        with self._mu:
+            workers = dict(self._workers)
+            batchers = dict(self._batchers)
+            self._started = False
+            self._stopping = True
+        for b in batchers.values():
             b.close()
         still_alive = set()
-        for (name, idx), t in self._workers.items():
+        for (name, idx), t in workers.items():
             t.join(timeout=10)
             if t.is_alive():  # e.g. stuck in first-call XLA compilation
                 still_alive.add(name)
-        self._workers.clear()
-        self._started = False
         # closed batchers can't be reopened: re-arm each model with a fresh
         # queue so a later start()/infer() serves again instead of hanging.
         # A batcher whose worker didn't exit is LEAKED, not destroyed — the
         # worker may still call next_batch on it (freeing would be a
         # use-after-free on the native handle).
-        for name, b in list(self._batchers.items()):
-            if name not in still_alive:
-                b.destroy()
-            self._batchers[name] = _make_batcher(
-                self._models[name][0].batch_size, self.batch_timeout_s)
+        # workers joined, so nobody else drains a dead batcher: ids parked
+        # by a submit that raced the close (e.g. a second stop() destroying
+        # the batcher another infer_async just landed in) are collected
+        # here for a clean refusal instead of a future that hangs forever.
+        # Outside _mu — next_batch never blocks on a closed batcher, but
+        # it does take the batcher's own internal lock (CCY003). Nothing
+        # can re-fill a closed batcher: submit fails fast once closed.
+        leftover: Dict[str, List[int]] = {}
+        for name, b in batchers.items():
+            if name in still_alive:
+                continue
+            ids: List[int] = []
+            while True:
+                batch = b.next_batch()
+                if not batch:
+                    break
+                ids.extend(batch)
+            if ids:
+                leftover[name] = ids
+        with self._mu:
+            for key in workers:
+                self._workers.pop(key, None)
+            for name, b in batchers.items():
+                if name not in still_alive:
+                    for i in leftover.get(name, ()):
+                        req = self._requests[name].pop(i, None)
+                        if req is not None and not req.future.done():
+                            req.future.set_exception(
+                                RuntimeError("engine stopped"))
+                    b.destroy()
+                self._batchers[name] = _make_batcher(
+                    self._models[name][0].batch_size, self.batch_timeout_s)
+            self._stopping = False
 
     # ---- request path ------------------------------------------------------
     def infer_async(self, model: str, inputs: Sequence[np.ndarray]) -> Future:
         """Submit one request (arrays WITHOUT the batch dim). The future
         resolves to the model's per-request output array."""
-        if not self._started:
-            self.start()
-        inst = self._models[model][0]  # all group instances share the spec
+        with self._mu:
+            self._start_locked()
+            inst = self._models[model][0]  # all group instances share the spec
         # validate per-request shapes HERE so one malformed request fails
         # alone instead of poisoning every co-batched request
         if len(inputs) != inst.n_inputs:
@@ -391,13 +454,31 @@ class InferenceEngine:
                     f"{want}, got {np.shape(a)}")
         req = InferenceRequest(next(self._ids),
                                [np.asarray(a)[None, ...] for a in inputs])
-        with self._mu:
-            self._requests[model][req.request_id] = req
-        self._batchers[model].submit(req.request_id)
+        for attempt in range(64):
+            with self._mu:
+                batcher = self._batchers[model]
+                self._requests[model][req.request_id] = req
+            try:
+                batcher.submit(req.request_id)
+                break
+            except RuntimeError:
+                # a concurrent stop() closed this batcher between the
+                # registry read and the submit; un-register and retry
+                # against the re-armed batcher stop() installs
+                with self._mu:
+                    self._requests[model].pop(req.request_id, None)
+                time.sleep(0.005)
+        else:
+            raise RuntimeError(
+                f"{model!r}: batcher stayed closed across retries "
+                f"(engine is shutting down?)")
+        # the submit may have landed in a batcher re-armed by a concurrent
+        # stop() (which leaves the engine stopped): respawn the workers
+        # that drain it — no-op in the common already-started case
+        self.start()
         reg = metrics_registry()
         reg.counter("serving.requests").inc()
-        reg.histogram("serving.queue_depth").observe(
-            self._batchers[model].pending())
+        reg.histogram("serving.queue_depth").observe(batcher.pending())
         return req.future
 
     def infer(self, model: str, inputs: Sequence[np.ndarray],
@@ -406,8 +487,9 @@ class InferenceEngine:
 
     # ---- worker ------------------------------------------------------------
     def _worker(self, name: str, idx: int = 0) -> None:
-        inst = self._models[name][idx]
-        batcher = self._batchers[name]
+        with self._mu:
+            inst = self._models[name][idx]
+            batcher = self._batchers[name]
         reg = metrics_registry()
         while True:
             ids = batcher.next_batch()

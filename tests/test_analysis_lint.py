@@ -157,9 +157,11 @@ def test_lock_pragma_suppresses_hot003():
     assert lint_hotpath_source(src, "worker.py") == []
 
 
-def test_thread_rules_scoped_to_runtime_dir(tmp_path):
-    """serving/-style workers run device work by design; HOT002/003 only
-    apply under runtime/ (THREAD_RULE_DIRS)."""
+def test_thread_rules_apply_everywhere_via_role_model(tmp_path):
+    """PR 7 rebased HOT002/003 onto the concurrency auditor's thread-role
+    model: the old runtime/-only directory allowlist is gone, so a
+    serving/-style worker doing device work now fires exactly like a
+    runtime/ one (intentional device inference carries sync-ok pragmas)."""
     src = textwrap.dedent("""
         import threading
         import jax
@@ -174,8 +176,32 @@ def test_thread_rules_scoped_to_runtime_dir(tmp_path):
         os.makedirs(tmp_path / "pkg" / sub, exist_ok=True)
         (tmp_path / "pkg" / sub / "mod.py").write_text(src)
     findings = lint_hotpaths([str(tmp_path / "pkg")])
-    assert [f.code for f in findings] == ["HOT002"]
-    assert f"runtime{os.sep}mod.py" in findings[0].file
+    assert [f.code for f in findings] == ["HOT002", "HOT002"]
+    files = sorted(f.file for f in findings)
+    assert f"runtime{os.sep}mod.py" in files[0]
+    assert f"serving{os.sep}mod.py" in files[1]
+
+
+def test_function_shared_with_main_role_is_not_worker_scope():
+    """A helper called from BOTH the public surface and the worker is not
+    worker-only — the role model attributes it to both roles, so HOT002
+    does not misflag the dispatch thread's own device calls."""
+    src = textwrap.dedent("""
+        import threading
+        import jax
+
+        def _place(batch):
+            return jax.device_put(batch)
+
+        def serve(self):
+            def _work():
+                while True:
+                    self.q.put(self.assemble())
+            threading.Thread(target=_work).start()
+            for batch in self.q:
+                _place(batch)
+    """)
+    assert lint_hotpath_source(src, "f.py") == []
 
 
 # ----------------------------------------------------- tools round-trips

@@ -1,0 +1,630 @@
+"""Concurrency auditor tests (tier-1 gate).
+
+Seeded fixtures trip each CCY0xx rule, the matching correct idioms stay
+clean (negative controls), pragma suppressions follow the shared
+reason-required grammar, and the repo itself sweeps clean — the
+``make concurrency-lint`` gate, in-process."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+
+from flexflow_tpu.analysis.concurrency_check import (
+    build_package, check_package, check_source, module_worker_functions)
+
+PKG = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "flexflow_tpu")
+
+
+def _codes(src):
+    return [f.code for f in check_source(textwrap.dedent(src), "fix.py")]
+
+
+# ------------------------------------------------------- repo stays clean
+def test_repo_is_concurrency_clean():
+    """The ``make concurrency-lint`` gate, in-process: zero error
+    findings over the whole package. A new unguarded shared write, lock
+    cycle, or leaked thread fails tier-1 here."""
+    report = check_package([PKG])
+    assert not report.errors, "\n".join(f.format() for f in report.errors)
+    assert not report.warnings, \
+        "\n".join(f.format() for f in report.warnings)
+
+
+def test_repo_roles_cover_known_workers():
+    """The role inference finds the package's real worker threads: the
+    Prefetcher's ff-prefetch worker and serving's per-instance worker."""
+    report = check_package([PKG])
+    roles = getattr(report, "roles", {})
+    names = set(roles)
+    assert "main" in names
+    assert any("ff-prefetch" in r for r in names), sorted(names)
+    assert any("serving/engine.py" in r for r in names), sorted(names)
+    # every suppression that fired carries a reason (grammar-enforced)
+    assert getattr(report, "suppressed", 0) > 0
+
+
+def test_make_ci_runs_concurrency_lint():
+    mk = open(os.path.join(os.path.dirname(PKG), "Makefile")).read()
+    assert "\nconcurrency-lint:" in mk
+    ci_line = next(l for l in mk.splitlines() if l.startswith("ci:"))
+    assert "concurrency-lint" in ci_line
+
+
+# ------------------------------------------------- CCY001 shared mutation
+_CCY001 = """
+    import threading
+
+    class Pool:
+        def __init__(self):
+            self._mu = threading.Lock()
+            self.count = 0
+
+        def _work(self):
+            while True:
+                self.count += 1
+
+        def start(self):
+            t = threading.Thread(target=self._work)
+            t.start()
+            self.t = t
+
+        def stop(self):
+            self.t.join()
+
+        def read(self):
+            with self._mu:
+                return self.count
+"""
+
+
+def test_unguarded_shared_write_fires_ccy001():
+    codes = _codes(_CCY001)
+    assert "CCY001" in codes, codes
+
+
+def test_guarded_write_is_clean_control():
+    src = _CCY001.replace(
+        "            while True:\n                self.count += 1",
+        "            while True:\n                with self._mu:\n"
+        "                    self.count += 1")
+    codes = _codes(src)
+    assert "CCY001" not in codes, codes
+
+
+def test_race_ok_pragma_with_reason_suppresses_ccy001():
+    src = _CCY001.replace(
+        "self.count += 1",
+        "self.count += 1  # concurrency: race-ok (GIL-atomic test)")
+    assert "CCY001" not in _codes(src)
+
+
+def test_reasonless_pragma_does_not_suppress():
+    src = _CCY001.replace(
+        "self.count += 1",
+        "self.count += 1  # concurrency: race-ok")
+    assert "CCY001" in _codes(src)
+
+
+def test_unguarded_read_of_guarded_state_warns_ccy001():
+    src = textwrap.dedent("""
+        import threading
+
+        class Pool:
+            def __init__(self):
+                self._mu = threading.Lock()
+                self.count = 0
+
+            def _work(self):
+                while True:
+                    with self._mu:
+                        self.count += 1
+
+            def start(self):
+                self.t = threading.Thread(target=self._work)
+                self.t.start()
+
+            def stop(self):
+                self.t.join()
+
+            def peek(self):
+                return self.count
+    """)
+    findings = check_source(src, "fix.py")
+    reads = [f for f in findings if f.code == "CCY001"]
+    assert reads and all(f.severity == "warning" for f in reads), \
+        [f.format() for f in findings]
+
+
+def test_constructor_stores_are_not_shared_mutations():
+    """__init__ runs before the object is published to any thread."""
+    src = textwrap.dedent("""
+        import threading
+
+        class Pool:
+            def __init__(self):
+                self.count = 0
+                self.t = threading.Thread(target=self._work, daemon=True)
+                self.t.start()
+
+            def _work(self):
+                while not self.stop.is_set():
+                    with self.mu:
+                        self.count += 1
+    """)
+    codes = [f.code for f in check_source(src, "fix.py")]
+    assert "CCY001" not in codes, codes
+
+
+# ------------------------------------------------------ CCY002 ABBA cycle
+_CCY002 = """
+    import threading
+
+    class Transfer:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+
+        def forward(self):
+            with self._a:
+                with self._b:
+                    pass
+
+        def backward(self):
+            with self._b:
+                with self._a:
+                    pass
+"""
+
+
+def test_abba_two_lock_cycle_fires_ccy002():
+    codes = _codes(_CCY002)
+    assert "CCY002" in codes, codes
+
+
+def test_consistent_lock_order_is_clean_control():
+    src = _CCY002.replace(
+        "with self._b:\n                with self._a:",
+        "with self._a:\n                with self._b:")
+    assert "CCY002" not in _codes(src)
+
+
+def test_interprocedural_abba_cycle_fires_ccy002():
+    """One leg of the cycle crosses a call boundary: forward() holds A
+    and CALLS a helper that takes B, backward() nests B then A."""
+    src = """
+    import threading
+
+    class Transfer:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+
+        def _tail(self):
+            with self._b:
+                pass
+
+        def forward(self):
+            with self._a:
+                self._tail()
+
+        def backward(self):
+            with self._b:
+                with self._a:
+                    pass
+    """
+    codes = _codes(src)
+    assert "CCY002" in codes, codes
+
+
+# ------------------------------------------------ CCY003 blocking in lock
+def test_queue_get_under_lock_fires_ccy003():
+    src = """
+    import queue
+    import threading
+
+    class Stage:
+        def __init__(self):
+            self._mu = threading.Lock()
+            self._q = queue.Queue()
+
+        def pull(self):
+            with self._mu:
+                return self._q.get()
+    """
+    codes = _codes(src)
+    assert "CCY003" in codes, codes
+
+
+def test_join_under_lock_fires_ccy003():
+    src = """
+    import threading
+
+    class Stage:
+        def __init__(self):
+            self._mu = threading.Lock()
+            self._t = threading.Thread(target=self._run, daemon=True)
+
+        def _run(self):
+            pass
+
+        def stop(self):
+            with self._mu:
+                self._t.join()
+    """
+    codes = _codes(src)
+    assert "CCY003" in codes, codes
+
+
+def test_blocking_outside_lock_is_clean_control():
+    src = """
+    import queue
+    import threading
+
+    class Stage:
+        def __init__(self):
+            self._mu = threading.Lock()
+            self._q = queue.Queue()
+
+        def pull(self):
+            item = self._q.get()
+            with self._mu:
+                return item
+    """
+    assert "CCY003" not in _codes(src)
+
+
+def test_nonblocking_queue_get_is_clean():
+    src = """
+    import queue
+    import threading
+
+    class Stage:
+        def __init__(self):
+            self._mu = threading.Lock()
+            self._q = queue.Queue()
+
+        def pull(self):
+            with self._mu:
+                return self._q.get(block=False)
+    """
+    assert "CCY003" not in _codes(src)
+
+
+# ------------------------------------------- CCY004 Condition discipline
+_CCY004_WAIT_NO_LOOP = """
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._cv = threading.Condition()
+            self.ready = False
+
+        def get(self):
+            with self._cv:
+                if not self.ready:
+                    self._cv.wait()
+                return 1
+"""
+
+
+def test_wait_without_predicate_loop_fires_ccy004():
+    codes = _codes(_CCY004_WAIT_NO_LOOP)
+    assert "CCY004" in codes, codes
+
+
+def test_correct_condition_idiom_is_clean_control():
+    """The canonical `with cv: while not pred: cv.wait()` idiom plus
+    notify under the lock — the auditor must stay silent."""
+    src = """
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._cv = threading.Condition()
+            self.ready = False
+
+        def get(self):
+            with self._cv:
+                while not self.ready:
+                    self._cv.wait()
+                return 1
+
+        def put(self):
+            with self._cv:
+                self.ready = True
+                self._cv.notify_all()
+    """
+    findings = check_source(textwrap.dedent(src), "fix.py")
+    assert findings == [], [f.format() for f in findings]
+
+
+def test_wait_outside_lock_fires_ccy004():
+    src = """
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._cv = threading.Condition()
+
+        def get(self):
+            while True:
+                self._cv.wait()
+    """
+    codes = _codes(src)
+    assert "CCY004" in codes, codes
+
+
+def test_notify_outside_lock_fires_ccy004():
+    src = """
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._cv = threading.Condition()
+
+        def put(self):
+            self._cv.notify_all()
+    """
+    codes = _codes(src)
+    assert "CCY004" in codes, codes
+
+
+# ------------------------------------------------------ CCY005 thread leak
+def test_unjoined_nondaemon_thread_fires_ccy005():
+    src = """
+    import threading
+
+    def fire_and_forget(fn):
+        t = threading.Thread(target=fn)
+        t.start()
+    """
+    codes = _codes(src)
+    assert "CCY005" in codes, codes
+
+
+def test_joined_thread_is_clean_control():
+    src = """
+    import threading
+
+    class Pool:
+        def start(self):
+            self._t = threading.Thread(target=self._run, daemon=True)
+            self._t.start()
+
+        def _run(self):
+            pass
+
+        def stop(self):
+            self._t.join()
+    """
+    assert "CCY005" not in _codes(src)
+
+
+def test_daemon_with_stop_event_is_clean():
+    src = """
+    import threading
+
+    class Pool:
+        def __init__(self):
+            self._stop = threading.Event()
+
+        def start(self):
+            t = threading.Thread(target=self._run, daemon=True)
+            t.start()
+
+        def _run(self):
+            while not self._stop.is_set():
+                pass
+    """
+    assert "CCY005" not in _codes(src)
+
+
+def test_worker_pool_container_join_is_clean():
+    """The engine's exact pattern: threads parked in a dict keyed by
+    (name, idx), joined by iterating the dict elsewhere."""
+    src = """
+    import threading
+
+    class Pool:
+        def __init__(self):
+            self._workers = {}
+
+        def start(self, n):
+            for i in range(n):
+                t = threading.Thread(target=self._run, daemon=True)
+                self._workers[i] = t
+                t.start()
+
+        def _run(self):
+            pass
+
+        def stop(self):
+            for i, t in self._workers.items():
+                t.join()
+    """
+    assert "CCY005" not in _codes(src)
+
+
+# ------------------------------------------- CCY006 guarded-by consistency
+def test_inconsistent_guard_fires_ccy006():
+    src = """
+    import threading
+
+    class Split:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+            self.state = 0
+
+        def one(self):
+            with self._a:
+                self.state = 1
+
+        def two(self):
+            with self._b:
+                self.state = 2
+    """
+    codes = _codes(src)
+    assert "CCY006" in codes, codes
+
+
+def test_single_guard_everywhere_is_clean_control():
+    src = """
+    import threading
+
+    class Split:
+        def __init__(self):
+            self._a = threading.Lock()
+            self.state = 0
+
+        def one(self):
+            with self._a:
+                self.state = 1
+
+        def two(self):
+            with self._a:
+                self.state = 2
+    """
+    assert "CCY006" not in _codes(src)
+
+
+# ------------------------------------------------- role model + worker API
+def test_module_worker_functions_finds_worker_only_closure():
+    src = textwrap.dedent("""
+        import threading
+
+        def start(self):
+            def _work():
+                while True:
+                    self.q.get()
+            threading.Thread(target=_work, daemon=True).start()
+    """)
+    workers = module_worker_functions(src, "mod.py")
+    names = sorted(getattr(n, "name", "<lambda>") for n, _ in workers)
+    assert names == ["_work"], names
+
+
+def test_shared_helper_is_not_worker_only():
+    src = textwrap.dedent("""
+        import threading
+
+        def helper():
+            return 1
+
+        def start(self):
+            def _work():
+                helper()
+            threading.Thread(target=_work).start()
+            helper()
+    """)
+    names = [getattr(n, "name", "<lambda>")
+             for n, _ in module_worker_functions(src, "mod.py")]
+    assert "helper" not in names and "_work" in names
+
+
+def test_build_package_resolves_cross_module_roles(tmp_path):
+    """A spawn in one module whose target is imported from another: the
+    role must span both files (relative imports inside the package)."""
+    pkg = tmp_path / "pkg"
+    os.makedirs(pkg)
+    (pkg / "__init__.py").write_text("")
+    (pkg / "work.py").write_text(textwrap.dedent("""
+        def run_forever(state):
+            while True:
+                state.n += 1
+    """))
+    (pkg / "boot.py").write_text(textwrap.dedent("""
+        import threading
+
+        from .work import run_forever
+
+        def launch(state):
+            t = threading.Thread(target=run_forever, args=(state,))
+            t.start()
+            return t
+    """))
+    p = build_package([str(pkg)])
+    worker_roles = [r for r in p.roles if r != "main"]
+    assert worker_roles, sorted(p.roles)
+    fns = set().union(*(p.roles[r] for r in worker_roles))
+    assert any("work.py::run_forever" in q for q in fns), sorted(fns)
+
+
+# ------------------------------------------------------------- tool smoke
+def test_concurrency_lint_tool_emits_one_json_line(tmp_path):
+    out = tmp_path / "ccy.json"
+    tool = os.path.join(os.path.dirname(PKG), "tools",
+                        "concurrency_lint.py")
+    r = subprocess.run(
+        [sys.executable, tool, PKG, "--out", str(out)],
+        capture_output=True, text=True, timeout=240)
+    assert r.returncode == 0, r.stdout + r.stderr
+    lines = r.stdout.strip().splitlines()
+    assert len(lines) == 1, r.stdout
+    doc = json.loads(lines[0])
+    assert doc["exit"] == 0 and doc["errors"] == 0
+    assert doc["n_roles"] >= 3 and doc["n_functions"] > 0
+    assert doc["reasonless"] == []
+    assert "CCY001" in doc["codes"] and "CCY006" in doc["codes"]
+    assert doc["runtime_s"] > 0
+    assert json.loads(out.read_text())["exit"] == 0
+
+
+def test_reasonless_pragma_fails_the_tool_gate(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        import threading
+
+        class C:
+            def __init__(self):
+                self.n = 0
+
+            def _work(self):
+                self.n += 1  # concurrency: race-ok
+
+            def start(self):
+                self.t = threading.Thread(target=self._work)
+                self.t.start()
+
+            def stop(self):
+                self.t.join()
+
+            def value(self):
+                return self.n
+    """))
+    tool = os.path.join(os.path.dirname(PKG), "tools",
+                        "concurrency_lint.py")
+    r = subprocess.run(
+        [sys.executable, tool, str(bad)],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 1, r.stdout + r.stderr
+    doc = json.loads(r.stdout.strip().splitlines()[-1])
+    # the decorative pragma is flagged AND the finding still fires
+    assert doc["reasonless"], doc
+    assert doc["errors"] >= 1
+
+
+# --------------------------------------------------------- gate semantics
+def test_report_error_class_and_tag():
+    from flexflow_tpu.analysis.findings import ConcurrencyAuditError
+
+    report = check_package([PKG])
+    assert report.tag == "concurrency"
+    report.add("CCY001", "synthetic", severity="error", file="x.py", line=1)
+    try:
+        report.handle("error")
+    except ConcurrencyAuditError as e:
+        assert "CCY001" in str(e)
+    else:
+        raise AssertionError("handle('error') did not raise")
+
+
+def test_syntax_error_module_reports_ccy000(tmp_path):
+    bad = tmp_path / "broken.py"
+    bad.write_text("def oops(:\n")
+    report = check_package([str(tmp_path)])
+    assert [f.code for f in report.findings] == ["CCY000"]

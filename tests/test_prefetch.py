@@ -253,3 +253,94 @@ def test_recompile_check_interval_throttles_metric_sync():
     assert [m is None for _, m in seen[:2]] == [True, True]
     assert seen[2][1] is not None and seen[5][1] is not None
     assert seen[3][1] == seen[2][1] and seen[4][1] == seen[2][1]
+
+
+# ------------------------------------------- shutdown handshake (PR 7 fix)
+def test_channel_close_wakes_blocked_producer():
+    """The Prefetcher shutdown race: a worker blocked on a FULL buffer
+    must observe consumer abandonment immediately (the old Event-polling
+    handshake woke only at the next 50ms tick). close() wakes the
+    blocked put(), which returns False as the stop signal."""
+    import threading
+    import time
+
+    from flexflow_tpu.runtime.dataloader import _CLOSED, _Channel
+
+    chan = _Channel(capacity=1)
+    assert chan.put("a") is True  # buffer now full
+    results = []
+
+    def producer():
+        results.append(chan.put("b"))  # blocks until close()
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    time.sleep(0.05)
+    assert t.is_alive()  # genuinely blocked on the full buffer
+    t0 = time.perf_counter()
+    chan.close()
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert time.perf_counter() - t0 < 1.0  # deterministic wakeup, no poll
+    assert results == [False]
+    # consumer drains the buffered item, then sees the closed sentinel
+    assert chan.get() == "a"
+    assert chan.get() is _CLOSED
+
+
+def test_channel_get_unblocks_on_close():
+    import threading
+    import time
+
+    from flexflow_tpu.runtime.dataloader import _CLOSED, _Channel
+
+    chan = _Channel(capacity=2)
+    got = []
+    t = threading.Thread(target=lambda: got.append(chan.get()), daemon=True)
+    t.start()
+    time.sleep(0.05)
+    chan.close()
+    t.join(timeout=5)
+    assert got == [_CLOSED]
+
+
+def test_prefetcher_abandoned_mid_epoch_reclaims_worker():
+    """Abandoning the epoch generator while the worker is blocked on a
+    full queue must join the worker, not leak it (the CCY005/shutdown
+    finding the concurrency auditor surfaced)."""
+    import threading
+
+    x, y = _toy(n=512)
+    group = DataLoaderGroup([SingleDataLoader(x, 64),
+                             SingleDataLoader(y, 64)], seed=0, shuffle=False)
+    pf = Prefetcher(group, depth=1)
+    it = pf.epoch()
+    next(it)  # worker running; with depth=1 it blocks on the full channel
+    it.close()  # generator finally: close channel + join worker
+    assert not any(t.name == "ff-prefetch" and t.is_alive()
+                   for t in threading.enumerate())
+
+
+def test_prefetcher_stream_identical_after_abandonment():
+    """Behavior-preservation check for the channel rewrite: an abandoned
+    epoch leaves the loader able to produce the exact serial stream.
+    The abandoned epoch consumes one reshuffle draw (epoch() reshuffles
+    at generator start), so the two epochs after abandonment must equal
+    serial epochs 2-3 of a 3-epoch run."""
+    x, y = _toy(n=320)
+    args = ([x, y], 64, 3, True)
+    per_epoch = 320 // 64
+    serial = _collect(args, 0, epochs=3)[per_epoch:]
+
+    arrays, bs, seed, shuffle = args
+    group = DataLoaderGroup(
+        [SingleDataLoader(a, bs) for a in arrays], seed=seed, shuffle=shuffle)
+    pf = Prefetcher(group, depth=2)
+    it = pf.epoch()
+    next(it)
+    it.close()  # abandon mid-epoch
+    out = []
+    for _ in range(2):
+        for nk, batch in pf.epoch():
+            out.append((nk, [np.asarray(b) for b in batch]))
+    _assert_same_stream(serial, out)

@@ -271,3 +271,100 @@ def test_repository_config_file(tmp_path):
     out = eng.infer("clf", [np.zeros(12, np.float32)], timeout=120)
     assert out.shape == (3,)
     eng.stop()
+
+
+# ------------------------------------------ shutdown/submit race (PR 7 fix)
+@pytest.mark.parametrize("factory", [
+    pytest.param(lambda mb, to: _PyBatcher(mb, to), id="python"),
+    pytest.param(lambda mb, to: _make_batcher(mb, to), id="default"),
+])
+def test_batcher_submit_after_close_raises(factory):
+    """A request appended after close() would never be drained (workers
+    exit once the queue empties) — BOTH batcher implementations fail fast
+    instead of silently losing the request (the native wrapper guards its
+    handle so the engine's stop()-race retry path works there too)."""
+    b = factory(4, 0.005)
+    b.submit(1)
+    b.close()
+    with pytest.raises(RuntimeError):
+        b.submit(2)
+    # already-queued ids still drain after close
+    assert b.next_batch() == [1]
+    assert b.next_batch() is None
+    # destroy is atomic + idempotent; a stale reference cannot reach a
+    # freed handle afterwards
+    b.destroy()
+    b.destroy()
+    assert b.pending() == 0
+
+
+def test_engine_stop_concurrent_with_submissions():
+    """stop() racing a burst of infer_async() calls: no request may hang
+    or hit a KeyError — each lands in the re-armed batcher via the retry
+    path and resolves once the engine serves again (the shutdown race the
+    concurrency auditor's CCY findings drove out of the engine)."""
+    ff = _build_classifier(batch=4, d=6, classes=2)
+    eng = InferenceEngine(batch_timeout_s=0.002)
+    eng.register_ffmodel(ff, name="m")
+    expected = eng.infer("m", [np.zeros(6, np.float32)], timeout=60)
+
+    futures = []
+    errors = []
+
+    def burst():
+        for _ in range(12):
+            try:
+                futures.append(
+                    eng.infer_async("m", [np.zeros(6, np.float32)]))
+            except RuntimeError as e:  # clean shutdown refusal is ok
+                errors.append(e)
+            time.sleep(0.001)
+
+    t = threading.Thread(target=burst)
+    t.start()
+    time.sleep(0.01)
+    eng.stop()  # races the burst
+    t.join(timeout=30)
+    assert not t.is_alive()
+    # restart the engine: workers drain anything the race parked in the
+    # re-armed batcher, so EVERY accepted future resolves
+    final = eng.infer("m", [np.zeros(6, np.float32)], timeout=60)
+    np.testing.assert_allclose(final, expected)
+    for f in futures:
+        np.testing.assert_allclose(f.result(timeout=60), expected)
+    assert len(futures) + len(errors) == 12
+    eng.stop()
+
+
+def test_engine_registry_accessors_after_stop():
+    """models()/instances() take the engine lock (CCY001 fix) — they must
+    not deadlock against lifecycle transitions."""
+    ff = _build_classifier(batch=4, d=6, classes=2)
+    eng = InferenceEngine()
+    eng.register_ffmodel(ff, name="m")
+    eng.start()
+    assert eng.models() == ["m"]
+    eng.stop()
+    assert eng.models() == ["m"]
+    assert len(eng.instances("m")) == 1
+
+
+def test_stop_fails_parked_requests_cleanly():
+    """A request parked in a batcher that stop() destroys (the
+    double-stop / racing-submit window: workers already joined, nobody
+    will ever drain it) gets a clean RuntimeError on its future instead
+    of hanging forever."""
+    from flexflow_tpu.serving.engine import InferenceRequest
+
+    ff = _build_classifier(batch=4, d=6, classes=2)
+    eng = InferenceEngine()
+    eng.register_ffmodel(ff, name="m")
+    # park a request without starting workers — exactly the state the
+    # race leaves behind
+    req = InferenceRequest(0, [np.zeros((1, 6), np.float32)])
+    with eng._mu:
+        eng._requests["m"][0] = req
+    eng._batchers["m"].submit(0)
+    eng.stop()
+    with pytest.raises(RuntimeError, match="engine stopped"):
+        req.future.result(timeout=5)
