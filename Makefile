@@ -32,15 +32,26 @@
 #   make obs-report — flight-recorder smoke (obs/): traced pipelined fit
 #                     + serving requests -> one JSON line with the trace
 #                     event counts (schema-validated), the metrics
-#                     snapshot, and the sim-vs-measured divergence block
+#                     snapshot, the sim-vs-measured divergence block,
+#                     the run-ledger corpus stats, the XLA executable
+#                     telemetry (flops/bytes/peak memory per program),
+#                     and the watchdog state (zero dumps on health)
+#   make sentinel — perf regression tripwire over the run ledger: newest
+#                   run vs the per-(model, mesh, knobs) cohort baseline
+#                   (median of priors); one JSON line incl. ledger /
+#                   exec-telemetry / watchdog blocks; exit 1 on a
+#                   regression beyond the margin
 
 PY ?= python
 CPU_MESH = JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
 .PHONY: ci native native-check lint concurrency-lint pcg-lint audit \
-        test dryrun bench bench-fit bench-pipe obs-report
+        test dryrun bench bench-fit bench-pipe obs-report sentinel
 
-ci: native native-check lint concurrency-lint test dryrun obs-report audit
+# sentinel runs AFTER obs-report so a fresh checkout's first ci already
+# has ledger records to judge (first run: no baseline -> clean exit)
+ci: native native-check lint concurrency-lint test dryrun obs-report \
+    sentinel audit
 
 lint:
 	$(PY) -c "from flexflow_tpu.analysis.hotpath_lint import main; \
@@ -80,3 +91,6 @@ bench-pipe:
 
 obs-report:
 	$(CPU_MESH) $(PY) tools/obs_report.py
+
+sentinel:
+	$(CPU_MESH) $(PY) tools/perf_sentinel.py

@@ -66,11 +66,17 @@ CODE_CATALOG: Dict[str, str] = {
                "maps to a trivial (size-1/absent) mesh axis",
     "LINT003": "float-to-float cast in the step graph (mixed-precision "
                "boundary cast in the hot loop)",
-    # flight recorder (obs/divergence.py) — runtime, not compile-time
+    # flight recorder (obs/) — runtime, not compile-time
     "OBS001": "sim-vs-measured divergence: the measured step time missed "
               "the cost model's end-to-end prediction by more than "
               "config.divergence_threshold — the model steering the "
               "search no longer matches this machine (warning)",
+    "OBS002": "static-vs-XLA peak-memory divergence: the program audit's "
+              "static liveness estimate and the compiled executable's "
+              "XLA-reported peak memory disagree by more than "
+              "config.exec_mem_threshold — the liveness model steering "
+              "memory-aware decisions no longer matches the allocator "
+              "(warning; suppressible only with a reasoned allow entry)",
     "PCG016": "non-positive tensor dimension: a declared shape has a "
               "dim <= 0 (e.g. a conv/pool window larger than its input "
               "— the size formula goes negative and downstream sizes "

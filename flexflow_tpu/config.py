@@ -160,6 +160,47 @@ class FFConfig:
     # |measured/predicted - 1| beyond which the OBS001 warn finding
     # fires (1.0 = within 2x either way tolerated)
     divergence_threshold: float = 1.0
+    # --- durable observability (obs/ledger, exec_telemetry, watchdog) -----
+    # run ledger (obs/ledger.py): "on" (default) appends one schema-
+    # versioned JSONL record per compile/fit/eval/serving/bench run to
+    # ledger_dir — the durable corpus the divergence flywheel and
+    # tools/perf_sentinel.py read; "off" disables all appends.
+    ledger: str = "on"
+    # None = unset: resolution is explicit knob > FLEXFLOW_TPU_LEDGER_DIR
+    # env > .ffcache/obs/runs (obs/ledger.ledger_dir) — so a config that
+    # never touched the knob and a config-less reader (tools) agree on
+    # the directory even under the env override
+    ledger_dir: Optional[str] = None
+    # executable telemetry (obs/exec_telemetry.py): "on" pulls XLA's
+    # cost_analysis()/memory_analysis() off every compiled step
+    # executable at compile time (flops/bytes/peak memory per program,
+    # into the ledger + exec.* metrics) and reconciles the XLA peak
+    # against the program audit's static liveness estimate (OBS002,
+    # warn, past exec_mem_threshold). Opt-in ("off" default): the
+    # ahead-of-time compile the analyses hang off is NOT shared with
+    # the dispatch path's executable cache, so "on" pays one extra XLA
+    # compile per program — a profiling-run cost, not an inner-loop one.
+    exec_telemetry: str = "off"
+    # symmetric peak-memory divergence (max(r, 1/r) - 1 for
+    # r = xla_peak/static_peak) tolerated before OBS002; 3.0 = within 4x
+    # in either direction (the two models count different things —
+    # static prices every intermediate at full aval size, XLA's
+    # allocator reuses and fuses buffers — so only order-level drift is
+    # signal)
+    exec_mem_threshold: float = 3.0
+    # program name -> REASON for waiving OBS002 on a known-divergent
+    # program (the pragma contract: an empty reason does not suppress)
+    exec_mem_allow: Optional[dict] = None
+    # stall watchdog (obs/watchdog.py): "on" arms a daemon thread fed
+    # heartbeats by the fit/eval dispatch loops, the Prefetcher worker,
+    # and serving workers; a watched source silent past
+    # watchdog_threshold_s — or a fatal signal — writes a black-box
+    # dump (all thread stacks, tracer ring tail, metrics snapshot, last
+    # ledger record) to watchdog_dir. "off" (default) costs one flag
+    # check per heartbeat site.
+    watchdog: str = "off"
+    watchdog_threshold_s: float = 60.0
+    watchdog_dir: str = ".ffcache/obs/blackbox"
     # numerics
     computation_mode: CompMode = CompMode.TRAINING
     # mixed precision: "bfloat16" runs activations/matmuls in bf16 on the
@@ -297,6 +338,20 @@ class FFConfig:
                 cfg.divergence = _next()
             elif a == "--divergence-threshold":
                 cfg.divergence_threshold = float(_next())
+            elif a == "--ledger":
+                cfg.ledger = _next()
+            elif a == "--ledger-dir":
+                cfg.ledger_dir = _next()
+            elif a == "--exec-telemetry":
+                cfg.exec_telemetry = "on"
+            elif a == "--exec-mem-threshold":
+                cfg.exec_mem_threshold = float(_next())
+            elif a == "--watchdog":
+                cfg.watchdog = "on"
+            elif a == "--watchdog-threshold":
+                cfg.watchdog_threshold_s = float(_next())
+            elif a == "--watchdog-dir":
+                cfg.watchdog_dir = _next()
             elif a == "--print-freq":
                 cfg.print_freq = int(_next())
             elif a == "--adoption-margin":

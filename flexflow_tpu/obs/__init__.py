@@ -22,6 +22,24 @@ Model for Tensor Processing Units", arXiv:2008.01040, and FlexFlow's
   and raising the coded finding OBS001 (warn) past a configurable
   threshold.
 
+Plus the DURABLE half (telemetry that outlives the process):
+
+* :mod:`.ledger` — **run ledger**: every compile/fit/eval/serving/bench
+  run appends a schema-versioned JSONL record to ``.ffcache/obs/runs/``
+  (machine fingerprint, knobs, search/cache outcome, throughput,
+  divergence, metrics snapshot) with load/filter/merge APIs — the
+  corpus the learned-cost-model flywheel and ``tools/perf_sentinel.py``
+  read.
+* :mod:`.exec_telemetry` — **XLA executable telemetry**: per-program
+  ``cost_analysis()``/``memory_analysis()`` (flops, bytes accessed,
+  peak memory) recorded into the ledger and ``exec.*`` metrics, with
+  the static-vs-XLA peak-memory reconciliation (OBS002, warn).
+* :mod:`.watchdog` — **stall watchdog**: an opt-in daemon monitoring
+  heartbeats from the fit loop, the Prefetcher worker, and serving
+  workers; a silent source past the threshold (or a fatal signal)
+  writes a black-box dump — thread stacks, tracer ring, metrics
+  snapshot, last ledger record — to ``.ffcache/obs/blackbox/``.
+
 ``runtime/profiling.py`` is the façade re-exporting this module's
 public surface next to the historical profiling exports;
 ``tools/obs_report.py`` renders the one-line JSON summary.
@@ -48,4 +66,24 @@ from .divergence import (  # noqa: F401
     maybe_record_divergence,
     predicted_step_time,
     record_divergence,
+)
+from .ledger import (  # noqa: F401
+    LEDGER_SCHEMA,
+    cohort_key,
+    last_record,
+    ledger_dir,
+    load_runs,
+    merge_runs,
+    record_run,
+    scan_ledger,
+)
+from .exec_telemetry import (  # noqa: F401
+    collect_traced,
+    reconcile_peak_memory,
+    telemetry_mode,
+)
+from .watchdog import (  # noqa: F401
+    Watchdog,
+    configure_watchdog,
+    watchdog,
 )

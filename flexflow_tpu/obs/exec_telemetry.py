@@ -1,0 +1,238 @@
+"""Executable telemetry: what XLA itself reports about compiled programs.
+
+Nothing in the repo ever read what the backend says about an executable
+— ``cost_analysis()`` (FLOPs, bytes accessed) and ``memory_analysis()``
+(argument/output/temp/alias bytes) — so the program audit's static
+peak-live estimate (analysis/program_audit.py) was never reconciled
+against ground truth. This module pulls both analyses off every
+compiled step executable (train/eval step, each pipeline schedule
+program, the serving decode step), records flops/bytes/peak-memory per
+program into the ledger and ``exec.*`` metrics, and compares the
+XLA-reported peak against the static liveness estimate: divergence past
+``config.exec_mem_threshold`` emits the coded finding **OBS002** (warn)
+through :mod:`..analysis.findings` — a liveness model that drifts from
+the allocator's reality mis-prices every memory-aware search decision.
+
+Costs and gating: the analyses hang off a COMPILED executable, and the
+ahead-of-time ``lower().compile()`` is *not* shared with the dispatch
+path's executable cache (measured on this jax: a full second XLA
+compile), so collection is **opt-in** — ``config.exec_telemetry="on"``
+/ ``--exec-telemetry`` (default ``"off"``). Backends that do not
+implement an analysis degrade to an explicit ``{"unavailable": reason}``
+block instead of guessing.
+
+OBS002 suppression follows the shared pragma contract
+(analysis/pragmas.py): an ``allow`` entry maps a program name to a
+REASON, and an empty/missing reason does not suppress — a decorative
+waiver cannot silently rot.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+from .metrics import metrics_registry
+from .trace import span
+
+# symmetric divergence (max(r, 1/r) - 1 for r = xla/static) tolerated
+# before OBS002 when config carries no threshold (3.0 = within 4x in
+# EITHER direction: the two models count different things — the static
+# walk prices every intermediate at full aval size, XLA's allocator
+# reuses and fuses buffers — so only order-level drift is signal)
+DEFAULT_MEM_THRESHOLD = 3.0
+
+
+def telemetry_mode(config) -> str:
+    """The validated ``config.exec_telemetry`` mode (typo fails at
+    compile entry, the mode-knob convention)."""
+    mode = getattr(config, "exec_telemetry", "off") or "off"
+    if mode not in ("on", "off"):
+        raise ValueError(
+            f"exec_telemetry={mode!r}: expected 'on' or 'off'")
+    return mode
+
+
+def _cost_block(compiled) -> Dict:
+    """flops / bytes-accessed from ``cost_analysis()`` (versions return
+    a dict or a one-element list of dicts)."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception as e:  # noqa: BLE001 — backend-optional API
+        return {"unavailable": f"cost_analysis: {type(e).__name__}: {e}"}
+    props = ca[0] if isinstance(ca, (list, tuple)) and ca else ca
+    if not isinstance(props, dict) or not props:
+        return {"unavailable": "cost_analysis returned no properties"}
+    out: Dict = {}
+    if "flops" in props:
+        out["flops"] = float(props["flops"])
+    if "bytes accessed" in props:
+        out["bytes_accessed"] = float(props["bytes accessed"])
+    return out or {"unavailable": "cost_analysis lacks flops/bytes keys"}
+
+
+def _memory_block(compiled) -> Dict:
+    """Byte accounting from ``memory_analysis()``; ``peak_bytes`` is the
+    arguments + outputs + XLA temp allocations minus donated aliases —
+    the executable's resident working set, the quantity the static
+    liveness walk estimates."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception as e:  # noqa: BLE001 — backend-optional API
+        return {"unavailable": f"memory_analysis: {type(e).__name__}: {e}"}
+    if ma is None:
+        return {"unavailable": "backend reports no compiled memory stats"}
+    try:
+        arg = int(ma.argument_size_in_bytes)
+        outb = int(ma.output_size_in_bytes)
+        temp = int(ma.temp_size_in_bytes)
+        alias = int(ma.alias_size_in_bytes)
+    except Exception as e:  # noqa: BLE001 — stats object shape drift
+        return {"unavailable": f"memory stats unreadable: {e}"}
+    return {
+        "argument_bytes": arg,
+        "output_bytes": outb,
+        "temp_bytes": temp,
+        "alias_bytes": alias,
+        "generated_code_bytes": int(
+            getattr(ma, "generated_code_size_in_bytes", 0)),
+        "peak_bytes": max(0, arg + outb + temp - alias),
+    }
+
+
+def collect_traced(name: str, traced) -> Dict:
+    """Lower + compile one ``jax.stages.Traced`` and extract both
+    analyses. Every failure mode lands as an explicit ``unavailable``
+    reason — never an exception into the compile path."""
+    t0 = time.perf_counter()
+    try:
+        with span("obs.exec_compile", cat="obs", program=name):
+            compiled = traced.lower().compile()
+    except Exception as e:  # noqa: BLE001 — telemetry never masks compile
+        return {"unavailable":
+                f"lower/compile failed: {type(e).__name__}: {e}"}
+    out: Dict = {"compile_s": round(time.perf_counter() - t0, 6)}
+    out.update(_cost_block(compiled))
+    mem = _memory_block(compiled)
+    if "unavailable" in mem:
+        out["memory"] = mem
+    else:
+        out.update(mem)
+    return out
+
+
+def _feed_metrics(name: str, tel: Dict) -> None:
+    reg = metrics_registry()
+    if "unavailable" in tel:
+        reg.counter("exec.unavailable").inc()
+        return
+    reg.counter("exec.programs").inc()
+    for key, series in (("flops", "flops"),
+                        ("bytes_accessed", "bytes_accessed"),
+                        ("peak_bytes", "peak_bytes")):
+        if key in tel:
+            reg.gauge(f"exec.{name}.{series}").set(float(tel[key]))
+
+
+# --------------------------------------------------- OBS002 reconciliation
+def reconcile_peak_memory(name: str, static_bytes, xla_bytes, *,
+                          config=None,
+                          allow: Optional[Dict[str, str]] = None,
+                          printer=print) -> Dict:
+    """Compare the audit's static peak-live estimate against the
+    XLA-reported peak for one program. Returns the reconciliation row;
+    past ``config.exec_mem_threshold`` it carries the OBS002 finding
+    (warn — printed, mirrored to ``exec.obs002_findings``).
+
+    ``allow``: program name -> reason. Only a NON-EMPTY reason
+    suppresses (the pragma contract); a suppressed row records the
+    reason instead of the finding."""
+    row: Dict = {"program": name}
+    if not static_bytes or not xla_bytes or static_bytes <= 0 \
+            or xla_bytes <= 0:
+        row["unavailable"] = "no static estimate or no XLA peak to compare"
+        return row
+    ratio = float(xla_bytes) / float(static_bytes)
+    divergence = max(ratio, 1.0 / ratio) - 1.0  # symmetric in direction
+    thr = getattr(config, "exec_mem_threshold", None)
+    thr = DEFAULT_MEM_THRESHOLD if thr is None else float(thr)
+    row.update({"static_peak_bytes": int(static_bytes),
+                "xla_peak_bytes": int(xla_bytes),
+                "ratio": round(ratio, 4),
+                "divergence": round(divergence, 4), "threshold": thr})
+    if divergence <= thr:
+        return row
+    reason = (allow or {}).get(name)
+    if reason:  # reason REQUIRED to suppress — empty string does not
+        row["suppressed"] = reason
+        return row
+    from ..analysis.findings import ValidationReport
+
+    report = ValidationReport(source="exec_telemetry", tag="obs")
+    f = report.add(
+        "OBS002",
+        f"program '{name}': XLA-reported peak memory "
+        f"{int(xla_bytes)}B diverges from the static liveness estimate "
+        f"{int(static_bytes)}B (ratio {ratio:.3f}, divergence "
+        f"{divergence:.3f} > threshold {thr}) — the liveness model "
+        f"steering memory-aware decisions no longer matches the "
+        f"allocator",
+        severity="warning")
+    printer(f"[obs] {f.format()}", flush=True)
+    metrics_registry().counter("exec.obs002_findings").inc()
+    row["finding"] = f.to_dict()
+    return row
+
+
+# ------------------------------------------------------------ entry points
+def collect_compiled_model(cm, *, config=None, skip=(),
+                           static_peaks: Optional[Dict[str, Any]] = None,
+                           allow: Optional[Dict[str, str]] = None) -> Dict:
+    """Telemetry for every program a CompiledModel exposes through its
+    ``audit_exec`` specs (minus ``skip`` — never-dispatched programs).
+    Returns ``{"programs": {name: block}, "reconciliation": [rows]}``;
+    blocks degrade to ``{"unavailable": reason}`` individually."""
+    programs: Dict[str, Dict] = {}
+    rows = []
+    for spec in (getattr(cm, "audit_exec", None) or []):
+        if spec.name in skip:
+            continue
+        try:
+            traced = spec.fn.trace(*spec.args)
+        except Exception as e:  # noqa: BLE001 — never masks compile
+            programs[spec.name] = {
+                "unavailable": f"trace failed: {type(e).__name__}: {e}"}
+            _feed_metrics(spec.name, programs[spec.name])
+            continue
+        tel = collect_traced(spec.name, traced)
+        programs[spec.name] = tel
+        _feed_metrics(spec.name, tel)
+        static = (static_peaks or {}).get(spec.name)
+        if "peak_bytes" in tel:
+            rows.append(reconcile_peak_memory(
+                spec.name, static, tel["peak_bytes"], config=config,
+                allow=allow))
+    out: Dict = {"programs": programs}
+    if rows:
+        out["reconciliation"] = rows
+    return out
+
+
+def collect_one(name: str, traced, *, config=None, static_peak=None,
+                allow: Optional[Dict[str, str]] = None) -> Dict:
+    """Single-program variant for the pipeline engine and the serving
+    decode step (they own their traces)."""
+    tel = collect_traced(name, traced)
+    _feed_metrics(name, tel)
+    out: Dict = {"programs": {name: tel}}
+    if "peak_bytes" in tel:
+        out["reconciliation"] = [reconcile_peak_memory(
+            name, static_peak, tel["peak_bytes"], config=config,
+            allow=allow)]
+    return out
+
+
+__all__ = [
+    "DEFAULT_MEM_THRESHOLD", "collect_compiled_model", "collect_one",
+    "collect_traced", "reconcile_peak_memory", "telemetry_mode",
+]

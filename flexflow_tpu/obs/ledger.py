@@ -1,0 +1,399 @@
+"""Run ledger: durable, append-only, per-run telemetry records.
+
+The flight recorder (tracer/metrics/divergence) sees ONE process for ONE
+run and then forgets everything. The ledger is the durable half: every
+``compile()``, ``fit()``/``eval()``, serving session, and bench-tool run
+appends one schema-versioned JSON line to ``.ffcache/obs/runs/`` —
+machine fingerprint, config knobs, search/cache outcome, epoch
+throughput, divergence block, serving percentiles, full metrics
+snapshot — so telemetry accumulates across processes and time. That
+corpus is what the ROADMAP's learned cost model (arXiv:2008.01040
+trains on exactly this kind of measured-program record) and
+``tools/perf_sentinel.py``'s regression baseline read.
+
+Design constraints:
+
+* **append-only JSONL, one file per process** — no file ever rewritten,
+  concurrent processes never share a file handle, and a corrupt line
+  (truncated by a crash mid-append) costs that line only:
+  :func:`scan_ledger` skips it and counts it.
+* **never throws into the workload** — :func:`record_run` catches
+  everything and counts failures on ``ledger.errors``; a full disk must
+  not kill a training run.
+* **schema-versioned** — every record carries ``schema`` =
+  :data:`LEDGER_SCHEMA`; readers filter on it instead of guessing.
+
+Gating: ``config.ledger`` is ``"on"`` (default — the corpus only exists
+if it accumulates) or ``"off"``; ``config.ledger_dir`` /
+``FLEXFLOW_TPU_LEDGER_DIR`` move the directory (tests point it at a
+tmpdir).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional
+
+from .metrics import metrics_registry
+
+LEDGER_SCHEMA = 1
+DEFAULT_DIR = os.path.join(".ffcache", "obs", "runs")
+
+_mu = threading.Lock()  # guards _LAST_RECORD + _FINGERPRINT + appends
+_LAST_RECORD: Optional[Dict] = None
+_FINGERPRINT: Optional[Dict] = None
+
+
+def ledger_mode(config) -> str:
+    """The validated ``config.ledger`` mode — a typo raises at the call
+    site (compile/fit entry), the mode-knob convention every obs gate
+    follows."""
+    mode = getattr(config, "ledger", "on") or "on"
+    if mode not in ("on", "off"):
+        raise ValueError(f"ledger={mode!r}: expected 'on' or 'off'")
+    return mode
+
+
+def ledger_dir(config=None) -> str:
+    """Resolution order: explicit config knob > env override > default
+    (cwd-relative ``.ffcache/obs/runs``, next to the strategy cache)."""
+    d = getattr(config, "ledger_dir", None) if config is not None else None
+    return d or os.environ.get("FLEXFLOW_TPU_LEDGER_DIR") or DEFAULT_DIR
+
+
+def machine_fingerprint() -> Dict:
+    """The coarse machine identity stamped on every record (the cohort
+    discriminator across heterogeneous hosts; the search cache's
+    ``machine_signature`` is the fine-grained cost-model view — this one
+    must stay cheap and import-light)."""
+    global _FINGERPRINT
+    with _mu:
+        if _FINGERPRINT is not None:
+            return dict(_FINGERPRINT)
+    import platform
+
+    import jax
+
+    fp = {
+        "host": platform.node() or "unknown",
+        "backend": jax.default_backend(),
+        "devices": jax.device_count(),
+        "jax": jax.__version__,
+        "py": platform.python_version(),
+    }
+    with _mu:
+        _FINGERPRINT = fp
+    return dict(fp)
+
+
+# ------------------------------------------------------------- writing
+def record_run(kind: str, record: Dict, config=None) -> Optional[Dict]:
+    """Append one ``kind`` record to the ledger; returns the full
+    (enveloped) record, or None when the ledger is off or the append
+    failed. The envelope (schema/kind/run_id/timestamp/pid/machine)
+    always wins over same-named payload keys."""
+    try:
+        if config is not None and ledger_mode(config) == "off":
+            return None
+        doc = dict(record)
+        doc.update({
+            "schema": LEDGER_SCHEMA,
+            "kind": kind,
+            "run_id": uuid.uuid4().hex,
+            "ts_unix_s": round(time.time(), 3),
+            "pid": os.getpid(),
+            "machine": machine_fingerprint(),
+        })
+        _append(ledger_dir(config), doc)
+        metrics_registry().counter("ledger.records").inc()
+        return doc
+    except ValueError:
+        raise  # a typo'd mode knob must fail loudly, not count as an error
+    except Exception as e:  # noqa: BLE001 — telemetry never kills a run
+        metrics_registry().counter("ledger.errors").inc()
+        import sys
+
+        print(f"[ledger] append failed: {type(e).__name__}: {e}",
+              file=sys.stderr, flush=True)
+        return None
+
+
+def _append(dirpath: str, doc: Dict, track_last: bool = True) -> None:
+    os.makedirs(dirpath, exist_ok=True)
+    path = os.path.join(dirpath, f"runs-{os.getpid()}.jsonl")
+    line = json.dumps(doc, sort_keys=True, default=str)
+    global _LAST_RECORD
+    with _mu:
+        with open(path, "a") as f:
+            f.write(line + "\n")
+        if track_last:
+            _LAST_RECORD = doc
+
+
+def last_record() -> Optional[Dict]:
+    """The most recent record THIS process appended (the watchdog's
+    black-box dump includes it — the last known-good telemetry before a
+    stall)."""
+    with _mu:
+        return dict(_LAST_RECORD) if _LAST_RECORD is not None else None
+
+
+# ------------------------------------------------------------- reading
+def scan_ledger(dirpath: Optional[str] = None) -> Dict:
+    """Read every ``*.jsonl`` under the ledger dir. Corrupt lines
+    (crash-truncated appends, foreign garbage) are SKIPPED and counted —
+    one bad line never poisons the corpus. Returns
+    ``{"runs": [...], "files": n, "corrupt_lines": n}`` with runs in
+    ascending ``ts_unix_s`` order."""
+    dirpath = dirpath or ledger_dir()
+    runs: List[Dict] = []
+    files = corrupt = 0
+    try:
+        names = sorted(os.listdir(dirpath))
+    except OSError:
+        names = []
+    for name in names:
+        if not name.endswith(".jsonl"):
+            continue
+        files += 1
+        try:
+            with open(os.path.join(dirpath, name), errors="replace") as f:
+                lines = f.read().splitlines()
+        except OSError:
+            corrupt += 1
+            continue
+        for line in lines:
+            if not line.strip():
+                continue
+            try:
+                doc = json.loads(line)
+                if not isinstance(doc, dict) or "schema" not in doc:
+                    raise ValueError("not a ledger record")
+            except ValueError:
+                corrupt += 1
+                continue
+            runs.append(doc)
+    runs.sort(key=lambda r: (r.get("ts_unix_s") or 0, r.get("run_id") or ""))
+    return {"runs": runs, "files": files, "corrupt_lines": corrupt}
+
+
+def load_runs(dirpath: Optional[str] = None, kind: Optional[str] = None,
+              since_unix_s: Optional[float] = None, **match) -> List[Dict]:
+    """The filtered corpus: records of one ``kind`` (optional), newer
+    than ``since_unix_s`` (optional), with every ``match`` key equal
+    (e.g. ``model_sig=...``)."""
+    runs = scan_ledger(dirpath)["runs"]
+    if kind is not None:
+        runs = [r for r in runs if r.get("kind") == kind]
+    if since_unix_s is not None:
+        runs = [r for r in runs if (r.get("ts_unix_s") or 0) >= since_unix_s]
+    return filter_runs(runs, **match)
+
+
+def filter_runs(runs: List[Dict], **match) -> List[Dict]:
+    return [r for r in runs
+            if all(r.get(k) == v for k, v in match.items())]
+
+
+def merge_runs(src_dir: str, dst_dir: str) -> int:
+    """Fold another ledger directory's records into ``dst_dir`` (e.g.
+    pulling worker-host ledgers onto the coordinator), de-duplicated by
+    ``run_id``; returns the number of records appended."""
+    have = {r.get("run_id") for r in scan_ledger(dst_dir)["runs"]}
+    fresh = [r for r in scan_ledger(src_dir)["runs"]
+             if r.get("run_id") not in have]
+    for doc in fresh:
+        # merged records are FOREIGN: they must not become this
+        # process's last_record() (the watchdog's black box would then
+        # report another machine's run as our final transmission)
+        _append(dst_dir, doc, track_last=False)
+    return len(fresh)
+
+
+def cohort_key(rec: Dict) -> str:
+    """The (model, mesh, knobs) cohort a record belongs to —
+    ``tools/perf_sentinel.py`` only ever compares runs within one cohort
+    (cross-model or cross-mesh ratios would be meaningless)."""
+    perf = rec.get("perf") or {}
+    return json.dumps([
+        rec.get("kind"),
+        perf.get("metric"),
+        rec.get("label") or rec.get("model_sig"),
+        sorted((rec.get("mesh") or {}).items()),
+        sorted((rec.get("knobs") or {}).items()),
+        (rec.get("machine") or {}).get("backend"),
+    ], sort_keys=True, default=str)
+
+
+# ----------------------------------------------- FFModel record builders
+_KNOB_FIELDS = ("batch_size", "compute_dtype", "prefetch_depth",
+                "steps_per_dispatch", "max_inflight_steps",
+                "grad_accum_steps", "zero_optimizer", "pipeline_schedule",
+                "search_cache", "perform_fusion")
+
+
+def model_context(ff) -> Dict:
+    """The cohort-defining context of a compiled FFModel: a stable model
+    signature (op types + shapes — invariant to the process-global layer
+    name counters), mesh axes, and the perf-relevant config knobs."""
+    import hashlib
+
+    cm = ff.compiled
+    ctx: Dict = {"knobs": {k: getattr(ff.config, k, None)
+                           for k in _KNOB_FIELDS}}
+    if cm is None:
+        return ctx
+    sig = [(op.op_type.value,
+            tuple(tuple(t.dims) for t in op.layer.outputs))
+           for op in cm.ops]
+    ctx["model_sig"] = hashlib.sha256(
+        json.dumps(sig, default=str).encode()).hexdigest()[:12]
+    ctx["n_ops"] = len(cm.ops)
+    if cm.mesh is not None:
+        from ..core.machine import mesh_axis_sizes
+
+        ctx["mesh"] = dict(mesh_axis_sizes(cm.mesh))
+    if ff.pipelined is not None:
+        # the schedule actually running (an "auto" knob resolves here)
+        ctx["knobs"]["pipeline_schedule"] = ff.pipelined.cfg.schedule
+    return ctx
+
+
+def _scalars(doc: Optional[Dict]) -> Dict:
+    """JSON-scalar subset of a profile dict (drops bulky nested blocks
+    a ledger line does not need twice)."""
+    return {k: v for k, v in (doc or {}).items()
+            if isinstance(v, (int, float, str, bool)) or v is None}
+
+
+def record_compile(ff, wall_s: float) -> Optional[Dict]:
+    """The per-compile record: search/cache outcome, audit summary, and
+    the executable telemetry block (flops/bytes/peak memory per program,
+    or its explicit ``unavailable`` reason)."""
+    try:
+        if ledger_mode(ff.config) == "off":
+            return None
+        rec = model_context(ff)
+        rec["wall_s"] = round(wall_s, 6)
+        sp = getattr(ff, "search_profile", None)
+        if sp:
+            rec["search"] = _scalars(sp)
+        ap = getattr(ff, "audit_profile", None)
+        if ap:
+            rec["audit"] = {
+                "programs": sorted((ap.get("programs") or {})),
+                "walk_s": ap.get("walk_s"),
+                "errors": len(ff.audit_report.errors)
+                if getattr(ff, "audit_report", None) else 0,
+                "warnings": len(ff.audit_report.warnings)
+                if getattr(ff, "audit_report", None) else 0,
+            }
+        rec["exec"] = (getattr(ff, "exec_telemetry", None)
+                       or {"unavailable": "exec_telemetry=off"})
+        return record_run("compile", rec, config=ff.config)
+    except ValueError:
+        raise
+    except Exception:  # noqa: BLE001 — telemetry never kills a compile
+        metrics_registry().counter("ledger.errors").inc()
+        return None
+
+
+def _watchdog_block() -> Dict:
+    from .watchdog import watchdog
+
+    return watchdog().stats()
+
+
+def record_fit(ff, kind: str = "fit") -> Optional[Dict]:
+    """The per-fit (or per-eval) record: epoch throughput, divergence
+    block, watchdog state, and the full metrics snapshot — the
+    divergence flywheel's training rows."""
+    try:
+        if ledger_mode(ff.config) == "off":
+            return None
+        rec = model_context(ff)
+        prof = getattr(ff, "fit_profile" if kind == "fit"
+                       else "eval_profile", None) or {}
+        rec["throughput"] = {
+            **_scalars(prof),
+            "epochs": [dict(e) for e in prof.get("epochs") or []],
+        }
+        if prof.get("divergence"):
+            rec["divergence"] = prof["divergence"]
+        if prof.get("pipeline"):
+            rec["pipeline"] = _scalars(prof["pipeline"])
+        if prof.get("steps_per_s"):
+            rec["perf"] = {"metric": f"{kind}.steps_per_s",
+                           "value": prof["steps_per_s"],
+                           "higher_is_better": True}
+        rec["watchdog"] = _watchdog_block()
+        rec["metrics"] = metrics_registry().to_json()
+        return record_run(kind, rec, config=ff.config)
+    except ValueError:
+        raise
+    except Exception:  # noqa: BLE001 — telemetry never kills a fit
+        metrics_registry().counter("ledger.errors").inc()
+        return None
+
+
+def record_serving(extra: Optional[Dict] = None,
+                   config=None) -> Optional[Dict]:
+    """One record per serving session (engine ``stop()``). The counter
+    and percentile values are snapshots of the PROCESS-CUMULATIVE
+    ``serving.*`` registry series (the registry is process-wide, not
+    per-engine) — ``scope`` says so explicitly; per-session deltas are
+    the difference between consecutive records of one pid."""
+    try:
+        reg = metrics_registry()
+        rec: Dict = {"counters": {}, "scope": "process_cumulative"}
+        for name in ("serving.requests", "serving.batches",
+                     "serving.errors"):
+            m = reg.get(name)
+            if m is not None:
+                rec["counters"][name] = m.to_json()
+        for name in ("serving.queue_wait_s", "serving.e2e_s",
+                     "serving.infer_s", "serving.batch_size"):
+            m = reg.get(name)
+            if m is not None:
+                rec[name] = m.to_json()
+        if extra:
+            rec.update(extra)
+        rec["watchdog"] = _watchdog_block()
+        if not rec["counters"]:
+            return None  # nothing served — no record
+        return record_run("serving", rec, config=config)
+    except Exception:  # noqa: BLE001 — telemetry never kills shutdown
+        metrics_registry().counter("ledger.errors").inc()
+        return None
+
+
+def record_bench(tool: str, result: Dict, perf: Optional[Dict] = None,
+                 label: Optional[str] = None, knobs: Optional[Dict] = None,
+                 config=None) -> Optional[Dict]:
+    """One record per bench-tool run, so BENCH_*.json trend lines
+    survive in-repo; ``perf`` is the sentinel's comparison handle
+    (``{"metric", "value", "higher_is_better"}``)."""
+    try:
+        rec: Dict = {"tool": tool, "result": result}
+        if label:
+            rec["label"] = label
+        if knobs:
+            rec["knobs"] = dict(knobs)
+        if perf:
+            rec["perf"] = dict(perf)
+        return record_run("bench", rec, config=config)
+    except Exception:  # noqa: BLE001
+        metrics_registry().counter("ledger.errors").inc()
+        return None
+
+
+__all__ = [
+    "LEDGER_SCHEMA", "cohort_key", "filter_runs", "last_record",
+    "ledger_dir", "ledger_mode", "load_runs", "machine_fingerprint",
+    "merge_runs", "model_context", "record_bench", "record_compile",
+    "record_fit", "record_run", "record_serving", "scan_ledger",
+]

@@ -61,13 +61,13 @@ class Gauge:
         self.value = 0.0
 
     def set(self, v: float) -> None:
-        self.value = float(v)
+        self.value = float(v)  # concurrency: race-ok (lock-free by design: GIL-atomic store of a last-writer-wins sample)
 
     def to_json(self):
         return self.value
 
     def merge(self, other: "Gauge") -> None:
-        self.value = other.value
+        self.value = other.value  # concurrency: race-ok (merge folds quiesced worker registries)
 
 
 class Histogram:
@@ -123,8 +123,44 @@ class Histogram:
         self.sum += other.sum  # concurrency: race-ok (merge folds quiesced registries, see count)
         self.min = min(self.min, other.min)  # concurrency: race-ok (see count)
         self.max = max(self.max, other.max)  # concurrency: race-ok (see count)
-        for v in other._recent:
-            self._recent.append(v)
+        # reservoir merge: appending ALL of other's window into the
+        # maxlen-bounded deque would evict every one of self's samples
+        # whenever other has >= maxlen entries — merged percentiles would
+        # reflect only one process. Instead each window is subsampled
+        # (evenly strided, order preserved) to its proportional share of
+        # the capacity and the two are interleaved, so future appends
+        # evict both processes' samples fairly.
+        if not other._recent:
+            return
+        cap = self._recent.maxlen
+        a, b = list(self._recent), list(other._recent)
+        if cap is not None and len(a) + len(b) > cap:
+            na = min(len(a), max(1, round(cap * len(a) / (len(a) + len(b)))))
+            a, b = _strided(a, na), _strided(b, cap - na)
+        self._recent = collections.deque(  # concurrency: race-ok (see count)
+            _interleave(a, b), maxlen=cap)
+
+
+def _strided(xs: List[float], n: int) -> List[float]:
+    """``n`` evenly-spaced samples of ``xs``, order preserved (the
+    deterministic subsample the reservoir merge uses)."""
+    if n >= len(xs):
+        return list(xs)
+    if n <= 0:
+        return []
+    step = len(xs) / n
+    return [xs[min(len(xs) - 1, int(i * step))] for i in range(n)]
+
+
+def _interleave(a: List[float], b: List[float]) -> List[float]:
+    out: List[float] = []
+    la, lb = len(a), len(b)
+    for i in range(max(la, lb)):
+        if i < la:
+            out.append(a[i])
+        if i < lb:
+            out.append(b[i])
+    return out
 
 
 def _prom_name(name: str) -> str:

@@ -87,7 +87,11 @@ class Tracer:
     def __init__(self, enabled: bool = False, capacity: int = 65536):
         self.enabled = bool(enabled)
         self._events: collections.deque = collections.deque(maxlen=capacity)
+        # the two clocks are read back to back so the wall-clock anchor
+        # corresponds to ts == 0: merged cross-process traces realign on
+        # anchor + ts/1e6 (perf_counter epochs are per-process arbitrary)
         self._epoch = time.perf_counter()
+        self._anchor_unix = time.time()
         self._pid = os.getpid()
         self._lock = threading.Lock()  # export/clear vs concurrent append
 
@@ -164,12 +168,29 @@ class Tracer:
         with self._lock:
             self._events.clear()
 
+    def export_metadata(self) -> Dict:
+        """Cross-process merge anchor: ``ts`` values are microseconds
+        since a per-process ``perf_counter`` epoch, so traces from two
+        processes misalign unless each export says WHEN its epoch was
+        (wall clock) and WHOSE it is (process label). A merger shifts
+        every event by ``(anchor_a - anchor_b) * 1e6`` to co-plot."""
+        import platform
+
+        return {
+            "wall_clock_anchor_unix_s": round(self._anchor_unix, 6),
+            "process": f"{platform.node() or 'host'}:{self._pid}",
+            "pid": self._pid,
+            "clock": "us_since_process_epoch",
+        }
+
     def export(self, path: str) -> int:
-        """Write the buffer as Chrome trace-event JSON; returns the
-        event count written."""
+        """Write the buffer as Chrome trace-event JSON (with the
+        cross-process ``metadata`` anchor); returns the event count
+        written."""
         evs = self.events()
         with open(path, "w") as f:
-            json.dump({"traceEvents": evs, "displayTimeUnit": "ms"}, f)
+            json.dump({"traceEvents": evs, "displayTimeUnit": "ms",
+                       "metadata": self.export_metadata()}, f)
         return len(evs)
 
 
@@ -227,6 +248,25 @@ def validate_chrome_trace(payload) -> List[str]:
     events = payload["traceEvents"]
     if not isinstance(events, list):
         return ["traceEvents is not a list"]
+    # exported traces carry the cross-process merge anchor; when the
+    # payload claims one ("metadata" present — every Tracer.export
+    # does), it must be usable: a numeric wall-clock anchor + a process
+    # label (in-memory event lists under test carry no metadata block)
+    if "metadata" in payload:
+        md = payload["metadata"]
+        if not isinstance(md, dict):
+            problems.append("metadata is not an object")
+        else:
+            anchor = md.get("wall_clock_anchor_unix_s")
+            if not isinstance(anchor, (int, float)) or anchor <= 0:
+                problems.append(
+                    "metadata.wall_clock_anchor_unix_s missing or not a "
+                    "positive number — cross-process merge cannot align "
+                    "this trace")
+            if not md.get("process"):
+                problems.append(
+                    "metadata.process label missing — merged traces "
+                    "cannot attribute events to a process")
     tracks: Dict[tuple, List[Dict]] = {}
     for i, ev in enumerate(events):
         for field in ("name", "ph", "ts", "pid", "tid"):

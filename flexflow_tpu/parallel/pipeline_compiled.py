@@ -281,6 +281,9 @@ class CompiledPipelinedModel(PipelinedModel):
         self._views_stale = False
         self._programs: Dict[Tuple, Any] = {}  # per (mb_shape sig) jit
         self._boundary_meta = None  # filled per microbatch shape
+        # XLA executable telemetry for the schedule program (filled per
+        # fresh program build when config.exec_telemetry="on")
+        self.exec_telemetry = None
 
     # ----------------------------------------------------- pack/unpack
     def _ensure_packed(self) -> None:
@@ -667,7 +670,10 @@ class CompiledPipelinedModel(PipelinedModel):
         cfg = self.audit_config
         mode = (getattr(cfg, "audit_programs", "off") or "off") \
             if cfg is not None else "off"
-        if mode == "off":
+        from ..obs.exec_telemetry import telemetry_mode
+
+        tmode = telemetry_mode(cfg) if cfg is not None else "off"
+        if mode == "off" and tmode == "off":
             return
         from ..analysis.findings import ValidationReport
         from ..analysis.program_audit import audit_traced
@@ -691,15 +697,35 @@ class CompiledPipelinedModel(PipelinedModel):
                 f"program '{pname}' could not be traced for audit: "
                 f"{type(e).__name__}: {e}",
                 severity="warning")
+            traced = None
         else:
             report = audit_traced(pname, traced, config=cfg,
                                   source="pipeline")
-        self.audit_report = report
-        reg = metrics_registry()
-        reg.counter("audit.programs").inc()
-        reg.counter("audit.errors").inc(len(report.errors))
-        reg.counter("audit.warnings").inc(len(report.warnings))
-        report.handle(mode)
+        if mode != "off":
+            self.audit_report = report
+            reg = metrics_registry()
+            reg.counter("audit.programs").inc()
+            reg.counter("audit.errors").inc(len(report.errors))
+            reg.counter("audit.warnings").inc(len(report.warnings))
+        if tmode == "on":
+            if traced is None:
+                # the telemetry contract: every failure mode is an
+                # explicit unavailable reason, never a bare None
+                self.exec_telemetry = {"programs": {
+                    pname: {"unavailable": "trace failed (see AUD000)"}}}
+            else:
+                # XLA executable telemetry for the ONE schedule program
+                # (flops/bytes/peak memory), reconciled against the
+                # audit's static peak-live estimate (OBS002 warn)
+                from ..obs.exec_telemetry import collect_one
+
+                static_peak = (report.programs.get(pname) or {}).get(
+                    "peak_live_bytes")
+                self.exec_telemetry = collect_one(
+                    pname, traced, config=cfg, static_peak=static_peak,
+                    allow=getattr(cfg, "exec_mem_allow", None))
+        if mode != "off":
+            report.handle(mode)
 
     # --------------------------------------------------------- training
     def train_step(self, rng, xs: Sequence[jax.Array], y: jax.Array,

@@ -84,6 +84,11 @@ class CompiledModel:
     # real call, so the compile() audit gate's AOT trace is shared with
     # the first dispatch instead of being paid twice
     audit_exec: Optional[List[Any]] = None
+    # XLA executable telemetry (obs/exec_telemetry.py): per-program
+    # flops / bytes-accessed / peak-memory blocks pulled off the
+    # compiled executables when config.exec_telemetry="on" (filled by
+    # FFModel.compile; None when the knob is off)
+    exec_telemetry: Optional[Dict] = None
 
 
 def toposort_layers(layers: List[Layer]) -> List[Layer]:

@@ -35,6 +35,8 @@ import jax
 from jax.sharding import NamedSharding, PartitionSpec
 
 from ..obs.trace import tracer
+from ..obs.watchdog import beat as _wd_beat
+from ..obs.watchdog import watch as _wd_watch
 
 
 def _put(batch: np.ndarray, sharding: Optional[NamedSharding]) -> jax.Array:
@@ -357,26 +359,48 @@ class Prefetcher:
         # span name/cat track whichever loop drives us (fit vs eval) so
         # the trace agrees with the registry series the stats feed
         pfx = self.stats.prefix if self.stats is not None else "fit"
+        # watchdog: the consumer loop is a watched section — every
+        # resumption of this generator (one per dispatch-loop iteration)
+        # heartbeats it, so a hang in dispatch, in the channel wait, or
+        # in serial assembly goes silent and dumps. The watch OPENS at
+        # the second iteration: the first step's dispatch blocks through
+        # the cold XLA compile (legitimately minutes on a big model),
+        # which must not read as a stall.
+        section = None
         if self.depth == 0:
-            for k in plan:
-                t0 = time.perf_counter()
-                host = self.group.assemble_host(k)
-                wait = time.perf_counter() - t0
-                if self.stats is not None:
-                    # serial mode: the whole inline assembly IS the wait
-                    self.stats.record_wait(wait)
-                    self.stats.record_depth(0)
-                if tr.enabled:
-                    tr.complete(f"{pfx}.input_wait", t0, wait, cat=pfx,
-                                args={"k": k, "mode": "serial"})
-                yield k, self.group.place(host, k)
+            try:
+                for i, k in enumerate(plan):
+                    if i == 1:
+                        section = _wd_watch(f"{pfx}.loop")
+                        section.__enter__()
+                    elif i > 1:
+                        _wd_beat(f"{pfx}.loop")
+                    t0 = time.perf_counter()
+                    host = self.group.assemble_host(k)
+                    wait = time.perf_counter() - t0
+                    if self.stats is not None:
+                        # serial mode: the whole inline assembly IS the wait
+                        self.stats.record_wait(wait)
+                        self.stats.record_depth(0)
+                    if tr.enabled:
+                        tr.complete(f"{pfx}.input_wait", t0, wait, cat=pfx,
+                                    args={"k": k, "mode": "serial"})
+                    yield k, self.group.place(host, k)
+            finally:
+                if section is not None:
+                    section.__exit__(None, None, None)
             return
         chan = _Channel(self.depth)
 
         def _work():
             try:
                 for k in plan:
-                    if not chan.put((k, self.group.assemble_host(k))):
+                    # the assembly must make progress; the put may block
+                    # legitimately on a full channel (consumer pacing),
+                    # so only the assembly is inside the watched section
+                    with _wd_watch("prefetch.worker"):
+                        item = (k, self.group.assemble_host(k))
+                    if not chan.put(item):
                         return  # consumer closed the channel mid-epoch
                 chan.put(_DONE)
             except BaseException as e:  # surfaced on the consumer side
@@ -386,7 +410,16 @@ class Prefetcher:
                                   name="ff-prefetch")
         worker.start()
         try:
+            i = -1
             while True:
+                i += 1
+                if i == 1:
+                    # second iteration: the first step's cold XLA
+                    # compile is behind us (see the serial path)
+                    section = _wd_watch(f"{pfx}.loop")
+                    section.__enter__()
+                elif i > 1:
+                    _wd_beat(f"{pfx}.loop")
                 depth_sample = chan.depth()
                 t0 = time.perf_counter()
                 item = chan.get()
@@ -396,8 +429,8 @@ class Prefetcher:
                 if isinstance(item, _WorkerError):
                     raise item.exc
                 if self.stats is not None:
-                    # real batches only (the end-of-epoch sentinel is not
-                    # an input wait)
+                    # real batches only (the end-of-epoch sentinel is
+                    # not an input wait)
                     self.stats.record_depth(depth_sample)
                     self.stats.record_wait(wait)
                 if tr.enabled:
@@ -407,6 +440,8 @@ class Prefetcher:
                 k, host = item
                 yield k, self.group.place(host, k)
         finally:
+            if section is not None:
+                section.__exit__(None, None, None)
             # close-then-join: a worker blocked on a full channel wakes
             # immediately (put returns False) — the generator can be
             # abandoned mid-epoch without leaking its worker thread

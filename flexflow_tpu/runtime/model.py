@@ -735,6 +735,13 @@ class FFModel:
         over the mesh's pipe axis (no reference equivalent — PP is reserved
         but unimplemented upstream, model.h:190-192)."""
         configure_tracer(self.config)  # config.trace="on" arms the recorder
+        # typo'd obs mode knobs fail HERE, before any search/XLA work is
+        # paid (the convention every mode knob follows)
+        from ..obs.exec_telemetry import telemetry_mode as _telemetry_mode
+        from ..obs.ledger import ledger_mode as _ledger_mode
+
+        _ledger_mode(self.config)
+        _telemetry_mode(self.config)
         _t0_compile = time.perf_counter()
         if optimizer is not None:
             self.optimizer = optimizer
@@ -943,17 +950,17 @@ class FFModel:
         self.audit_report = None
         self.audit_profile = None
         amode = self._audit_mode()
+        # with a pipeline engine active, fit() dispatches the engine's
+        # own (already audited) schedule programs and cm.train_step
+        # never runs — tracing/compiling it here (audit OR telemetry)
+        # would be cost no first dispatch ever amortizes
+        _skip = ("train_step",) if self.pipelined is not None else ()
         if amode != "off" and self.compiled is not None:
             from ..analysis.program_audit import audit_compiled_model
 
             _t0_audit = time.perf_counter()
             asrc = ("cache" if (self.search_profile or {}).get("cache")
                     == "hit" else "builder")
-            # with a pipeline engine active, fit() dispatches the
-            # engine's own (already audited) schedule programs and
-            # cm.train_step never runs — tracing it here would be cost
-            # no first dispatch ever amortizes
-            _skip = ("train_step",) if self.pipelined is not None else ()
             with span("compile.audit", cat="compile", source=asrc):
                 self.audit_report = audit_compiled_model(
                     self.compiled, config=self.config, source=asrc,
@@ -980,6 +987,28 @@ class FFModel:
                 len(self.audit_report.warnings))
             reg.histogram("audit.wall_time_s").observe(_dt_audit)
             self.audit_report.handle(amode)
+        # --- executable telemetry (obs/exec_telemetry.py): what XLA
+        # itself reports about each compiled step program — flops, bytes
+        # accessed, peak memory — reconciled against the audit's static
+        # peak-live estimate (OBS002 warn past exec_mem_threshold).
+        # Opt-in: the AOT compile the analyses need is not shared with
+        # the dispatch cache.
+        self.exec_telemetry = None
+        from ..obs.exec_telemetry import telemetry_mode as _tel_mode
+
+        if _tel_mode(self.config) == "on" and self.compiled is not None:
+            from ..obs.exec_telemetry import collect_compiled_model
+
+            _static = {
+                name: (p or {}).get("peak_live_bytes")
+                for name, p in ((self.audit_profile or {}).get(
+                    "programs") or {}).items()}
+            with span("compile.exec_telemetry", cat="compile"):
+                self.exec_telemetry = collect_compiled_model(
+                    self.compiled, config=self.config, skip=_skip,
+                    static_peaks=_static,
+                    allow=getattr(self.config, "exec_mem_allow", None))
+            self.compiled.exec_telemetry = self.exec_telemetry
         # graph exports requested via flags (reference: --compgraph /
         # --taskgraph dumps written right after compile, model.cc:3666-3674)
         if self.config.export_strategy_computation_graph_file:
@@ -998,11 +1027,18 @@ class FFModel:
         # decision plus the contention probe — tests assert on this so a
         # silent-skip regression (the except-all guard) fails loudly
         self._playoff_record = None
+        _dt_compile = time.perf_counter() - _t0_compile
         tracer().complete(
-            "compile", _t0_compile, time.perf_counter() - _t0_compile,
+            "compile", _t0_compile, _dt_compile,
             cat="compile",
             args={"n_ops": len(self.compiled.ops),
                   "pipelined": self.pipelined is not None})
+        # durable telemetry: one ledger record per compile — machine
+        # fingerprint, knobs, search/cache outcome, audit summary, exec
+        # telemetry (obs/ledger.py; config.ledger="off" disables)
+        from ..obs.ledger import record_compile
+
+        record_compile(self, _dt_compile)
 
     def _resolve_pipeline(self, pipeline, cm):
         """Finalize a PipelineConfig against the compiled model:
@@ -1760,8 +1796,16 @@ class FFModel:
         assert self.compiled is not None, "call compile() first"
         _tr = configure_tracer(self.config)
         from ..obs.divergence import divergence_mode
+        from ..obs.ledger import ledger_mode, record_fit
+        from ..obs.watchdog import beat as _wd_beat
+        from ..obs.watchdog import configure_watchdog
 
         divergence_mode(self.config)  # typo fails BEFORE training, not after
+        ledger_mode(self.config)      # same contract for the ledger knob
+        # config.watchdog="on" arms the stall monitor (threshold/dir from
+        # config); the step loop below heartbeats it via the Prefetcher's
+        # watched section plus the explicit per-step beat
+        configure_watchdog(self.config)
         if guard is not None and self.pipelined is not None:
             raise ValueError("TrainingGuard does not support pipelined "
                              "models yet (stage state lives off the "
@@ -1844,6 +1888,7 @@ class FFModel:
                                   else loss_accum + guard_add)
                 self._advance_window(stats, inflight, loss, nk,
                                      batch_nbytes * nk, max_inflight)
+                _wd_beat("fit.loop")  # watchdog heartbeat (no-op when off)
                 cm._iteration += nk
                 if recompile_state is not None:
                     # reference: recompile_on_condition evaluated per
@@ -1919,6 +1964,9 @@ class FFModel:
         from ..obs.divergence import maybe_record_divergence
 
         maybe_record_divergence(self)
+        # durable telemetry: one ledger record per fit — throughput,
+        # divergence block, watchdog state, full metrics snapshot
+        record_fit(self)
         return history
 
     def eval(self, x, y, batch_size: Optional[int] = None, verbose: bool = True) -> PerfMetrics:
@@ -1928,6 +1976,12 @@ class FFModel:
         throughput record lands in ``self.eval_profile``."""
         assert self.compiled is not None
         _tr = configure_tracer(self.config)
+        from ..obs.ledger import ledger_mode
+        from ..obs.watchdog import beat as _wd_beat
+        from ..obs.watchdog import configure_watchdog
+
+        ledger_mode(self.config)  # typo fails BEFORE the eval, not after
+        configure_watchdog(self.config)
         cm = self.compiled
         xs = x if isinstance(x, (list, tuple)) else [x]
         bs = batch_size or self.config.batch_size
@@ -1946,6 +2000,7 @@ class FFModel:
             pm.accumulate(bm)
             self._advance_window(stats, inflight, loss, 1, batch_nbytes,
                                  max_inflight)
+            _wd_beat("eval.loop")  # watchdog heartbeat (no-op when off)
             if _tr.enabled:
                 _tr.complete("eval.step", _ts, _tr.now() - _ts, cat="eval")
         with span("eval.host_sync", cat="eval"):
@@ -1959,6 +2014,9 @@ class FFModel:
                   f"{rec['dispatch_ahead_occupancy']:.2f}", flush=True)
         if verbose:
             print(f"eval: {pm.report(cm.metrics)}", flush=True)
+        from ..obs.ledger import record_fit
+
+        record_fit(self, kind="eval")
         return pm
 
     # ---- manual-loop verbs (reference: model.cc:2415-2495) --------------- #

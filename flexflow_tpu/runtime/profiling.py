@@ -66,6 +66,24 @@ from ..obs.trace import (  # noqa: F401
     tracer,
     validate_chrome_trace,
 )
+from ..obs.ledger import (  # noqa: F401
+    cohort_key,
+    last_record,
+    ledger_dir,
+    load_runs,
+    merge_runs,
+    record_run,
+    scan_ledger,
+)
+from ..obs.exec_telemetry import (  # noqa: F401
+    collect_traced,
+    reconcile_peak_memory,
+)
+from ..obs.watchdog import (  # noqa: F401
+    Watchdog,
+    configure_watchdog,
+    watchdog,
+)
 from ..utils.dot import DotFile
 
 

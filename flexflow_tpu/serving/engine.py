@@ -28,6 +28,7 @@ import numpy as np
 
 from ..obs.metrics import metrics_registry
 from ..obs.trace import VIRTUAL_TID_BASE, tracer
+from ..obs.watchdog import watch as _wd_watch
 
 
 class _PyBatcher:
@@ -102,6 +103,12 @@ class ModelInstance:
     def __init__(self, ff, name: str = "model"):
         if ff.compiled is None:
             raise ValueError("compile() the FFModel before serving it")
+        # a serving-only process never runs fit()/eval(), so the served
+        # model's config must arm the stall monitor here or the worker
+        # watch sections would be permanent no-ops
+        from ..obs.watchdog import configure_watchdog
+
+        configure_watchdog(ff.config)
         self.name = name
         self._ff = ff
         cm = ff.compiled
@@ -386,6 +393,10 @@ class InferenceEngine:
         with self._mu:
             workers = dict(self._workers)
             batchers = dict(self._batchers)
+            # the first registered model's config gates the session's
+            # ledger record (ledger="off" must disable ALL appends)
+            _groups = next(iter(self._models.values()), None)
+            ledger_cfg = _groups[0]._ff.config if _groups else None
             self._started = False
             self._stopping = True
         for b in batchers.values():
@@ -433,6 +444,12 @@ class InferenceEngine:
                 self._batchers[name] = _make_batcher(
                     self._models[name][0].batch_size, self.batch_timeout_s)
             self._stopping = False
+        # durable telemetry: one ledger record per serving session —
+        # request/batch/error counters + latency percentile snapshots
+        # (never raises; ledger.errors counts failures)
+        from ..obs.ledger import record_serving
+
+        record_serving({"models": sorted(batchers)}, config=ledger_cfg)
 
     # ---- request path ------------------------------------------------------
     def infer_async(self, model: str, inputs: Sequence[np.ndarray]) -> Future:
@@ -487,10 +504,13 @@ class InferenceEngine:
 
     # ---- worker ------------------------------------------------------------
     def _worker(self, name: str, idx: int = 0) -> None:
+        import contextlib
+
         with self._mu:
             inst = self._models[name][idx]
             batcher = self._batchers[name]
         reg = metrics_registry()
+        first_batch = True
         while True:
             ids = batcher.next_batch()
             if ids is None:
@@ -501,37 +521,49 @@ class InferenceEngine:
             if not reqs:
                 continue
             t_pickup = time.perf_counter()
-            try:
-                stacked = [
-                    np.concatenate([r.inputs[k] for r in reqs], axis=0)
-                    for k in range(inst.n_inputs)
-                ]
-                t_assembled = time.perf_counter()
-                outs = inst.infer(stacked)[0]
-                t_infer = time.perf_counter()
-                row = 0
-                ends = []
-                for r in reqs:
-                    cnt = r.inputs[0].shape[0]
-                    r.future.set_result(outs[row:row + cnt][0]
-                                        if cnt == 1 else outs[row:row + cnt])
-                    row += cnt
-                    ends.append(time.perf_counter())
-                reg.counter("serving.batches").inc()
-                reg.histogram("serving.batch_size").observe(row)
-                reg.histogram("serving.infer_s").observe(t_infer - t_assembled)
-                for r, t_end in zip(reqs, ends):
-                    reg.histogram("serving.queue_wait_s").observe(
-                        t_pickup - r.t_enqueue)
-                    reg.histogram("serving.e2e_s").observe(
-                        t_end - r.t_enqueue)
-                self._record_request_spans(name, reqs, t_pickup,
-                                           t_assembled, t_infer, ends)
-            except Exception as e:  # surface per-request, keep serving
-                reg.counter("serving.errors").inc()
-                for r in reqs:
-                    if not r.future.done():
-                        r.future.set_exception(e)
+            # watchdog: only ACTIVE batch processing is watched — idle
+            # blocking on next_batch() above is the normal empty-queue
+            # state, but a hang while requests are in hand (a wedged
+            # device) must black-box dump. The FIRST batch runs
+            # unwatched: its infer blocks through the cold XLA compile,
+            # which is legitimate, not a stall.
+            ctx = (contextlib.nullcontext() if first_batch
+                   else _wd_watch(f"serving.{name}.{idx}"))
+            first_batch = False
+            with ctx:
+                try:
+                    stacked = [
+                        np.concatenate([r.inputs[k] for r in reqs], axis=0)
+                        for k in range(inst.n_inputs)
+                    ]
+                    t_assembled = time.perf_counter()
+                    outs = inst.infer(stacked)[0]
+                    t_infer = time.perf_counter()
+                    row = 0
+                    ends = []
+                    for r in reqs:
+                        cnt = r.inputs[0].shape[0]
+                        r.future.set_result(
+                            outs[row:row + cnt][0]
+                            if cnt == 1 else outs[row:row + cnt])
+                        row += cnt
+                        ends.append(time.perf_counter())
+                    reg.counter("serving.batches").inc()
+                    reg.histogram("serving.batch_size").observe(row)
+                    reg.histogram("serving.infer_s").observe(
+                        t_infer - t_assembled)
+                    for r, t_end in zip(reqs, ends):
+                        reg.histogram("serving.queue_wait_s").observe(
+                            t_pickup - r.t_enqueue)
+                        reg.histogram("serving.e2e_s").observe(
+                            t_end - r.t_enqueue)
+                    self._record_request_spans(name, reqs, t_pickup,
+                                               t_assembled, t_infer, ends)
+                except Exception as e:  # surface per-request, keep serving
+                    reg.counter("serving.errors").inc()
+                    for r in reqs:
+                        if not r.future.done():
+                            r.future.set_exception(e)
 
     @staticmethod
     def _record_request_spans(model: str, reqs, t_pickup, t_assembled,

@@ -109,12 +109,18 @@ class Generator:
         # matching output and the cast copy is reused across steps, so
         # the audit proves nothing further is safely donatable here.
         self.audit_report = None
+        # XLA executable telemetry for the decode step (filled when
+        # config.exec_telemetry="on")
+        self.exec_telemetry = None
         self._maybe_audit()
 
     def _maybe_audit(self) -> None:
         cfg = self._cm.config
         mode = getattr(cfg, "audit_programs", "off") or "off"
-        if mode == "off":
+        from ..obs.exec_telemetry import telemetry_mode
+
+        tmode = telemetry_mode(cfg)
+        if mode == "off" and tmode == "off":
             return
         from ..analysis.program_audit import audit_traced
 
@@ -151,18 +157,39 @@ class Generator:
                 f"program 'serving.decode_step' could not be traced for "
                 f"audit: {type(e).__name__}: {e}",
                 severity="warning")
-            self.audit_report = report
-            report.handle(mode)
+            if tmode == "on":
+                # the telemetry contract: every failure mode is an
+                # explicit unavailable reason, never a bare None
+                self.exec_telemetry = {"programs": {
+                    "serving.decode_step": {"unavailable":
+                        f"trace failed: {type(e).__name__}: {e}"}}}
+            if mode != "off":
+                self.audit_report = report
+                report.handle(mode)
             return
-        self.audit_report = audit_traced(
+        report = audit_traced(
             "serving.decode_step", traced, config=cfg, source="serving")
         from ..obs.metrics import metrics_registry
 
-        reg = metrics_registry()
-        reg.counter("audit.programs").inc()
-        reg.counter("audit.errors").inc(len(self.audit_report.errors))
-        reg.counter("audit.warnings").inc(len(self.audit_report.warnings))
-        self.audit_report.handle(mode)
+        if mode != "off":
+            self.audit_report = report
+            reg = metrics_registry()
+            reg.counter("audit.programs").inc()
+            reg.counter("audit.errors").inc(len(report.errors))
+            reg.counter("audit.warnings").inc(len(report.warnings))
+        if tmode == "on":
+            # decode-step telemetry, reconciled against the static
+            # peak-live estimate the audit walk just produced
+            from ..obs.exec_telemetry import collect_one
+
+            static_peak = (report.programs.get("serving.decode_step")
+                           or {}).get("peak_live_bytes")
+            self.exec_telemetry = collect_one(
+                "serving.decode_step", traced, config=cfg,
+                static_peak=static_peak,
+                allow=getattr(cfg, "exec_mem_allow", None))
+        if mode != "off":
+            self.audit_report.handle(mode)
 
     def _exec_params(self):
         """Params in the decode compute dtype. bf16: cast ONCE per params

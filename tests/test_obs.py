@@ -96,6 +96,32 @@ def test_validate_chrome_trace_rejects_partial_overlap():
     assert validate_chrome_trace([]) != []
 
 
+def test_export_metadata_carries_cross_process_anchor(armed_tracer,
+                                                      tmp_path):
+    """Satellite: ``ts`` is relative to a per-process perf_counter
+    epoch, so merged traces from different processes misalign unless the
+    export records a wall-clock anchor + process label — and the
+    validator enforces both on any payload that claims metadata."""
+    with span("anchored", cat="test"):
+        pass
+    p = str(tmp_path / "trace.json")
+    armed_tracer.export(p)
+    payload = json.load(open(p))
+    md = payload["metadata"]
+    anchor = md["wall_clock_anchor_unix_s"]
+    assert anchor > 0 and abs(anchor - time.time()) < 3600
+    assert md["process"] and str(md["pid"]) in md["process"]
+    assert validate_chrome_trace(payload) == []
+    # a payload claiming metadata without the anchor/label is rejected
+    assert validate_chrome_trace(
+        {"traceEvents": [], "metadata": {}}) != []
+    assert validate_chrome_trace(
+        {"traceEvents": [],
+         "metadata": {"wall_clock_anchor_unix_s": anchor}}) != []
+    # in-memory event lists (no metadata claim) stay valid
+    assert validate_chrome_trace({"traceEvents": []}) == []
+
+
 def test_tracer_ring_buffer_bounds_memory():
     tr = Tracer(enabled=True, capacity=8)
     for i in range(50):
@@ -161,6 +187,51 @@ def test_registry_merge():
     c.gauge("c").set(1.0)
     with pytest.raises(TypeError):
         a.merge(c)
+
+
+def test_histogram_merge_keeps_both_reservoir_windows():
+    """Satellite regression: merging used to append ALL of other's
+    window into the maxlen-bounded deque, evicting every one of self's
+    samples whenever other had >= reservoir entries — merged percentiles
+    reflected only one process. The merge must keep a proportional,
+    interleaved sample of BOTH windows."""
+    from flexflow_tpu.obs.metrics import Histogram
+
+    a, b = Histogram(reservoir=64), Histogram(reservoir=64)
+    for _ in range(100):  # both windows individually overflow the cap
+        a.observe(1.0)
+        b.observe(3.0)
+    a.merge(b)
+    assert a.count == 200 and a.sum == 400.0
+    assert a.min == 1.0 and a.max == 3.0
+    vals = list(a._recent)
+    assert len(vals) == 64  # still bounded
+    n1, n3 = vals.count(1.0), vals.count(3.0)
+    assert n1 > 0 and n3 > 0, "one process's window was evicted entirely"
+    assert abs(n1 - n3) <= 2  # equal-sized windows share ~equally
+    # pooled percentiles span both processes
+    assert a.percentile(0.25) == 1.0 and a.percentile(0.75) == 3.0
+    # interleaved, not concatenated: future appends evict fairly
+    assert vals[0] != vals[1]
+    # asymmetric WINDOW sizes keep proportional shares (48 vs 16 of 64)
+    c, d = Histogram(reservoir=64), Histogram(reservoir=64)
+    for _ in range(48):
+        c.observe(1.0)
+    for _ in range(16):
+        d.observe(3.0)
+    for _ in range(16):  # overflow the merged capacity
+        d.observe(3.0)
+    c.merge(d)
+    cv = list(c._recent)
+    assert len(cv) == 64
+    # 48:32 windows -> ~3:2 shares of the 64-slot merged reservoir
+    assert 34 <= cv.count(1.0) <= 42 and 22 <= cv.count(3.0) <= 30
+    # small merges (under the cap) keep every sample
+    e, f = Histogram(reservoir=64), Histogram(reservoir=64)
+    e.observe(1.0)
+    f.observe(3.0)
+    e.merge(f)
+    assert sorted(e._recent) == [1.0, 3.0]
 
 
 def test_fit_feeds_registry_counters():
@@ -234,6 +305,7 @@ def test_obs001_in_code_catalog():
     from flexflow_tpu.analysis import CODE_CATALOG
 
     assert "OBS001" in CODE_CATALOG
+    assert "OBS002" in CODE_CATALOG
 
 
 # ----------------------------------------------------------------- serving

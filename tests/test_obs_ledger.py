@@ -1,0 +1,488 @@
+"""Durable observability tests: run ledger append/load/merge + corrupt
+tolerance, XLA executable telemetry + OBS002 reconciliation, stall
+watchdog black-box dumps, and the perf sentinel's verdicts."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+from flexflow_tpu.models.mlp import build_mlp
+from flexflow_tpu.obs import ledger
+from flexflow_tpu.obs.exec_telemetry import reconcile_peak_memory
+from flexflow_tpu.obs.metrics import metrics_registry
+from flexflow_tpu.obs.watchdog import Watchdog, watchdog
+
+
+def _mlp(tmp_path=None, hidden=(16,), **cfg):
+    if tmp_path is not None:
+        cfg.setdefault("ledger_dir", str(tmp_path))
+    ff = FFModel(FFConfig(batch_size=16, seed=0, **cfg))
+    build_mlp(ff, 16, in_dim=8, hidden_dims=hidden, num_classes=4)
+    ff.compile(optimizer=SGDOptimizer(lr=0.05),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=[])
+    return ff
+
+
+def _data(n=64):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, 8)).astype(np.float32)
+    y = rng.integers(0, 4, size=(n, 1)).astype(np.int32)
+    return x, y
+
+
+# ------------------------------------------------------------------ ledger
+def test_ledger_record_load_round_trip(tmp_path):
+    class Cfg:
+        ledger = "on"
+        ledger_dir = str(tmp_path)
+
+    doc = ledger.record_run("bench", {"label": "t", "perf": {
+        "metric": "m", "value": 2.0}}, config=Cfg())
+    assert doc["schema"] == ledger.LEDGER_SCHEMA
+    assert doc["kind"] == "bench" and doc["run_id"] and doc["pid"]
+    assert doc["machine"]["devices"] >= 1 and doc["machine"]["backend"]
+    ledger.record_run("fit", {"label": "u"}, config=Cfg())
+    runs = ledger.load_runs(str(tmp_path))
+    assert [r["kind"] for r in runs] == ["bench", "fit"]
+    assert ledger.load_runs(str(tmp_path), kind="bench")[0]["label"] == "t"
+    assert ledger.filter_runs(runs, label="u")[0]["kind"] == "fit"
+    # the envelope always wins over same-named payload keys
+    doc2 = ledger.record_run("bench", {"schema": 999}, config=Cfg())
+    assert doc2["schema"] == ledger.LEDGER_SCHEMA
+    # last_record: the most recent append from THIS process
+    assert ledger.last_record()["run_id"] == doc2["run_id"]
+
+
+def test_ledger_tolerates_corrupt_lines(tmp_path):
+    class Cfg:
+        ledger = "on"
+        ledger_dir = str(tmp_path)
+
+    for i in range(3):
+        ledger.record_run("bench", {"i": i}, config=Cfg())
+    # crash-truncated append + foreign garbage + non-record JSON
+    path = os.path.join(str(tmp_path), f"runs-{os.getpid()}.jsonl")
+    with open(path, "a") as f:
+        f.write('{"schema": 1, "kind": "ben')  # truncated mid-record
+        f.write("\nnot json at all\n")
+        f.write('[1, 2, 3]\n')
+        f.write('{"no_schema_field": true}\n')
+    scan = ledger.scan_ledger(str(tmp_path))
+    assert len(scan["runs"]) == 3  # every valid line survives
+    assert scan["corrupt_lines"] == 4
+    assert sorted(r["i"] for r in scan["runs"]) == [0, 1, 2]
+
+
+def test_ledger_merge_dedupes_by_run_id(tmp_path):
+    src, dst = tmp_path / "src", tmp_path / "dst"
+
+    class Src:
+        ledger = "on"
+        ledger_dir = str(src)
+
+    class Dst:
+        ledger = "on"
+        ledger_dir = str(dst)
+
+    a = ledger.record_run("bench", {"x": 1}, config=Src())
+    ledger.record_run("bench", {"x": 2}, config=Src())
+    ledger.record_run("bench", {"x": 3}, config=Dst())
+    # seed one duplicate into dst so merge must skip it
+    with open(os.path.join(str(dst), "runs-dup.jsonl"), "w") as f:
+        f.write(json.dumps(a) + "\n")
+    assert ledger.merge_runs(str(src), str(dst)) == 1  # only x=2 is new
+    runs = ledger.scan_ledger(str(dst))["runs"]
+    assert sorted(r["x"] for r in runs) == [1, 2, 3]
+    assert len({r["run_id"] for r in runs}) == 3
+    assert ledger.merge_runs(str(src), str(dst)) == 0  # idempotent
+
+
+def test_fit_appends_compile_and_fit_records(tmp_path):
+    ff = _mlp(tmp_path, divergence="e2e")
+    x, y = _data()
+    ff.fit(x, y, epochs=2, verbose=False)
+    ff.eval(x, y, verbose=False)
+    runs = ledger.load_runs(str(tmp_path))
+    kinds = [r["kind"] for r in runs]
+    assert kinds == ["compile", "fit", "eval"]
+    comp, fit, ev = runs
+    # compile: cohort context + exec block (off -> explicit reason)
+    assert comp["model_sig"] and comp["n_ops"] == len(ff.compiled.ops)
+    assert comp["exec"] == {"unavailable": "exec_telemetry=off"}
+    assert comp["knobs"]["batch_size"] == 16
+    # fit: throughput + divergence + perf handle + metrics snapshot
+    assert fit["model_sig"] == comp["model_sig"]
+    assert fit["throughput"]["epochs"] and fit["throughput"]["steps_per_s"]
+    assert fit["divergence"]["e2e_ratio"]
+    assert fit["perf"]["metric"] == "fit.steps_per_s"
+    assert fit["perf"]["value"] > 0
+    assert "fit.steps" in fit["metrics"]
+    assert fit["watchdog"]["dumps"] == 0
+    assert ev["perf"]["metric"] == "eval.steps_per_s"
+
+
+def test_ledger_off_and_mode_guard(tmp_path):
+    ff = _mlp(tmp_path, ledger="off")
+    x, y = _data()
+    ff.fit(x, y, epochs=1, verbose=False)
+    assert ledger.scan_ledger(str(tmp_path))["runs"] == []
+    with pytest.raises(ValueError, match="ledger="):
+        _mlp(tmp_path, ledger="bogus")  # typo fails at compile, loudly
+
+
+# --------------------------------------------------------- exec telemetry
+def test_exec_telemetry_blocks_and_metrics(tmp_path):
+    before = metrics_registry().counter("exec.programs").value
+    ff = _mlp(tmp_path, exec_telemetry="on")
+    tel = ff.exec_telemetry
+    assert tel is ff.compiled.exec_telemetry
+    assert set(tel["programs"]) == {"train_step", "eval_step"}
+    for name, block in tel["programs"].items():
+        # the contract: numbers, or an explicit unavailable reason
+        assert ("flops" in block) or ("unavailable" in block), block
+        if "flops" in block:
+            assert block["flops"] > 0 and block["bytes_accessed"] > 0
+            assert block["peak_bytes"] > 0
+    assert metrics_registry().counter("exec.programs").value > before
+    # reconciliation ran against the audit's static estimate and the
+    # tiny MLP sits inside the default threshold (no OBS002)
+    rows = tel["reconciliation"]
+    assert {r["program"] for r in rows} == {"train_step", "eval_step"}
+    for r in rows:
+        assert r["static_peak_bytes"] > 0 and r["xla_peak_bytes"] > 0
+        assert "finding" not in r
+    # the compile ledger record carries the same block
+    comp = ledger.load_runs(str(tmp_path), kind="compile")[-1]
+    assert set(comp["exec"]["programs"]) == {"train_step", "eval_step"}
+
+
+def test_exec_telemetry_off_by_default_and_mode_guard(tmp_path):
+    ff = _mlp(tmp_path)
+    assert ff.exec_telemetry is None
+    with pytest.raises(ValueError, match="exec_telemetry="):
+        _mlp(tmp_path, exec_telemetry="bogus")
+
+
+def test_obs002_fires_on_seeded_divergence(capsys):
+    before = metrics_registry().counter("exec.obs002_findings").value
+    row = reconcile_peak_memory("seeded", 1000, 100000)  # 100x apart
+    f = row["finding"]
+    assert f["code"] == "OBS002" and f["severity"] == "warning"
+    assert row["ratio"] == 100.0 and row["divergence"] == 99.0
+    assert "OBS002" in capsys.readouterr().out
+    assert metrics_registry().counter(
+        "exec.obs002_findings").value == before + 1
+    # symmetric: a static estimate far ABOVE reality fires too
+    row2 = reconcile_peak_memory("seeded2", 100000, 1000)
+    assert row2["finding"]["code"] == "OBS002"
+    # inside the threshold: clean row, no finding
+    row3 = reconcile_peak_memory("close", 1000, 1500)
+    assert "finding" not in row3 and row3["divergence"] == 0.5
+    # nothing to compare: explicit reason, never a crash
+    assert "unavailable" in reconcile_peak_memory("none", None, 1000)
+    assert "unavailable" in reconcile_peak_memory("zero", 0, 1000)
+
+
+def test_obs002_suppressible_only_with_reasoned_allow(capsys):
+    # a reasonless entry does NOT suppress (the pragma contract)
+    row = reconcile_peak_memory("p", 1000, 100000, allow={"p": ""})
+    assert row["finding"]["code"] == "OBS002"
+    row = reconcile_peak_memory("p", 1000, 100000, allow={"other": "x"})
+    assert row["finding"]["code"] == "OBS002"
+    # a REASONED entry suppresses and records the review trail
+    row = reconcile_peak_memory(
+        "p", 1000, 100000,
+        allow={"p": "packed pipeline buffers are priced per stage"})
+    assert "finding" not in row
+    assert row["suppressed"].startswith("packed pipeline")
+    capsys.readouterr()
+
+
+def test_obs002_clean_negative_sweep_small_zoo(tmp_path):
+    """Telemetry-on compiles of real zoo models stay OBS002-clean: the
+    default threshold separates allocator-vs-static slack (every clean
+    program) from genuine order-level drift (the seeded fixture)."""
+    from flexflow_tpu.models import zoo_smoke_builders
+
+    zoo = zoo_smoke_builders()
+    reconciled = 0
+    for name in ("mlp", "dlrm"):
+        ff = FFModel(FFConfig(batch_size=8, seed=0, exec_telemetry="on",
+                              ledger_dir=str(tmp_path)))
+        zoo[name](ff, 8)
+        ff.compile(optimizer=SGDOptimizer(lr=0.05),
+                   loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                   metrics=[])
+        rows = (ff.exec_telemetry or {}).get("reconciliation") or []
+        reconciled += len(rows)
+        bad = [r for r in rows if "finding" in r]
+        assert not bad, f"{name}: spurious OBS002 on a clean model: {bad}"
+    assert reconciled >= 4  # mlp + dlrm train/eval actually compared
+
+
+def test_exec_telemetry_degrades_to_unavailable_on_trace_failure():
+    """The degrade-gracefully contract: a program that cannot even be
+    traced lands as an explicit {"unavailable": reason} block — never an
+    exception into compile, never a guessed number."""
+    from flexflow_tpu.analysis.program_audit import ExecutableSpec
+    from flexflow_tpu.obs.exec_telemetry import collect_compiled_model
+
+    class _Boom:
+        def trace(self, *a):
+            raise RuntimeError("wedged lowering")
+
+    class _FakeCM:
+        audit_exec = [ExecutableSpec("broken", _Boom())]
+
+    out = collect_compiled_model(_FakeCM())
+    block = out["programs"]["broken"]
+    assert "unavailable" in block and "wedged lowering" in block["unavailable"]
+    assert "reconciliation" not in out
+
+
+def test_pipeline_schedule_program_telemetry(tmp_path):
+    """The compiled pipeline engine's ONE schedule program gets its own
+    telemetry block, reconciled against the audit's static estimate."""
+    import jax
+
+    from flexflow_tpu import make_mesh
+    from flexflow_tpu.parallel.pipeline import PipelineConfig
+
+    ff = FFModel(FFConfig(batch_size=16, seed=0, exec_telemetry="on",
+                          ledger_dir=str(tmp_path)))
+    t = ff.create_tensor((16, 8), name="x")
+    t = ff.dense(t, 16, name="p_fc0")
+    t = ff.relu(t, name="p_act0")
+    t = ff.dense(t, 4, name="p_fc1")
+    ff.softmax(t, name="p_sm")
+    ff.compile(
+        optimizer=SGDOptimizer(lr=0.05),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        mesh=make_mesh({"pipe": 2}, devices=jax.devices()[:2]),
+        pipeline=PipelineConfig(num_stages=2, num_microbatches=4,
+                                schedule="1f1b", engine="compiled"),
+    )
+    assert ff.pipelined.engine_name == "compiled"
+    x, y = _data(32)
+    ff.fit(x, y, epochs=1, verbose=False)
+    tel = ff.pipelined.exec_telemetry
+    assert tel is not None
+    block = tel["programs"]["pipeline.1f1b"]
+    assert ("flops" in block) or ("unavailable" in block), block
+
+
+# ---------------------------------------------------------------- watchdog
+def test_watchdog_stall_dump_on_seeded_heartbeat(tmp_path):
+    wd = Watchdog(threshold_s=0.15, poll_s=0.05, dump_dir=str(tmp_path))
+    wd.arm()
+    try:
+        with wd.watch("seeded"):
+            wd.beat("seeded")
+            deadline = time.monotonic() + 5.0
+            while wd.stats()["dumps"] == 0 \
+                    and time.monotonic() < deadline:
+                time.sleep(0.05)  # go silent: the monitor must fire
+        assert wd.stats()["dumps"] == 1
+    finally:
+        wd.disarm()
+    dumps = [n for n in os.listdir(str(tmp_path))
+             if n.startswith("blackbox-")]
+    assert len(dumps) == 1
+    doc = json.load(open(os.path.join(str(tmp_path), dumps[0])))
+    assert doc["schema"] == 1 and doc["reason"] == "stall"
+    assert "seeded" in doc["stalled"]
+    assert doc["stalled"]["seeded"] >= 0.15
+    # the black box: every thread's stack, incl. this test thread and
+    # the monitor itself, plus the recorder state
+    stacks = doc["threads"]
+    assert any("ff-watchdog" in k for k in stacks)
+    assert any("MainThread" in k for k in stacks)
+    assert all(isinstance(v, list) and v for v in stacks.values())
+    assert isinstance(doc["metrics"], dict)
+    assert "trace_tail" in doc and "last_ledger_record" in doc
+    # fatal-signal handler was registered into the same dir
+    assert os.path.exists(
+        os.path.join(str(tmp_path), f"fatal-{os.getpid()}.log"))
+
+
+def test_watchdog_one_dump_per_stall_and_beat_rearms(tmp_path):
+    wd = Watchdog(threshold_s=0.1, poll_s=0.03, dump_dir=str(tmp_path))
+    wd.arm()
+    try:
+        with wd.watch("s"):
+            time.sleep(0.5)  # several poll ticks past the threshold
+            assert wd.stats()["dumps"] == 1  # deduped per silent stretch
+            wd.beat("s")  # recovery re-arms the source
+            time.sleep(0.35)
+            assert wd.stats()["dumps"] == 2
+    finally:
+        wd.disarm()
+
+
+def test_watchdog_zero_dumps_on_healthy_fit(tmp_path):
+    bb = tmp_path / "bb"
+    ff = _mlp(tmp_path / "ledger", watchdog="on",
+              watchdog_threshold_s=120.0, watchdog_dir=str(bb))
+    try:
+        x, y = _data()
+        ff.fit(x, y, epochs=2, verbose=False)
+        st = watchdog().stats()
+        assert st["enabled"]
+        assert "fit.loop" in st["sources_seen"]
+        assert st["watched"] == []  # sections closed with the fit
+    finally:
+        watchdog().disarm()
+    dumps = [n for n in os.listdir(str(bb))
+             if n.startswith("blackbox-")] if bb.exists() else []
+    assert dumps == [], f"healthy fit produced dumps: {dumps}"
+
+
+def test_watchdog_mode_guard_and_disarmed_is_cheap(tmp_path):
+    from flexflow_tpu.obs.watchdog import beat, watch
+
+    ff = _mlp(tmp_path, watchdog="bogus")
+    x, y = _data()
+    with pytest.raises(ValueError, match="watchdog="):
+        ff.fit(x, y, epochs=1, verbose=False)
+    assert not watchdog().enabled
+    t0 = time.perf_counter()
+    for _ in range(100_000):
+        beat("x")
+        with watch("y"):
+            pass
+    elapsed = time.perf_counter() - t0
+    # ~free: one flag check per call, shared no-op section (loose bound)
+    assert elapsed < 2.0, f"disarmed watchdog too slow: {elapsed:.3f}s"
+
+
+def test_watchdog_manual_dump_and_cap(tmp_path):
+    wd = Watchdog(threshold_s=60, dump_dir=str(tmp_path), max_dumps=2)
+    p1 = wd.dump("manual")
+    p2 = wd.dump("manual")
+    assert p1 and p2 and p1 != p2
+    assert wd.dump("manual") is None  # per-process cap
+    doc = json.load(open(p1))
+    assert doc["reason"] == "manual" and doc["threads"]
+
+
+# ---------------------------------------------------------------- sentinel
+def _sentinel():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "perf_sentinel", os.path.join(os.path.dirname(__file__),
+                                      os.pardir, "tools",
+                                      "perf_sentinel.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _bench_rec(value, ts, label="m1"):
+    return {
+        "schema": 1, "kind": "bench", "run_id": f"r{ts}",
+        "ts_unix_s": ts, "pid": 1,
+        "machine": {"backend": "cpu"},
+        "label": label, "mesh": {"data": 8}, "knobs": {"batch": 64},
+        "perf": {"metric": "steps_per_s", "value": value,
+                 "higher_is_better": True},
+    }
+
+
+def _write_ledger(tmp_path, recs, name="runs-t.jsonl"):
+    os.makedirs(str(tmp_path), exist_ok=True)
+    with open(os.path.join(str(tmp_path), name), "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+
+
+def test_sentinel_flags_regression(tmp_path):
+    sent = _sentinel()
+    _write_ledger(tmp_path, [_bench_rec(10.0, 1), _bench_rec(10.5, 2),
+                             _bench_rec(9.8, 3), _bench_rec(4.0, 4)])
+    out = sent.run_sentinel(ledger_dir=str(tmp_path), margin=0.2,
+                            blackbox_dir=str(tmp_path / "bb"))
+    assert out["exit"] == 1 and out["verdict"] == "regression"
+    (reg,) = out["regressions"]
+    assert reg["newest"] == 4.0 and reg["baseline"] == 10.0
+    assert reg["ratio"] == 0.4
+    json.dumps(out)  # one-line-JSON-able
+
+
+def test_sentinel_ok_and_blocks(tmp_path):
+    sent = _sentinel()
+    recs = [_bench_rec(10.0, 1), _bench_rec(10.5, 2), _bench_rec(9.9, 3)]
+    # a second, independent cohort must be judged separately
+    recs += [_bench_rec(100.0, 1, label="m2"),
+             _bench_rec(101.0, 2, label="m2"),
+             _bench_rec(99.0, 3, label="m2")]
+    # a record carrying exec telemetry feeds the sentinel's exec block
+    recs.append({
+        "schema": 1, "kind": "compile", "run_id": "c1", "ts_unix_s": 5,
+        "pid": 1, "machine": {"backend": "cpu"},
+        "exec": {"programs": {"train_step": {"flops": 123.0}}},
+    })
+    _write_ledger(tmp_path, recs)
+    out = sent.run_sentinel(ledger_dir=str(tmp_path), margin=0.2,
+                            blackbox_dir=str(tmp_path / "bb"))
+    assert out["exit"] == 0 and out["verdict"] == "ok"
+    assert out["judged"] == 2 and not out["regressions"]
+    assert 0.9 < out["overall_ratio"] < 1.1
+    assert out["ledger"]["runs"] == 7
+    assert out["ledger"]["by_kind"] == {"bench": 6, "compile": 1}
+    assert out["exec"]["programs"]["train_step"]["flops"] == 123.0
+    assert out["watchdog"]["blackbox_dumps"] == 0
+    assert "live" in out["watchdog"]
+
+
+def test_sentinel_empty_and_thin_baselines(tmp_path):
+    sent = _sentinel()
+    # empty ledger: clean exit, explicit verdict
+    out = sent.run_sentinel(ledger_dir=str(tmp_path / "none"),
+                            blackbox_dir=str(tmp_path / "bb"))
+    assert out["exit"] == 0 and out["verdict"] == "no_baseline"
+    assert "unavailable" in out["exec"]
+    # one prior run is noise, not a baseline (even a huge drop passes)
+    _write_ledger(tmp_path, [_bench_rec(10.0, 1), _bench_rec(1.0, 2)])
+    out = sent.run_sentinel(ledger_dir=str(tmp_path), margin=0.2,
+                            min_baseline=2,
+                            blackbox_dir=str(tmp_path / "bb"))
+    assert out["exit"] == 0
+    assert out["cohorts"][0]["verdict"] == "no_baseline"
+
+
+def test_fit_bench_main_appends_ledger_record(tmp_path, monkeypatch):
+    """CI/tooling satellite: the bench tools' main() persists the trend
+    line. The bench itself is covered by test_fit_bench.py — here it is
+    stubbed so the WIRING (perf handle extraction, knob cohort keys) is
+    what's under test, at ~zero suite cost."""
+    import importlib.util
+
+    monkeypatch.setenv("FLEXFLOW_TPU_LEDGER_DIR", str(tmp_path))
+    spec = importlib.util.spec_from_file_location(
+        "fit_bench_ledger", os.path.join(os.path.dirname(__file__),
+                                         os.pardir, "tools",
+                                         "fit_bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    canned = {
+        "steps_per_s_serial": 10.0, "steps_per_s_pipeline": 12.5,
+        "speedup": 1.25, "losses_bit_identical": True,
+        "batch": 64, "prefetch_depth": 2, "steps_per_dispatch": 2,
+        "steps": 4,
+    }
+    monkeypatch.setattr(mod, "run_bench", lambda **kw: dict(canned))
+    assert mod.main(["--smoke"]) == 0
+    (rec,) = ledger.load_runs(str(tmp_path), kind="bench")
+    assert rec["tool"] == "fit_bench"
+    assert rec["label"] == "fit_bench_mlp_smoke"
+    assert rec["perf"] == {"metric": "fit_bench.steps_per_s_pipeline",
+                           "value": 12.5, "higher_is_better": True}
+    assert rec["knobs"] == {"batch": 64, "prefetch_depth": 2,
+                            "steps_per_dispatch": 2, "steps": 4}
+    assert rec["result"]["losses_bit_identical"] is True

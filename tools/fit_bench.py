@@ -213,6 +213,18 @@ def main(argv=None) -> int:
                         classes=ns.classes, batch=ns.batch,
                         trials=ns.trials, depth=ns.prefetch_depth,
                         k=ns.steps_per_dispatch, native=ns.native)
+    # durable trend line: the record lands in the run ledger so
+    # tools/perf_sentinel.py can judge the next run against this one
+    from flexflow_tpu.obs.ledger import record_bench
+
+    record_bench(
+        "fit_bench", out,
+        perf={"metric": "fit_bench.steps_per_s_pipeline",
+              "value": out["steps_per_s_pipeline"],
+              "higher_is_better": True},
+        label="fit_bench_mlp" + ("_smoke" if ns.smoke else ""),
+        knobs={k: out[k] for k in ("batch", "prefetch_depth",
+                                   "steps_per_dispatch", "steps")})
     print(json.dumps(out))
     return 0
 

@@ -5,8 +5,12 @@ Exercises the full observability surface end to end — the CI smoke for
 ``flexflow_tpu/obs/`` and the bench-trend record:
 
 * compiles a 2-stage **pipelined** MLP (pipe x data mesh) and fits it
-  with the span tracer armed (``config.trace=on``) and divergence
-  tracking in full per-op mode (``config.divergence=on``);
+  with the span tracer armed (``config.trace=on``), divergence tracking
+  in full per-op mode (``config.divergence=on``), executable telemetry
+  pulling XLA's cost/memory analyses off every program
+  (``exec_telemetry=on``), and the stall watchdog armed
+  (``watchdog=on`` — the report asserts ZERO black-box dumps on this
+  healthy run);
 * serves a few requests through the :class:`InferenceEngine` so the
   serving span trees + queue/latency metrics populate;
 * exports the trace buffer as Chrome trace-event JSON and validates it
@@ -17,10 +21,16 @@ Exercises the full observability surface end to end — the CI smoke for
      "metrics": {...full registry snapshot...},
      "divergence": {"e2e_ratio": ..., "per_op": [...], ...},
      "pipeline": {"schedule": ..., "engine": ..., "dispatches_per_step": ...},
+     "ledger": {"dir": ..., "runs": N, "kinds": [...]},
+     "exec": {"programs": {name: {"flops": ..., "bytes_accessed": ...,
+              "peak_bytes": ...} or {"unavailable": reason}}, ...},
+     "watchdog": {"enabled": true, "sources_seen": [...], "dumps": 0},
      "exit": 0}
 
 Exit status 1 when the trace fails validation, the divergence block is
-missing, or the serving/fit counters did not populate.
+missing, the serving/fit counters did not populate, the ledger stayed
+empty, a telemetry block lacks both numbers and an ``unavailable``
+reason, or the watchdog wrote a dump during the healthy run.
 
 Usage::
 
@@ -50,17 +60,22 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np  # noqa: E402
 
 
-def _fit_pipelined(samples: int, epochs: int) -> dict:
-    """2-stage pipelined MLP fit with trace + divergence armed; returns
-    the fit report (throughput + pipeline + divergence records)."""
+def _fit_pipelined(samples: int, epochs: int) -> tuple:
+    """2-stage pipelined MLP fit with the WHOLE observability surface
+    armed — trace, per-op divergence, executable telemetry, watchdog —
+    returns (fit report, exec-telemetry block)."""
     from flexflow_tpu import (DataType, FFConfig, FFModel, LossType,
                               SGDOptimizer, make_mesh)
     from flexflow_tpu.runtime.profiling import fit_report
 
     bs = 16
     mesh_shape = {"pipe": 2, "data": 4}
+    # watchdog threshold well above a cold XLA pipeline-program compile
+    # (which happens INSIDE the watched step loop on first dispatch) so
+    # the smoke never false-dumps on a loaded CI box
     cfg = FFConfig(batch_size=bs, seed=0, trace="on", divergence="on",
-                   mesh_shape=mesh_shape)
+                   exec_telemetry="on", watchdog="on",
+                   watchdog_threshold_s=300.0, mesh_shape=mesh_shape)
     ff = FFModel(cfg)
     x = ff.create_tensor((bs, 16), DataType.FLOAT, name="obs_x")
     t = ff.dense(x, 32, name="obs_fc1")
@@ -78,7 +93,15 @@ def _fit_pipelined(samples: int, epochs: int) -> dict:
     w = rng.normal(size=(16, 4)).astype(np.float32)
     ys = np.argmax(xs @ w, axis=1).astype(np.int32).reshape(-1, 1)
     ff.fit(xs, ys, epochs=epochs, verbose=False)
-    return fit_report(ff) or {}
+    # merge the compile-time telemetry (eval/forward programs) with the
+    # pipeline engine's schedule-program telemetry
+    exec_block = {"programs": {}, "reconciliation": []}
+    for tel in (ff.exec_telemetry,
+                getattr(ff.pipelined, "exec_telemetry", None)):
+        if tel:
+            exec_block["programs"].update(tel.get("programs") or {})
+            exec_block["reconciliation"] += tel.get("reconciliation") or []
+    return fit_report(ff) or {}, exec_block
 
 
 def _serve_smoke(requests: int) -> int:
@@ -102,12 +125,14 @@ def _serve_smoke(requests: int) -> int:
 
 def run_report(samples: int = 64, epochs: int = 2, requests: int = 4,
                trace_out: str = "") -> dict:
+    from flexflow_tpu.obs.ledger import ledger_dir, scan_ledger
     from flexflow_tpu.obs.metrics import metrics_registry
     from flexflow_tpu.obs.trace import (configure_tracer, tracer,
                                         validate_chrome_trace)
+    from flexflow_tpu.obs.watchdog import watchdog
 
     configure_tracer(enabled=True)
-    report = _fit_pipelined(samples, epochs)
+    report, exec_block = _fit_pipelined(samples, epochs)
     _serve_smoke(requests)
 
     tr = tracer()
@@ -122,9 +147,30 @@ def run_report(samples: int = 64, epochs: int = 2, requests: int = 4,
     pipeline = report.get("pipeline") or {}
     missing = [k for k in ("fit.steps", "serving.requests")
                if k not in snapshot]
+    # ---- durable blocks: ledger corpus, exec telemetry, watchdog -----
+    scan = scan_ledger()
+    ledger_block = {
+        "dir": ledger_dir(),
+        "files": scan["files"],
+        "runs": len(scan["runs"]),
+        "corrupt_lines": scan["corrupt_lines"],
+        "kinds": sorted({r.get("kind") for r in scan["runs"]}),
+    }
+    wd_block = watchdog().stats()
+    # the report is a snapshot; disarm so an in-process caller (the
+    # tier-1 smoke) does not keep a monitor thread — and its 60s default
+    # threshold — running under the rest of the suite
+    watchdog().disarm()
+    exec_ok = bool(exec_block.get("programs")) and all(
+        any(k in b for k in ("flops", "bytes_accessed", "peak_bytes",
+                             "unavailable"))
+        for b in exec_block["programs"].values())
     ok = (n_events > 0 and not problems and not missing
           and bool(divergence.get("e2e_ratio"))
-          and divergence.get("per_op"))
+          and divergence.get("per_op")
+          and ledger_block["runs"] > 0
+          and exec_ok
+          and wd_block["enabled"] and wd_block["dumps"] == 0)
     return {
         "trace": {
             "events": n_events,
@@ -138,6 +184,9 @@ def run_report(samples: int = 64, epochs: int = 2, requests: int = 4,
         "pipeline": {k: pipeline.get(k) for k in
                      ("schedule", "engine", "dispatches_per_step",
                       "bubble_fraction")} if pipeline else {},
+        "ledger": ledger_block,
+        "exec": exec_block,
+        "watchdog": wd_block,
         "steps_per_s": report.get("steps_per_s"),
         "missing_metrics": missing,
         "exit": 0 if ok else 1,

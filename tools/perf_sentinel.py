@@ -1,0 +1,216 @@
+#!/usr/bin/env python
+"""Perf sentinel: the run ledger's regression tripwire — one JSON line.
+
+Reads the durable run ledger (``.ffcache/obs/runs/``, written by every
+``fit``/``eval`` and bench-tool run), groups records into (model, mesh,
+knobs, backend) cohorts — cross-cohort ratios are meaningless — and
+compares each cohort's NEWEST run against its baseline, the median of
+the cohort's prior values (the existing bench methodology: medians, and
+ratios rather than absolutes, so shared-host speed drift mostly cancels
+and a single outlier epoch cannot define the baseline). Prints ONE
+line::
+
+    {"cohorts": [...], "overall_ratio": ..., "regressions": [...],
+     "ledger": {...}, "exec": {...}, "watchdog": {...}, "exit": 0|1}
+
+Exit status 1 only on a regression beyond ``--margin`` in at least one
+cohort with a big-enough baseline (``--min-baseline`` prior runs — a
+single prior run is machine noise, not a baseline). An empty ledger or
+all-new cohorts exit 0 with ``"verdict": "no_baseline"``.
+
+The ``exec`` and ``watchdog`` blocks surface the newest ledger
+record's executable telemetry (flops/bytes/peak memory per program, or
+its explicit ``unavailable`` reason) and watchdog state plus the
+black-box dump count — the whole durable-observability surface in one
+scrape.
+
+Margin honesty: this repo's CPU fallback boxes drift 0.8-1.5x with
+machine state (ROADMAP status note), so the default margin is wide
+(0.5 = flag only a >2x slowdown). On dedicated hardware tighten it
+(``--margin 0.15``).
+
+Usage::
+
+    python tools/perf_sentinel.py
+    python tools/perf_sentinel.py --margin 0.15 --min-baseline 3
+    python tools/perf_sentinel.py --ledger-dir /path/to/runs --kind fit
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _median(xs: List[float]) -> float:
+    xs = sorted(xs)
+    n = len(xs)
+    return xs[n // 2] if n % 2 else 0.5 * (xs[n // 2 - 1] + xs[n // 2])
+
+
+def _cohorts(runs: List[Dict]) -> Dict[str, List[Dict]]:
+    from flexflow_tpu.obs.ledger import cohort_key
+
+    out: Dict[str, List[Dict]] = {}
+    for r in runs:
+        perf = r.get("perf") or {}
+        if not isinstance(perf.get("value"), (int, float)) \
+                or perf["value"] <= 0 or not perf.get("metric"):
+            continue  # no comparison handle on this record
+        out.setdefault(cohort_key(r), []).append(r)
+    return out
+
+
+def _judge_cohort(key: str, runs: List[Dict], margin: float,
+                  min_baseline: int) -> Dict:
+    """Newest run vs the median of the cohort's prior values."""
+    runs = sorted(runs, key=lambda r: (r.get("ts_unix_s") or 0,
+                                       r.get("run_id") or ""))
+    newest = runs[-1]
+    prior = [float(r["perf"]["value"]) for r in runs[:-1]]
+    perf = newest["perf"]
+    row: Dict = {
+        "metric": perf.get("metric"),
+        "label": newest.get("label") or newest.get("model_sig"),
+        "mesh": newest.get("mesh"),
+        "runs": len(runs),
+        "newest": float(perf["value"]),
+        "newest_run_id": newest.get("run_id"),
+    }
+    if len(prior) < min_baseline:
+        row.update({"verdict": "no_baseline", "baseline_runs": len(prior)})
+        return row
+    baseline = _median(prior)
+    higher = bool(perf.get("higher_is_better", True))
+    ratio = (row["newest"] / baseline) if baseline > 0 else None
+    row.update({"baseline": round(baseline, 6),
+                "baseline_runs": len(prior),
+                "ratio": round(ratio, 4) if ratio else None})
+    if ratio is None:
+        row["verdict"] = "no_baseline"
+    elif (higher and ratio < 1.0 - margin) \
+            or (not higher and ratio > 1.0 + margin):
+        row["verdict"] = "regression"
+    else:
+        row["verdict"] = "ok"
+    return row
+
+
+def _newest_with(runs: List[Dict], key: str) -> Optional[Dict]:
+    for r in reversed(runs):
+        if r.get(key):
+            return r
+    return None
+
+
+def run_sentinel(ledger_dir: Optional[str] = None,
+                 kinds: Optional[List[str]] = None, margin: float = 0.5,
+                 min_baseline: int = 2,
+                 blackbox_dir: Optional[str] = None) -> Dict:
+    from flexflow_tpu.obs.ledger import ledger_dir as _ledger_dir
+    from flexflow_tpu.obs.ledger import scan_ledger
+    from flexflow_tpu.obs.watchdog import DEFAULT_DIR as _BLACKBOX_DEFAULT
+    from flexflow_tpu.obs.watchdog import watchdog
+
+    scan = scan_ledger(ledger_dir)
+    runs = scan["runs"]
+    if kinds:
+        perf_runs = [r for r in runs if r.get("kind") in kinds]
+    else:
+        perf_runs = runs
+    rows = [
+        _judge_cohort(key, cohort_runs, margin, min_baseline)
+        for key, cohort_runs in sorted(_cohorts(perf_runs).items())
+    ]
+    judged = [r for r in rows if r["verdict"] != "no_baseline"]
+    regressions = [r for r in rows if r["verdict"] == "regression"]
+    ratios = [r["ratio"] for r in judged if r.get("ratio")]
+
+    # ---- exec-telemetry block: the newest record that carries one ----
+    # (prefer a record with real per-program numbers over one whose
+    # compile ran with the telemetry knob off)
+    exec_rec = next(
+        (r for r in reversed(runs)
+         if isinstance(r.get("exec"), dict) and r["exec"].get("programs")),
+        None) or _newest_with(runs, "exec")
+    exec_block = (exec_rec["exec"] if exec_rec
+                  else {"unavailable": "no ledger record carries "
+                        "executable telemetry (compile with "
+                        "exec_telemetry=on)"})
+
+    # ---- watchdog block: live process state + on-disk dump count -----
+    wd = watchdog().stats()
+    bdir = blackbox_dir or wd.get("dump_dir") or _BLACKBOX_DEFAULT
+    try:
+        dumps = sorted(n for n in os.listdir(bdir)
+                       if n.startswith("blackbox-"))
+    except OSError:
+        dumps = []
+    wd_rec = _newest_with(runs, "watchdog")
+    watchdog_block = {
+        "live": wd,
+        "blackbox_dir": bdir,
+        "blackbox_dumps": len(dumps),
+        "newest_dump": dumps[-1] if dumps else None,
+        "last_run": (wd_rec or {}).get("watchdog"),
+    }
+
+    return {
+        "cohorts": rows,
+        "judged": len(judged),
+        "overall_ratio": round(_median(ratios), 4) if ratios else None,
+        "regressions": regressions,
+        "margin": margin,
+        "min_baseline": min_baseline,
+        "verdict": ("regression" if regressions
+                    else ("ok" if judged else "no_baseline")),
+        "ledger": {
+            "dir": ledger_dir or _ledger_dir(),
+            "files": scan["files"],
+            "runs": len(runs),
+            "corrupt_lines": scan["corrupt_lines"],
+            "by_kind": _by_kind(runs),
+        },
+        "exec": exec_block,
+        "watchdog": watchdog_block,
+        "exit": 1 if regressions else 0,
+    }
+
+
+def _by_kind(runs: List[Dict]) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for r in runs:
+        k = r.get("kind") or "?"
+        out[k] = out.get(k, 0) + 1
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--ledger-dir", default=None,
+                    help="ledger directory (default: "
+                         ".ffcache/obs/runs or FLEXFLOW_TPU_LEDGER_DIR)")
+    ap.add_argument("--kind", action="append", default=None,
+                    help="record kinds to judge (repeatable; default: "
+                         "all perf-bearing records)")
+    ap.add_argument("--margin", type=float, default=0.5,
+                    help="tolerated fractional slowdown before exit 1 "
+                         "(default 0.5: CPU fallback boxes drift)")
+    ap.add_argument("--min-baseline", type=int, default=2,
+                    help="prior runs required before a cohort is judged")
+    ap.add_argument("--blackbox-dir", default=None)
+    ns = ap.parse_args(argv)
+    out = run_sentinel(ledger_dir=ns.ledger_dir, kinds=ns.kind,
+                       margin=ns.margin, min_baseline=ns.min_baseline,
+                       blackbox_dir=ns.blackbox_dir)
+    print(json.dumps(out, sort_keys=True, default=str))
+    return out["exit"]
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -219,6 +219,17 @@ def main(argv=None) -> int:
                         batch=ns.batch, dim=ns.dim, hidden=ns.hidden,
                         layers=ns.layers, steps=ns.steps,
                         rounds=ns.rounds)
+    # durable trend line in the run ledger (tools/perf_sentinel.py
+    # judges the next run's best-variant step time against this one)
+    from flexflow_tpu.obs.ledger import record_bench
+
+    best = out["variants"][out["measured_best"]]
+    record_bench(
+        "pipe_bench", out,
+        perf={"metric": "pipe_bench.best_step_ms",
+              "value": best["step_ms"], "higher_is_better": False},
+        label="pipe_bench_mlp" + ("_smoke" if ns.smoke else ""),
+        knobs={k: out[k] for k in ("stages", "microbatches", "batch")})
     print(json.dumps(out))
     return 0
 
