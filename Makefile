@@ -39,19 +39,26 @@
 #   make sentinel — perf regression tripwire over the run ledger: newest
 #                   run vs the per-(model, mesh, knobs) cohort baseline
 #                   (median of priors); one JSON line incl. ledger /
-#                   exec-telemetry / watchdog blocks; exit 1 on a
+#                   exec-telemetry / watchdog blocks + the attributed
+#                   dominant phase per cohort verdict; exit 1 on a
 #                   regression beyond the margin
+#   make explain  — explain the newest ledger run: attribution phase
+#                   breakdown (must reconcile with the measured step
+#                   time), top ops measured-vs-predicted, divergence
+#                   outliers, sentinel cohort trend; one JSON line
+#                   (tools/explain_run.py --latest --json)
 
 PY ?= python
 CPU_MESH = JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
 .PHONY: ci native native-check lint concurrency-lint pcg-lint audit \
-        test dryrun bench bench-fit bench-pipe obs-report sentinel
+        test dryrun bench bench-fit bench-pipe obs-report sentinel explain
 
 # sentinel runs AFTER obs-report so a fresh checkout's first ci already
-# has ledger records to judge (first run: no baseline -> clean exit)
+# has ledger records to judge (first run: no baseline -> clean exit);
+# explain runs after sentinel and narrates the newest of those records
 ci: native native-check lint concurrency-lint test dryrun obs-report \
-    sentinel audit
+    sentinel explain audit
 
 lint:
 	$(PY) -c "from flexflow_tpu.analysis.hotpath_lint import main; \
@@ -94,3 +101,6 @@ obs-report:
 
 sentinel:
 	$(CPU_MESH) $(PY) tools/perf_sentinel.py
+
+explain:
+	$(CPU_MESH) $(PY) tools/explain_run.py --latest --json

@@ -191,6 +191,40 @@ class FFConfig:
     # program name -> REASON for waiving OBS002 on a known-divergent
     # program (the pragma contract: an empty reason does not suppress)
     exec_mem_allow: Optional[dict] = None
+    # step-time attribution (obs/attribution.py): "on" (default)
+    # decomposes each fit's measured steady-state step time into phases
+    # (input wait, host dispatch, device compute, collective/transfer,
+    # pipeline bubble, optimizer+metric fold) by joining the tracer
+    # ring, the epoch throughput record, and the pipeline profile
+    # against the simulator's predicted task timeline. Pure-python join
+    # plus one analytic replay — no extra XLA work; the report lands in
+    # fit_profile["attribution"], the run ledger, and the obs server's
+    # /attribution endpoint. "off" skips it.
+    attribution: str = "on"
+    # rows in the attribution report's top-ops and divergence-outlier
+    # rankings
+    attribution_top_k: int = 8
+    # per-op cost corpus (obs/costcorpus.py): "on" times every compiled
+    # op forward AND backward under its real mesh sharding after each
+    # fit and appends featurized, dedup-keyed rows to
+    # .ffcache/costmodel/corpus/ — the training set ROADMAP item 2's
+    # learned cost model consumes. Opt-in ("off" default): collection
+    # jits each op fwd+bwd once, a profiling-run cost.
+    cost_corpus: str = "off"
+    # None = unset: knob > FLEXFLOW_TPU_COSTCORPUS_DIR env > default
+    cost_corpus_dir: Optional[str] = None
+    # observability HTTP server (obs/server.py): a port arms a zero-dep
+    # http.server background thread exposing /metrics (Prometheus),
+    # /healthz (watchdog heartbeat ages), /runs (ledger tail), /trace
+    # (Chrome trace download), /attribution (latest report). None
+    # (default) = no socket, no thread; 0 = OS-assigned ephemeral port
+    # (the bound port is on obs_server().port).
+    obs_server_port: Optional[int] = None
+    # divergence per-op rows kept on each ledger fit record (the top-k
+    # by measured time; 0 = keep none; the record counts what it
+    # truncated either way so it never silently claims full coverage).
+    # The full rows stay in the in-process fit_profile regardless.
+    ledger_per_op_topk: int = 16
     # stall watchdog (obs/watchdog.py): "on" arms a daemon thread fed
     # heartbeats by the fit/eval dispatch loops, the Prefetcher worker,
     # and serving workers; a watched source silent past
@@ -346,6 +380,18 @@ class FFConfig:
                 cfg.exec_telemetry = "on"
             elif a == "--exec-mem-threshold":
                 cfg.exec_mem_threshold = float(_next())
+            elif a == "--attribution":
+                cfg.attribution = _next()
+            elif a == "--attribution-top-k":
+                cfg.attribution_top_k = int(_next())
+            elif a == "--cost-corpus":
+                cfg.cost_corpus = "on"
+            elif a == "--cost-corpus-dir":
+                cfg.cost_corpus_dir = _next()
+            elif a == "--obs-server-port":
+                cfg.obs_server_port = int(_next())
+            elif a == "--ledger-per-op-topk":
+                cfg.ledger_per_op_topk = int(_next())
             elif a == "--watchdog":
                 cfg.watchdog = "on"
             elif a == "--watchdog-threshold":

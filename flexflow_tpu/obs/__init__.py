@@ -22,6 +22,24 @@ Model for Tensor Processing Units", arXiv:2008.01040, and FlexFlow's
   and raising the coded finding OBS001 (warn) past a configurable
   threshold.
 
+The EXPLAIN half (why a run performed the way it did):
+
+* :mod:`.attribution` — **step-time attribution**: the measured
+  steady-state step time decomposed into phases (input wait, host
+  dispatch, device compute, collective/transfer, pipeline bubble,
+  optimizer fold) by joining the tracer ring, the throughput record,
+  and the pipeline profile against the simulator's predicted task
+  timeline; top-k ops by measured-vs-predicted time and the largest
+  divergence contributors, in ``fit_profile["attribution"]``.
+* :mod:`.costcorpus` — **per-op cost corpus**: every compiled op timed
+  forward AND backward under its real sharding, featurized
+  (shapes/dtypes/mesh degrees/flops/bytes) and appended as
+  schema-versioned, dedup-keyed JSONL to ``.ffcache/costmodel/corpus/``
+  — the learned cost model's training set (ROADMAP item 2).
+* :mod:`.server` — **observability HTTP server**: a zero-dep
+  ``http.server`` background thread (role ``ff-obs-server``) serving
+  ``/metrics``, ``/healthz``, ``/runs``, ``/trace``, ``/attribution``.
+
 Plus the DURABLE half (telemetry that outlives the process):
 
 * :mod:`.ledger` — **run ledger**: every compile/fit/eval/serving/bench
@@ -86,4 +104,24 @@ from .watchdog import (  # noqa: F401
     Watchdog,
     configure_watchdog,
     watchdog,
+)
+from .attribution import (  # noqa: F401
+    attribute_fit,
+    attribution_report,
+    format_phase_table,
+    maybe_attribute,
+)
+from .costcorpus import (  # noqa: F401
+    append_rows,
+    build_rows,
+    corpus_dir,
+    load_rows,
+    scan_corpus,
+)
+from .server import (  # noqa: F401
+    ObsServer,
+    configure_obs_server,
+    obs_server,
+    publish_attribution,
+    stop_obs_server,
 )

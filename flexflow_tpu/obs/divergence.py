@@ -76,13 +76,16 @@ def predicted_step_time(ffmodel) -> Optional[Tuple[float, str]]:
     return float(est), "simulator"
 
 
-def op_predictions(ffmodel) -> Dict[str, float]:
-    """Per-op analytic forward time (seconds) for every compiled op."""
+def op_predictions(ffmodel) -> Dict[str, Tuple[float, float]]:
+    """Per-op analytic (forward, backward) times in seconds for every
+    compiled op — both halves, so the per-op comparison covers the same
+    fwd+bwd envelope the measured pass times."""
     from ..sim import OpCostModel, detect_machine_model
 
     cm = ffmodel.compiled
     cost = OpCostModel(detect_machine_model(cm.mesh.devices.size))
-    return {op.name: cost.measure(op).forward_time for op in cm.ops}
+    return {op.name: (cost.measure(op).forward_time,
+                      cost.measure(op).backward_time) for op in cm.ops}
 
 
 def _ratio(measured: float, predicted: float) -> Optional[float]:
@@ -138,23 +141,36 @@ def record_divergence(ffmodel, per_op: bool = True,
 
             predicted_ops = op_predictions(ffmodel)
             try:
-                measured_ops = profile_ops(ffmodel, iters=iters, warmup=1)
+                # fwd AND bwd: a cost model can nail the forward and
+                # still mis-rank every search if its backward factors
+                # drift (the backward is 2/3 of a training step)
+                measured_ops = profile_ops(ffmodel, iters=iters,
+                                           warmup=1, backward=True)
             except Exception as e:  # never kill a fit over a profile
                 measured_ops = []
                 rec["per_op_error"] = f"{type(e).__name__}: {e}"
         for r in measured_ops:
-            p = predicted_ops.get(r["name"])
+            p_fwd, p_bwd = predicted_ops.get(r["name"]) or (0.0, 0.0)
             m_s = r["forward_ms"] / 1e3
+            m_bwd = r.get("backward_ms")
             row = {
                 "name": r["name"],
                 "type": r["type"],
-                "predicted_ms": round((p or 0.0) * 1e3, 6),
+                "predicted_ms": round(p_fwd * 1e3, 6),
                 "measured_ms": round(r["forward_ms"], 6),
-                "ratio": _ratio(m_s, p or 0.0),
+                "ratio": _ratio(m_s, p_fwd),
+                "predicted_bwd_ms": round(p_bwd * 1e3, 6),
+                "measured_bwd_ms": (round(m_bwd, 6)
+                                    if m_bwd is not None else None),
+                "bwd_ratio": (_ratio(m_bwd / 1e3, p_bwd)
+                              if m_bwd is not None else None),
             }
             rows.append(row)
             if row["ratio"]:
                 reg.histogram("divergence.op_ratio").observe(row["ratio"])
+            if row["bwd_ratio"]:
+                reg.histogram("divergence.op_bwd_ratio").observe(
+                    row["bwd_ratio"])
         rec["per_op"] = rows
     # --- OBS001: the coded, warn-level finding past the threshold -------
     thr = getattr(ffmodel.config, "divergence_threshold", None)

@@ -308,9 +308,43 @@ def _watchdog_block() -> Dict:
     return watchdog().stats()
 
 
+def _divergence_for_ledger(div: Dict, config) -> Dict:
+    """The divergence block as the ledger stores it: per-op rows capped
+    at the top-``config.ledger_per_op_topk`` by measured time, with the
+    truncation COUNTED on the record (``per_op_total`` /
+    ``per_op_truncated``) and on the ``ledger.per_op_truncated``
+    counter — a capped record must never read as full coverage."""
+    rows = div.get("per_op")
+    if not rows:
+        return div
+    out = dict(div)
+    raw = getattr(config, "ledger_per_op_topk", 16)
+    k = 16 if raw is None else int(raw)
+    out["per_op_total"] = len(rows)
+    if k <= 0:
+        # explicit 0: keep NO per-op rows on the record (record-size
+        # control on huge graphs) — still counted, never silent
+        out.pop("per_op", None)
+        out["per_op_truncated"] = len(rows)
+        metrics_registry().counter("ledger.per_op_truncated").inc(
+            len(rows))
+        return out
+    if len(rows) <= k:
+        out["per_op_truncated"] = 0
+        return out
+    ranked = sorted(rows, key=lambda r: (-(r.get("measured_ms") or 0.0),
+                                         r.get("name") or ""))
+    out["per_op"] = ranked[:k]
+    out["per_op_truncated"] = len(rows) - k
+    metrics_registry().counter("ledger.per_op_truncated").inc(
+        len(rows) - k)
+    return out
+
+
 def record_fit(ff, kind: str = "fit") -> Optional[Dict]:
     """The per-fit (or per-eval) record: epoch throughput, divergence
-    block, watchdog state, and the full metrics snapshot — the
+    block (per-op rows top-k capped, truncation counted), attribution
+    report, watchdog state, and the full metrics snapshot — the
     divergence flywheel's training rows."""
     try:
         if ledger_mode(ff.config) == "off":
@@ -323,7 +357,12 @@ def record_fit(ff, kind: str = "fit") -> Optional[Dict]:
             "epochs": [dict(e) for e in prof.get("epochs") or []],
         }
         if prof.get("divergence"):
-            rec["divergence"] = prof["divergence"]
+            rec["divergence"] = _divergence_for_ledger(
+                prof["divergence"], ff.config)
+        if prof.get("attribution"):
+            rec["attribution"] = prof["attribution"]
+        if prof.get("cost_corpus"):
+            rec["cost_corpus"] = prof["cost_corpus"]
         if prof.get("pipeline"):
             rec["pipeline"] = _scalars(prof["pipeline"])
         if prof.get("steps_per_s"):

@@ -1,0 +1,336 @@
+"""Zero-dep observability HTTP server: the live scrape/health surface.
+
+Everything the flight recorder knows is in-process only — a scrape
+today means importing the package. ROADMAP item 1's SLO-aware serving
+(the Gemma-on-TPU comparison, arXiv:2605.25645, leans on exactly this
+kind of endpoint) needs a live surface, so this module serves one from
+the stdlib alone (``http.server``; the repo's zero-dep contract):
+
+=================  ====================================================
+``/metrics``       Prometheus text exposition of the process registry
+``/healthz``       JSON liveness: pid, watchdog arm state + per-source
+                   heartbeat ages (an age near the threshold = a stall
+                   about to dump), dump count
+``/runs``          run-ledger tail as JSON (``?n=`` bounds it, def. 20)
+``/trace``         the tracer ring as a Chrome trace-event JSON
+                   download (open in chrome://tracing / Perfetto)
+``/attribution``   the latest AttributionReport (404 until a fit with
+                   ``config.attribution`` on has run)
+=================  ====================================================
+
+Threading discipline (checked by analysis/concurrency_check.py): ONE
+background thread (role ``ff-obs-server``) runs the stdlib server's
+accept loop; request handlers only ever READ thread-safe surfaces (the
+metrics registry, the watchdog's locked ``stats()``, the ledger's
+on-disk scan, the tracer's locked ``events()``, and this module's
+lock-guarded latest-attribution slot). ``stop()`` shuts the socket
+down and joins the thread OUTSIDE the server's lock.
+
+Gating: ``config.obs_server_port`` is None (default — no socket, no
+thread) or a port (``0`` = OS-assigned ephemeral, the test/multi-proc
+mode; the bound port is on ``ObsServer.port``). The config path only
+ratchets ON, the tracer/watchdog contract.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+from urllib.parse import parse_qs, urlparse
+
+from .metrics import metrics_registry
+
+DEFAULT_RUNS_TAIL = 20
+
+# latest AttributionReport published by the fit hook + the ledger dir
+# the configuring model resolved (a --ledger-dir override must be the
+# directory /runs scrapes, not the env/default fallback); one lock
+# guards both slots (written by whichever thread runs fit/compile,
+# read by handler threads)
+_attr_mu = threading.Lock()
+_LATEST_ATTRIBUTION: Optional[Dict] = None
+_LEDGER_DIR: Optional[str] = None
+
+
+def publish_attribution(report: Dict) -> None:
+    """Make a fit's AttributionReport visible on ``/attribution``."""
+    global _LATEST_ATTRIBUTION
+    with _attr_mu:
+        _LATEST_ATTRIBUTION = dict(report)
+
+
+def latest_attribution() -> Optional[Dict]:
+    with _attr_mu:
+        return (dict(_LATEST_ATTRIBUTION)
+                if _LATEST_ATTRIBUTION is not None else None)
+
+
+def _publish_ledger_dir(dirpath: Optional[str]) -> None:
+    global _LEDGER_DIR
+    with _attr_mu:
+        _LEDGER_DIR = dirpath
+
+
+def _served_ledger_dir() -> Optional[str]:
+    with _attr_mu:
+        return _LEDGER_DIR
+
+
+# ----------------------------------------------------------- the handler
+class _Handler(BaseHTTPRequestHandler):
+    # the stdlib logs every request to stderr by default — route the
+    # signal to the metrics registry instead of polluting training logs
+    def log_message(self, fmt, *args):  # noqa: D102 — stdlib override
+        pass
+
+    def _send(self, status: int, body: bytes, ctype: str) -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, doc, status: int = 200) -> None:
+        self._send(status, json.dumps(doc, sort_keys=True,
+                                      default=str).encode(),
+                   "application/json")
+
+    def do_GET(self):  # noqa: N802 — stdlib contract
+        reg = metrics_registry()
+        reg.counter("obs_server.requests").inc()
+        url = urlparse(self.path)
+        try:
+            if url.path == "/metrics":
+                self._send(200, reg.to_prometheus().encode(),
+                           "text/plain; version=0.0.4")
+            elif url.path == "/healthz":
+                self._send_json(_healthz())
+            elif url.path == "/runs":
+                q = parse_qs(url.query)
+                try:
+                    n = int(q.get("n", [DEFAULT_RUNS_TAIL])[0])
+                except (TypeError, ValueError):
+                    n = DEFAULT_RUNS_TAIL
+                self._send_json(_runs_tail(max(1, n)))
+            elif url.path == "/trace":
+                from .trace import tracer
+
+                tr = tracer()
+                self._send_json({"traceEvents": tr.events(),
+                                 "displayTimeUnit": "ms",
+                                 "metadata": tr.export_metadata()})
+            elif url.path == "/attribution":
+                rec = latest_attribution()
+                if rec is None:
+                    self._send_json(
+                        {"unavailable": "no attribution report yet — "
+                         "run a fit with config.attribution='on'"},
+                        status=404)
+                else:
+                    self._send_json(rec)
+            else:
+                self._send_json(
+                    {"error": f"unknown path {url.path!r}",
+                     "endpoints": ["/metrics", "/healthz", "/runs",
+                                   "/trace", "/attribution"]},
+                    status=404)
+        except Exception as e:  # noqa: BLE001 — a bad scrape must not
+            reg.counter("obs_server.errors").inc()  # kill the server
+            try:
+                self._send_json(
+                    {"error": f"{type(e).__name__}: {e}"}, status=500)
+            except Exception:  # noqa: BLE001 — client already gone
+                pass
+
+
+def _healthz() -> Dict:
+    import os
+
+    from .watchdog import watchdog
+
+    wd = watchdog().stats()
+    return {
+        "ok": wd["dumps"] == 0,
+        "pid": os.getpid(),
+        "watchdog": wd,
+    }
+
+
+def _runs_tail(n: int) -> Dict:
+    from .ledger import ledger_dir, scan_ledger
+
+    # the directory the CONFIGURING model writes to (configure_obs_server
+    # published it), falling back to the env/default resolution for a
+    # server started without a config
+    d = _served_ledger_dir() or ledger_dir()
+    scan = scan_ledger(d)
+    return {
+        "dir": d,
+        "files": scan["files"],
+        "total_runs": len(scan["runs"]),
+        "corrupt_lines": scan["corrupt_lines"],
+        "runs": scan["runs"][-n:],
+    }
+
+
+def _make_httpd(host: str, port: int) -> ThreadingHTTPServer:
+    httpd = ThreadingHTTPServer((host, port), _Handler)
+    httpd.daemon_threads = True  # per-request threads die with us
+    return httpd
+
+
+# ------------------------------------------------------------- the server
+class ObsServer:
+    """One background accept loop serving the endpoints above. Tests
+    construct their own on port 0; the process-wide instance comes from
+    :func:`configure_obs_server`."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1"):
+        self._host = host
+        self._requested_port = int(port)
+        self._mu = threading.Lock()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._port: Optional[int] = None
+
+    @property
+    def port(self) -> Optional[int]:
+        """The bound port (None until :meth:`start`)."""
+        with self._mu:
+            return self._port
+
+    @property
+    def url(self) -> Optional[str]:
+        with self._mu:
+            if self._port is None:
+                return None
+            return f"http://{self._host}:{self._port}"
+
+    def running(self) -> bool:
+        with self._mu:
+            return self._thread is not None and self._thread.is_alive()
+
+    def start(self) -> int:
+        """Bind + serve in the background; idempotent. Returns the
+        bound port."""
+        with self._mu:
+            # a created-but-not-yet-started thread (ident None) counts
+            # as the server: its creator starts it below — two racing
+            # start() calls must not bind two sockets (watchdog.arm's
+            # duplicate-monitor discipline)
+            cur = self._thread
+            if cur is not None and (cur.ident is None
+                                    or cur.is_alive()):
+                return self._port
+            httpd = _make_httpd(self._host, self._requested_port)
+            self._httpd = httpd
+            self._port = int(httpd.server_address[1])
+            t = threading.Thread(target=httpd.serve_forever,
+                                 name="ff-obs-server", daemon=True)
+            self._thread = t
+            port = self._port
+        t.start()
+        metrics_registry().gauge("obs_server.port").set(float(port))
+        return port
+
+    def stop(self) -> None:
+        """Shut the accept loop down and join the thread; the socket
+        teardown and join run OUTSIDE the lock (they block)."""
+        with self._mu:
+            httpd = self._httpd
+            t = self._thread
+            self._httpd = None
+            self._thread = None
+            self._port = None
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        if t is not None:
+            t.join(timeout=10)
+
+
+# -------------------------------------------------------- process server
+_server_mu = threading.Lock()
+_SERVER: Optional[ObsServer] = None
+
+
+def obs_server() -> Optional[ObsServer]:
+    """The process-wide server, or None when never configured."""
+    with _server_mu:
+        return _SERVER
+
+
+def server_port_knob(config) -> Optional[int]:
+    """The validated ``config.obs_server_port`` (None = off; 0 =
+    ephemeral; a non-int or negative value fails loudly at
+    compile/fit entry, the mode-knob convention)."""
+    port = getattr(config, "obs_server_port", None)
+    if port is None:
+        return None
+    try:
+        port = int(port)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"obs_server_port={port!r}: expected None or an int >= 0")
+    if port < 0 or port > 65535:
+        raise ValueError(
+            f"obs_server_port={port}: expected 0 (ephemeral) or a "
+            f"valid TCP port")
+    return port
+
+
+def configure_obs_server(config=None,
+                         port: Optional[int] = None) -> Optional[ObsServer]:
+    """Apply ``config.obs_server_port`` (or an explicit ``port``) to
+    the process server. The config path only ratchets ON — a later
+    model whose config left the knob unset must not tear down a
+    surface an opted-in model started (the tracer/watchdog contract).
+    The FIRST configuration binds the socket; a later call asking for
+    a *different* port keeps the running server (one scrape surface
+    per process) and says so loudly — read ``obs_server().port`` for
+    the port actually bound."""
+    global _SERVER
+    if port is None:
+        if config is None:
+            return obs_server()
+        port = server_port_knob(config)
+        if port is None:
+            return obs_server()
+    with _server_mu:
+        srv = _SERVER
+        if srv is None:
+            srv = _SERVER = ObsServer(port=port)
+    bound = srv.start()
+    if port not in (0, bound) and srv._requested_port != port:
+        import sys
+
+        print(f"[obs-server] already serving on port {bound}; "
+              f"ignoring the later request for port {port} (one "
+              f"scrape surface per process — stop_obs_server() first "
+              f"to rebind)", file=sys.stderr, flush=True)
+        metrics_registry().counter("obs_server.port_conflicts").inc()
+    if config is not None:
+        from .ledger import ledger_dir
+
+        _publish_ledger_dir(ledger_dir(config))
+    return srv
+
+
+def stop_obs_server() -> None:
+    """Tear the process server down (tests + explicit shutdown only —
+    nothing in the workload path calls this)."""
+    global _SERVER
+    with _server_mu:
+        srv = _SERVER
+        _SERVER = None
+    if srv is not None:
+        srv.stop()
+
+
+__all__ = [
+    "DEFAULT_RUNS_TAIL", "ObsServer", "configure_obs_server",
+    "latest_attribution", "obs_server", "publish_attribution",
+    "server_port_knob", "stop_obs_server",
+]

@@ -283,11 +283,18 @@ class Watchdog:
     # -------------------------------------------------------------- reading
     def stats(self) -> Dict:
         with self._cv:
+            now = time.monotonic()
             return {
                 "enabled": self.enabled,
                 "threshold_s": self._threshold,
                 "dump_dir": self._dir,
                 "watched": sorted(self._watched),
+                # seconds since each live source's last beat — the obs
+                # server's /healthz liveness signal (age near the
+                # threshold = a stall about to dump)
+                "watched_age_s": {k: round(now - t, 3)
+                                  for k, t in sorted(
+                                      self._watched.items())},
                 "sources_seen": sorted(self._seen),
                 "dumps": self._dumps,
             }

@@ -737,11 +737,19 @@ class FFModel:
         configure_tracer(self.config)  # config.trace="on" arms the recorder
         # typo'd obs mode knobs fail HERE, before any search/XLA work is
         # paid (the convention every mode knob follows)
+        from ..obs.attribution import attribution_mode as _attr_mode
+        from ..obs.costcorpus import corpus_mode as _corpus_mode
         from ..obs.exec_telemetry import telemetry_mode as _telemetry_mode
         from ..obs.ledger import ledger_mode as _ledger_mode
+        from ..obs.server import configure_obs_server as _cfg_obs_server
 
         _ledger_mode(self.config)
         _telemetry_mode(self.config)
+        _attr_mode(self.config)
+        _corpus_mode(self.config)
+        # config.obs_server_port arms the scrape/health surface (ratchet-
+        # on, like the tracer; a bad port value raises here)
+        _cfg_obs_server(self.config)
         _t0_compile = time.perf_counter()
         if optimizer is not None:
             self.optimizer = optimizer
@@ -1795,13 +1803,19 @@ class FFModel:
         land in ``self.fit_profile``."""
         assert self.compiled is not None, "call compile() first"
         _tr = configure_tracer(self.config)
+        from ..obs.attribution import attribution_mode
+        from ..obs.costcorpus import corpus_mode
         from ..obs.divergence import divergence_mode
         from ..obs.ledger import ledger_mode, record_fit
+        from ..obs.server import configure_obs_server
         from ..obs.watchdog import beat as _wd_beat
         from ..obs.watchdog import configure_watchdog
 
         divergence_mode(self.config)  # typo fails BEFORE training, not after
         ledger_mode(self.config)      # same contract for the ledger knob
+        attribution_mode(self.config)
+        corpus_mode(self.config)
+        configure_obs_server(self.config)  # ratchet-on scrape surface
         # config.watchdog="on" arms the stall monitor (threshold/dir from
         # config); the step loop below heartbeats it via the Prefetcher's
         # watched section plus the explicit per-step beat
@@ -1964,8 +1978,24 @@ class FFModel:
         from ..obs.divergence import maybe_record_divergence
 
         maybe_record_divergence(self)
+        # step-time attribution (config.attribution; obs/attribution.py):
+        # AFTER divergence so the per-op measured rows are joinable
+        from ..obs.attribution import maybe_attribute
+
+        maybe_attribute(self)
+        if self.config.profiling and (self.fit_profile or {}).get(
+                "attribution"):
+            from ..obs.attribution import format_phase_table
+
+            print(format_phase_table(self.fit_profile["attribution"]),
+                  flush=True)
+        # per-op cost corpus (config.cost_corpus; obs/costcorpus.py):
+        # measured fwd+bwd rows for the learned cost model's flywheel
+        from ..obs.costcorpus import maybe_collect_corpus
+
+        maybe_collect_corpus(self)
         # durable telemetry: one ledger record per fit — throughput,
-        # divergence block, watchdog state, full metrics snapshot
+        # divergence block, attribution, watchdog state, metrics snapshot
         record_fit(self)
         return history
 

@@ -84,6 +84,21 @@ from ..obs.watchdog import (  # noqa: F401
     configure_watchdog,
     watchdog,
 )
+from ..obs.attribution import (  # noqa: F401
+    attribute_fit,
+    attribution_report,
+    format_phase_table,
+)
+from ..obs.costcorpus import (  # noqa: F401
+    corpus_dir,
+    load_rows,
+    scan_corpus,
+)
+from ..obs.server import (  # noqa: F401
+    ObsServer,
+    configure_obs_server,
+    obs_server,
+)
 from ..utils.dot import DotFile
 
 
@@ -130,11 +145,74 @@ def trace(logdir: str):
 
 
 # ----------------------------------------------------------- per-op profiling
-def profile_ops(ffmodel, iters: int = 10, warmup: int = 2) -> List[Dict]:
+def _op_backward_ms(op, ctx, ins, weights, forward_ms: float,
+                    iters: int, warmup: int) -> Optional[float]:
+    """Time one op's backward pass standalone: jit the fwd+vjp of a
+    scalar reduction over the op's float outputs w.r.t. its float
+    inputs and weights, then subtract the already-measured forward time
+    (jitting the vjp application alone would bake the residuals in as
+    closed-over constants — exactly what AUD001 exists to flag).
+    Returns None for non-differentiable ops (integer-only
+    inputs+weights, or no float output to pull a cotangent through)."""
+    import jax
+    import jax.numpy as jnp
+
+    diff_idx = [i for i, a in enumerate(ins)
+                if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating)]
+    wkeys = sorted(k for k, v in weights.items()
+                   if jnp.issubdtype(jnp.asarray(v).dtype, jnp.floating))
+    if not diff_idx and not wkeys:
+        return None
+
+    def scalar_loss(diff_ins, diff_w):
+        full_ins = list(ins)
+        for i, a in zip(diff_idx, diff_ins):
+            full_ins[i] = a
+        full_w = dict(weights)
+        full_w.update(diff_w)
+        outs = op.forward(ctx, full_ins, full_w)
+        tot = None
+        for o in outs:
+            if jnp.issubdtype(o.dtype, jnp.floating):
+                s = o.astype(jnp.float32).sum()
+                tot = s if tot is None else tot + s
+        if tot is None:
+            raise TypeError("no float output to differentiate")
+        return tot
+
+    fwd_bwd = jax.jit(jax.grad(scalar_loss, argnums=(0, 1)))
+    d_ins = [ins[i] for i in diff_idx]
+    d_w = {k: weights[k] for k in wkeys}
+    try:
+        g = fwd_bwd(d_ins, d_w)  # compile
+        jax.block_until_ready(g)
+    except Exception:  # non-differentiable op — report None, not a crash
+        return None
+    for _ in range(warmup):
+        g = fwd_bwd(d_ins, d_w)
+    jax.block_until_ready(g)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        g = fwd_bwd(d_ins, d_w)
+    jax.block_until_ready(g)
+    full_ms = (time.perf_counter() - t0) / iters * 1e3
+    # the timed program runs forward AND backward; the backward share is
+    # what is left after the standalone forward (clamped: timer noise on
+    # a loaded host can put full under fwd for trivial ops)
+    return max(0.0, full_ms - forward_ms)
+
+
+def profile_ops(ffmodel, iters: int = 10, warmup: int = 2,
+                backward: bool = False) -> List[Dict]:
     """Time each compiled op's forward standalone (reference: per-op
     cudaEvent profiling under --profiling, OpMeta::profiling op_meta.h:17).
     Returns one record per op: name, type, ms, flops, arithmetic intensity.
-    """
+
+    ``backward=True`` additionally times each op's backward via
+    ``jax.vjp`` (a jitted fwd+grad program minus the forward) under the
+    same real mesh sharding — ``backward_ms`` per record, None for
+    non-differentiable ops. The per-op divergence comparison and the
+    cost-corpus collector (obs/costcorpus.py) both ride this."""
     import jax
 
     from ..core.op import LowerCtx
@@ -167,13 +245,17 @@ def profile_ops(ffmodel, iters: int = 10, warmup: int = 2) -> List[Dict]:
         for t, o in zip(op.layer.outputs, outs):
             acts[t.tensor_id] = o
         fl = op.flops()
-        records.append({
+        rec = {
             "name": op.name,
             "type": op.op_type.value,
             "forward_ms": ms,
             "flops": fl,
             "gflops_per_s": (fl / (ms * 1e-3)) / 1e9 if ms > 0 else 0.0,
-        })
+        }
+        if backward:
+            rec["backward_ms"] = _op_backward_ms(
+                op, ctx, ins, weights, ms, iters, warmup)
+        records.append(rec)
     return records
 
 

@@ -105,10 +105,14 @@ class ModelInstance:
             raise ValueError("compile() the FFModel before serving it")
         # a serving-only process never runs fit()/eval(), so the served
         # model's config must arm the stall monitor here or the worker
-        # watch sections would be permanent no-ops
+        # watch sections would be permanent no-ops — and likewise the
+        # scrape/health surface (config.obs_server_port), which ROADMAP
+        # item 1's SLO-aware serving scrapes for /metrics + /healthz
+        from ..obs.server import configure_obs_server
         from ..obs.watchdog import configure_watchdog
 
         configure_watchdog(ff.config)
+        configure_obs_server(ff.config)
         self.name = name
         self._ff = ff
         cm = ff.compiled

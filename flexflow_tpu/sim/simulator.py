@@ -299,12 +299,8 @@ class Simulator:
 
     # ------------------------------------------------------------- simulate
     def _effective_runtime(self, task: SimTask, bwd_total: float) -> float:
-        run = task.run_time
-        if task.name == "grad_sync" and self.overlap_grad_sync:
-            # XLA's latency-hiding scheduler overlaps grad all-reduce
-            # with backward compute; only the un-hidden tail is paid
-            run = max(run - 0.5 * bwd_total, run * 0.1)
-        return run
+        return effective_task_runtime(task, bwd_total,
+                                      self.overlap_grad_sync)
 
     def simulate_runtime(self, ops: List[Op]) -> float:
         """Estimated per-iteration seconds (reference:
@@ -397,6 +393,45 @@ class Simulator:
 
     def fits_memory(self, ops: List[Op]) -> bool:
         return self.memory_usage(ops).total <= self.machine.chip.hbm_capacity
+
+
+# --------------------------------------------------- phase decomposition
+def effective_task_runtime(task: SimTask, bwd_total: float,
+                           overlap_grad_sync: bool = True) -> float:
+    """One task's replay-priced runtime: grad sync pays only its
+    un-hidden tail when XLA's latency-hiding scheduler overlaps the
+    all-reduce with backward compute. The ONE copy of the overlap
+    discount — the replay (:meth:`Simulator._effective_runtime`) and
+    the attribution bucketing (:func:`task_phase_totals`) must price
+    identically or the phase shares drift from what steered the
+    search."""
+    run = task.run_time
+    if task.name == "grad_sync" and overlap_grad_sync:
+        run = max(run - 0.5 * bwd_total, run * 0.1)
+    return run
+
+
+def task_phase_totals(tasks: List[SimTask],
+                      overlap_grad_sync: bool = True) -> Dict[str, float]:
+    """Bucket a SimTask list (:meth:`Simulator.last_tasks`) into the
+    attribution engine's device phases — predicted seconds of forward/
+    backward compute, collective/transfer time, and the optimizer
+    update — via the same :func:`effective_task_runtime` pricing the
+    replay uses, so the fractions match what the replay priced. The
+    obs/attribution.py engine scales measured residual step time over
+    these proportions."""
+    bwd_total = sum(t.run_time for t in tasks if t.kind == "bwd")
+    compute = collective = update = 0.0
+    for t in tasks:
+        run = effective_task_runtime(t, bwd_total, overlap_grad_sync)
+        if t.kind in ("fwd", "bwd"):
+            compute += run
+        elif t.kind == "comm":
+            collective += run
+        elif t.kind == "update":
+            update += run
+    return {"device_compute": compute, "collective_transfer": collective,
+            "optimizer_fold": update}
 
 
 # ------------------------------------------------- pipeline schedule model

@@ -1,0 +1,235 @@
+#!/usr/bin/env python
+"""Explain one run: the whole observability story for a ledger record.
+
+Given a run id (prefix match) or ``--latest``, renders everything the
+repo knows about that run — the attribution phase breakdown (where the
+step time went), the top ops by measured-vs-predicted time, the largest
+divergence contributors, and the perf-sentinel cohort trend (this run
+against the median of its prior cohort values) — as human-readable text
+or ONE JSON line (``--json``)::
+
+    {"run_id": ..., "kind": "fit", "phases": {...},
+     "reconciliation": {"reconciles": true, ...},
+     "dominant_phase": ..., "top_ops": [...],
+     "divergence_outliers": [...], "divergence": {...},
+     "cohort": {"runs": N, "baseline": ..., "ratio": ..., "verdict": ...},
+     "exit": 0}
+
+Exit status 1 when no record matches, or the selected record's phase
+table fails its reconciliation check (a table that does not telescope
+back to the measured step time is a bug, not a rendering detail).
+
+Usage::
+
+    python tools/explain_run.py --latest
+    python tools/explain_run.py 3f2a9c --json
+    python tools/explain_run.py --latest --ledger-dir /path/to/runs
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _median(xs: List[float]) -> float:
+    xs = sorted(xs)
+    n = len(xs)
+    return xs[n // 2] if n % 2 else 0.5 * (xs[n // 2 - 1] + xs[n // 2])
+
+
+def _select(runs: List[Dict], run_id: Optional[str]) -> Optional[Dict]:
+    """The record to explain: an exact/prefix run-id match, else the
+    newest fit-like record carrying an attribution block, else the
+    newest record at all (so --latest never goes dark on old corpora)."""
+    if run_id:
+        for r in reversed(runs):
+            if (r.get("run_id") or "").startswith(run_id):
+                return r
+        return None
+    for r in reversed(runs):
+        if r.get("attribution"):
+            return r
+    for r in reversed(runs):
+        if r.get("kind") in ("fit", "eval"):
+            return r
+    return runs[-1] if runs else None
+
+
+def _cohort_trend(rec: Dict, runs: List[Dict]) -> Dict:
+    """This run against its sentinel cohort (same (kind, metric, model,
+    mesh, knobs, backend) — the perf_sentinel methodology: the newest
+    value vs the MEDIAN of the priors)."""
+    from flexflow_tpu.obs.ledger import cohort_key
+
+    perf = rec.get("perf") or {}
+    if not isinstance(perf.get("value"), (int, float)) or not perf.get(
+            "metric"):
+        return {"verdict": "no_perf_handle"}
+    key = cohort_key(rec)
+    cohort = sorted(
+        (r for r in runs
+         if isinstance((r.get("perf") or {}).get("value"), (int, float))
+         and cohort_key(r) == key),
+        key=lambda r: (r.get("ts_unix_s") or 0, r.get("run_id") or ""))
+    values = [float(r["perf"]["value"]) for r in cohort]
+    prior = [float(r["perf"]["value"]) for r in cohort
+             if r.get("run_id") != rec.get("run_id")]
+    out: Dict = {
+        "metric": perf["metric"],
+        "value": float(perf["value"]),
+        "higher_is_better": bool(perf.get("higher_is_better", True)),
+        "runs": len(cohort),
+        "trend": [round(v, 6) for v in values[-8:]],
+    }
+    if not prior:
+        out["verdict"] = "no_baseline"
+        return out
+    baseline = _median(prior)
+    out["baseline"] = round(baseline, 6)
+    out["ratio"] = (round(out["value"] / baseline, 4)
+                    if baseline > 0 else None)
+    out["verdict"] = "ok"
+    return out
+
+
+def explain(run_id: Optional[str] = None,
+            ledger_dir: Optional[str] = None) -> Dict:
+    from flexflow_tpu.obs.ledger import ledger_dir as _ledger_dir
+    from flexflow_tpu.obs.ledger import scan_ledger
+
+    scan = scan_ledger(ledger_dir)
+    runs = scan["runs"]
+    rec = _select(runs, run_id)
+    if rec is None:
+        return {"error": (f"no run matching {run_id!r}" if run_id
+                          else "ledger is empty"),
+                "ledger": {"dir": ledger_dir or _ledger_dir(),
+                           "runs": len(runs)},
+                "exit": 1}
+    attr = rec.get("attribution") or {}
+    rcn = attr.get("reconciliation") or {}
+    div = rec.get("divergence") or {}
+    doc: Dict = {
+        "run_id": rec.get("run_id"),
+        "kind": rec.get("kind"),
+        "ts_unix_s": rec.get("ts_unix_s"),
+        "machine": rec.get("machine"),
+        "label": rec.get("label") or rec.get("model_sig"),
+        "mesh": rec.get("mesh"),
+        "knobs": rec.get("knobs"),
+        "steps_per_s": (rec.get("throughput") or {}).get("steps_per_s"),
+        "phases": attr.get("phases"),
+        "phase_order": attr.get("phase_order"),
+        "measured_step_s": attr.get("measured_step_s"),
+        "reconciliation": rcn or None,
+        "dominant_phase": attr.get("dominant_phase"),
+        "top_ops": attr.get("top_ops"),
+        "divergence_outliers": attr.get("divergence_outliers"),
+        "divergence": ({
+            "source": div.get("source"),
+            "e2e_ratio": div.get("e2e_ratio"),
+            "predicted_step_s": div.get("predicted_step_s"),
+            "measured_step_s": div.get("measured_step_s"),
+            "per_op_total": div.get("per_op_total"),
+            "per_op_truncated": div.get("per_op_truncated"),
+            "findings": div.get("findings"),
+        } if div else None),
+        "watchdog": rec.get("watchdog"),
+        "cohort": _cohort_trend(rec, runs),
+        "ledger": {"dir": ledger_dir or _ledger_dir(),
+                   "runs": len(runs),
+                   "corrupt_lines": scan["corrupt_lines"]},
+    }
+    # exit contract: a selected record whose phase table does not
+    # reconcile is a bug upstream — fail the gate, don't prettify it
+    doc["exit"] = 1 if (attr and rcn and not rcn.get("reconciles")) else 0
+    return doc
+
+
+# ------------------------------------------------------------ rendering
+def _render_text(doc: Dict) -> str:
+    if doc.get("error"):
+        return f"explain_run: {doc['error']} (ledger {doc['ledger']})"
+    lines = [
+        f"run {doc['run_id']} kind={doc['kind']} "
+        f"label={doc['label']} mesh={doc['mesh']}",
+        f"machine {doc.get('machine')}",
+    ]
+    if doc.get("steps_per_s"):
+        lines.append(f"throughput {doc['steps_per_s']} steps/s")
+    if doc.get("phases"):
+        from flexflow_tpu.obs.attribution import format_phase_table
+
+        lines.append(format_phase_table({
+            "measured_step_s": doc["measured_step_s"],
+            "dominant_phase": doc["dominant_phase"],
+            "reconciliation": doc["reconciliation"],
+            "phases": doc["phases"],
+            "phase_order": doc["phase_order"],
+        }))
+    else:
+        lines.append("(no attribution block on this record — fit with "
+                     "config.attribution='on' to get one)")
+    if doc.get("top_ops"):
+        lines.append("top ops (measured vs predicted, fwd+bwd):")
+        lines.append("  %-24s %-12s %10s %10s %8s" % (
+            "op", "type", "meas ms", "pred ms", "ratio"))
+        for r in doc["top_ops"]:
+            lines.append("  %-24s %-12s %10s %10.3f %8s" % (
+                r["name"][:24], r["type"][:12],
+                ("%.3f" % r["measured_ms"])
+                if r.get("measured_ms") is not None else "-",
+                r["predicted_ms"],
+                ("%.2f" % r["ratio"])
+                if r.get("ratio") is not None else "-"))
+    if doc.get("divergence_outliers"):
+        lines.append("largest divergence contributors:")
+        for r in doc["divergence_outliers"]:
+            lines.append(f"  {r['abs_error_ms']:.3f}ms off — "
+                         f"{r['provenance']}")
+    d = doc.get("divergence")
+    if d:
+        trunc = d.get("per_op_truncated")
+        lines.append(
+            f"divergence: e2e_ratio={d.get('e2e_ratio')} "
+            f"(source {d.get('source')}; per-op rows "
+            f"{d.get('per_op_total')}, {trunc or 0} truncated)")
+    c = doc.get("cohort") or {}
+    if c.get("verdict") == "ok":
+        lines.append(
+            f"cohort trend ({c['metric']}, {c['runs']} runs): "
+            f"value {c['value']} vs baseline {c['baseline']} "
+            f"(ratio {c['ratio']}); recent {c['trend']}")
+    else:
+        lines.append(f"cohort trend: {c.get('verdict')}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("run_id", nargs="?", default=None,
+                    help="run id (prefix match) from the ledger")
+    ap.add_argument("--latest", action="store_true",
+                    help="explain the newest attribution-bearing run")
+    ap.add_argument("--ledger-dir", default=None)
+    ap.add_argument("--json", action="store_true",
+                    help="emit one JSON line instead of text")
+    ns = ap.parse_args(argv)
+    if not ns.run_id and not ns.latest:
+        ap.error("pass a run id or --latest")
+    doc = explain(run_id=ns.run_id, ledger_dir=ns.ledger_dir)
+    if ns.json:
+        print(json.dumps(doc, sort_keys=True, default=str))
+    else:
+        print(_render_text(doc))
+    return doc["exit"]
+
+
+if __name__ == "__main__":
+    sys.exit(main())

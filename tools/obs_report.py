@@ -20,6 +20,8 @@ Exercises the full observability surface end to end — the CI smoke for
     {"trace": {"events": N, "by_cat": {...}, "valid": true, "path": ...},
      "metrics": {...full registry snapshot...},
      "divergence": {"e2e_ratio": ..., "per_op": [...], ...},
+     "attribution": {"reconciliation": {...}, "dominant_phase": ...,
+                     "phases": {...}, "top_ops": [...]},
      "pipeline": {"schedule": ..., "engine": ..., "dispatches_per_step": ...},
      "ledger": {"dir": ..., "runs": N, "kinds": [...]},
      "exec": {"programs": {name: {"flops": ..., "bytes_accessed": ...,
@@ -28,9 +30,11 @@ Exercises the full observability surface end to end — the CI smoke for
      "exit": 0}
 
 Exit status 1 when the trace fails validation, the divergence block is
-missing, the serving/fit counters did not populate, the ledger stayed
-empty, a telemetry block lacks both numbers and an ``unavailable``
-reason, or the watchdog wrote a dump during the healthy run.
+missing, the attribution phase table is absent or fails to reconcile
+with the measured step time, the serving/fit counters did not populate,
+the ledger stayed empty, a telemetry block lacks both numbers and an
+``unavailable`` reason, or the watchdog wrote a dump during the healthy
+run.
 
 Usage::
 
@@ -144,6 +148,7 @@ def run_report(samples: int = 64, epochs: int = 2, requests: int = 4,
 
     snapshot = metrics_registry().to_json()
     divergence = report.get("divergence") or {}
+    attribution = report.get("attribution") or {}
     pipeline = report.get("pipeline") or {}
     missing = [k for k in ("fit.steps", "serving.requests")
                if k not in snapshot]
@@ -165,9 +170,15 @@ def run_report(samples: int = 64, epochs: int = 2, requests: int = 4,
         any(k in b for k in ("flops", "bytes_accessed", "peak_bytes",
                              "unavailable"))
         for b in exec_block["programs"].values())
+    # attribution gate: the phase table must exist for the traced fit
+    # and telescope back to the measured step time — a non-reconciling
+    # table means the engine mis-decomposed and the report exits 1
+    attr_ok = bool(attribution) and bool(
+        (attribution.get("reconciliation") or {}).get("reconciles"))
     ok = (n_events > 0 and not problems and not missing
           and bool(divergence.get("e2e_ratio"))
           and divergence.get("per_op")
+          and attr_ok
           and ledger_block["runs"] > 0
           and exec_ok
           and wd_block["enabled"] and wd_block["dumps"] == 0)
@@ -181,6 +192,13 @@ def run_report(samples: int = 64, epochs: int = 2, requests: int = 4,
         },
         "metrics": snapshot,
         "divergence": divergence,
+        "attribution": {
+            "reconciliation": attribution.get("reconciliation"),
+            "dominant_phase": attribution.get("dominant_phase"),
+            "phases": attribution.get("phases"),
+            "top_ops": [r.get("name")
+                        for r in attribution.get("top_ops") or []],
+        } if attribution else {},
         "pipeline": {k: pipeline.get(k) for k in
                      ("schedule", "engine", "dispatches_per_step",
                       "bubble_fraction")} if pipeline else {},

@@ -18,6 +18,11 @@ cohort with a big-enough baseline (``--min-baseline`` prior runs — a
 single prior run is machine noise, not a baseline). An empty ledger or
 all-new cohorts exit 0 with ``"verdict": "no_baseline"``.
 
+Each cohort row carries the newest run's attributed ``dominant_phase``
+(obs/attribution.py) so a regression verdict names its suspect —
+``input_wait`` points at the feed, ``collective_transfer`` at comm,
+``pipeline_bubble`` at the schedule — instead of just a ratio.
+
 The ``exec`` and ``watchdog`` blocks surface the newest ledger
 record's executable telemetry (flops/bytes/peak memory per program, or
 its explicit ``unavailable`` reason) and watchdog state plus the
@@ -81,6 +86,12 @@ def _judge_cohort(key: str, runs: List[Dict], margin: float,
         "runs": len(runs),
         "newest": float(perf["value"]),
         "newest_run_id": newest.get("run_id"),
+        # the attribution engine's phase verdict for the newest run: a
+        # regression row NAMES its suspect (input_wait = feed problem,
+        # collective_transfer = comm problem, ...) instead of just a
+        # ratio; None when the run carried no attribution block
+        "dominant_phase": (newest.get("attribution") or {}).get(
+            "dominant_phase"),
     }
     if len(prior) < min_baseline:
         row.update({"verdict": "no_baseline", "baseline_runs": len(prior)})
