@@ -40,8 +40,18 @@
 #                   run vs the per-(model, mesh, knobs) cohort baseline
 #                   (median of priors); one JSON line incl. ledger /
 #                   exec-telemetry / watchdog blocks + the attributed
-#                   dominant phase per cohort verdict; exit 1 on a
+#                   dominant phase per cohort verdict; fault-injected
+#                   (chaos) runs are cohort-excluded; exit 1 on a
 #                   regression beyond the margin
+#   make chaos    — fault-tolerance matrix (tools/chaos_bench.py): runs
+#                   the deterministic fault plans (subprocess kill at
+#                   step N, torn checkpoint, NaN loss, watchdog stall,
+#                   serving-worker crash, overload shed) and asserts
+#                   every recovery invariant — resume bit-identity, no
+#                   torn reads, every accepted serving future resolves,
+#                   black-box dump on stall, bounded shed, zero overhead
+#                   when the plan is off; one JSON line; exit 1 on any
+#                   violated invariant
 #   make explain  — explain the newest ledger run: attribution phase
 #                   breakdown (must reconcile with the measured step
 #                   time), top ops measured-vs-predicted, divergence
@@ -52,13 +62,16 @@ PY ?= python
 CPU_MESH = JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
 .PHONY: ci native native-check lint concurrency-lint pcg-lint audit \
-        test dryrun bench bench-fit bench-pipe obs-report sentinel explain
+        test dryrun bench bench-fit bench-pipe obs-report sentinel chaos \
+        explain
 
 # sentinel runs AFTER obs-report so a fresh checkout's first ci already
 # has ledger records to judge (first run: no baseline -> clean exit);
-# explain runs after sentinel and narrates the newest of those records
+# chaos runs after sentinel (its fault matrix uses its own tmp ledger,
+# never the corpus the sentinel just judged); explain runs last and
+# narrates the newest of those records
 ci: native native-check lint concurrency-lint test dryrun obs-report \
-    sentinel explain audit
+    sentinel chaos explain audit
 
 lint:
 	$(PY) -c "from flexflow_tpu.analysis.hotpath_lint import main; \
@@ -101,6 +114,9 @@ obs-report:
 
 sentinel:
 	$(CPU_MESH) $(PY) tools/perf_sentinel.py
+
+chaos:
+	$(CPU_MESH) $(PY) tools/chaos_bench.py
 
 explain:
 	$(CPU_MESH) $(PY) tools/explain_run.py --latest --json

@@ -235,6 +235,29 @@ class FFConfig:
     watchdog: str = "off"
     watchdog_threshold_s: float = 60.0
     watchdog_dir: str = ".ffcache/obs/blackbox"
+    # --- fault tolerance (runtime/faults.py, retry.py, checkpoint.py) -----
+    # deterministic fault injection: a schema-versioned plan dict
+    # ({"schema": 1, "seed": ..., "sites": {...}}) arming named failure
+    # sites across the stack (prefetcher exception, torn checkpoint,
+    # transient device_put, step-N kill, NaN loss, watchdog stall,
+    # serving-worker crash). None (default) = off: every site costs one
+    # global None-check and no faults.* metric exists. A malformed plan
+    # raises at compile()/fit()/serving entry, the mode-knob convention.
+    # Runs with an armed plan carry a ledger "faults" block and are
+    # cohort-EXCLUDED by tools/perf_sentinel.py.
+    fault_plan: Optional[dict] = None
+    # crash-safe training: fit() saves a full-resume checkpoint (params,
+    # optimizer state, step/epoch, rng, dataloader cursor + shuffle
+    # state, guard budget, lr) every N steps through CheckpointManager,
+    # asynchronously (Orbax async commit off the step loop's critical
+    # path). 0 (default) = off. fit(resume_from=dir) restores the newest
+    # INTACT checkpoint and replays the step loop from exactly there —
+    # bit-identical to the uninterrupted run (tools/chaos_bench.py
+    # proves it).
+    checkpoint_interval_steps: int = 0
+    # None = .ffcache/ckpt; fit(resume_from=...) overrides per call
+    checkpoint_dir: Optional[str] = None
+    checkpoint_max_to_keep: int = 3
     # numerics
     computation_mode: CompMode = CompMode.TRAINING
     # mixed precision: "bfloat16" runs activations/matmuls in bf16 on the
@@ -398,6 +421,19 @@ class FFConfig:
                 cfg.watchdog_threshold_s = float(_next())
             elif a == "--watchdog-dir":
                 cfg.watchdog_dir = _next()
+            elif a == "--fault-plan":
+                # a JSON file path (the chaos tools' handoff format); the
+                # plan is validated at compile/fit entry, not here
+                import json as _json
+
+                with open(_next()) as _f:
+                    cfg.fault_plan = _json.load(_f)
+            elif a == "--checkpoint-interval":
+                cfg.checkpoint_interval_steps = int(_next())
+            elif a == "--checkpoint-dir":
+                cfg.checkpoint_dir = _next()
+            elif a == "--checkpoint-keep":
+                cfg.checkpoint_max_to_keep = int(_next())
             elif a == "--print-freq":
                 cfg.print_freq = int(_next())
             elif a == "--adoption-margin":

@@ -123,10 +123,23 @@ def record_run(kind: str, record: Dict, config=None) -> Optional[Dict]:
 
 
 def _append(dirpath: str, doc: Dict, track_last: bool = True) -> None:
-    os.makedirs(dirpath, exist_ok=True)
     path = os.path.join(dirpath, f"runs-{os.getpid()}.jsonl")
     line = json.dumps(doc, sort_keys=True, default=str)
+    # transient append failures (full-ish disk clearing, NFS blips) back
+    # off through the shared retry policy; the lock is taken INSIDE the
+    # retried fn, so the backoff sleep never runs under it (CCY003). A
+    # final failure re-raises into record_run's counted catch.
+    from ..runtime.retry import RetryPolicy
+
+    RetryPolicy(max_attempts=3, base_delay_s=0.01, max_delay_s=0.1,
+                retry_on=(OSError,), label="ledger").call(
+        _locked_append, dirpath, path, line, doc, track_last)
+
+
+def _locked_append(dirpath: str, path: str, line: str, doc: Dict,
+                   track_last: bool) -> None:
     global _LAST_RECORD
+    os.makedirs(dirpath, exist_ok=True)
     with _mu:
         with open(path, "a") as f:
             f.write(line + "\n")
@@ -177,7 +190,11 @@ def scan_ledger(dirpath: Optional[str] = None) -> Dict:
                 corrupt += 1
                 continue
             runs.append(doc)
-    runs.sort(key=lambda r: (r.get("ts_unix_s") or 0, r.get("run_id") or ""))
+    # stable sort on the (rounded) timestamp only: records appended
+    # within the same millisecond keep their file/line order — which IS
+    # append order within a process file — instead of shuffling on a
+    # random run_id tie-break
+    runs.sort(key=lambda r: r.get("ts_unix_s") or 0)
     return {"runs": runs, "files": files, "corrupt_lines": corrupt}
 
 
@@ -308,6 +325,19 @@ def _watchdog_block() -> Dict:
     return watchdog().stats()
 
 
+def _faults_block() -> Optional[Dict]:
+    """The armed fault plan's evaluation/fire counts, or None on a clean
+    run. Its PRESENCE on a record marks the run chaotic —
+    tools/perf_sentinel.py cohort-excludes such records so injected
+    faults never pollute perf baselines."""
+    try:
+        from ..runtime.faults import faults_block
+
+        return faults_block()
+    except Exception:  # noqa: BLE001 — telemetry never kills a run
+        return None
+
+
 def _divergence_for_ledger(div: Dict, config) -> Dict:
     """The divergence block as the ledger stores it: per-op rows capped
     at the top-``config.ledger_per_op_topk`` by measured time, with the
@@ -369,6 +399,15 @@ def record_fit(ff, kind: str = "fit") -> Optional[Dict]:
             rec["perf"] = {"metric": f"{kind}.steps_per_s",
                            "value": prof["steps_per_s"],
                            "higher_is_better": True}
+        if prof.get("guard"):
+            # TrainingGuard recovery narrative (restores, backoffs,
+            # snapshot cadence) — explain_run narrates it
+            rec["guard"] = prof["guard"]
+        if ff.compiled is not None:
+            rec["resume"] = ff.compiled.resume_state()
+        fb = _faults_block()
+        if fb:
+            rec["faults"] = fb
         rec["watchdog"] = _watchdog_block()
         rec["metrics"] = metrics_registry().to_json()
         return record_run(kind, rec, config=ff.config)
@@ -401,6 +440,9 @@ def record_serving(extra: Optional[Dict] = None,
                 rec[name] = m.to_json()
         if extra:
             rec.update(extra)
+        fb = _faults_block()
+        if fb:
+            rec["faults"] = fb
         rec["watchdog"] = _watchdog_block()
         if not rec["counters"]:
             return None  # nothing served — no record

@@ -8,6 +8,23 @@ way SURVEY.md §7 prescribes (Orbax-style): sharded params/optimizer state
 are saved from device without gathering to one host, and restored directly
 into the compiled model's shardings, plus step/rng bookkeeping for exact
 training resume.
+
+Crash-safety contract (the fault-tolerance layer's foundation):
+
+* the ``extra`` sidecar is written **atomically** (tmp + fsync + rename)
+  — a crash mid-write can never leave a half-written
+  ``extra_<step>.json`` for :meth:`CheckpointManager.restore_extra` to
+  choke on;
+* :meth:`CheckpointManager.restore` without an explicit step **falls
+  back to the newest intact step**: a torn payload or corrupt sidecar
+  demotes that step (counted on ``checkpoint.corrupt_fallbacks`` /
+  ``checkpoint.corrupt_sidecars`` — never silent) and the next-newest
+  candidate is tried;
+* saves and sidecar writes retry transient I/O failures through the
+  shared backoff policy (runtime/retry.py);
+* the ``checkpoint.torn_write`` fault site (runtime/faults.py) tears a
+  just-committed checkpoint on purpose so chaos runs can prove all of
+  the above.
 """
 
 from __future__ import annotations
@@ -18,6 +35,29 @@ from typing import Any, Dict, Optional
 import jax
 import numpy as np
 
+from ..obs.metrics import metrics_registry
+from .faults import fire as _fault_fire
+from .retry import RetryPolicy
+
+# checkpoint I/O retry: directory-level transients (NFS blips, EAGAIN on
+# a loaded host) back off briefly; a persistent failure re-raises after
+# the budget and is the caller's to surface
+_IO_RETRY = RetryPolicy(max_attempts=3, base_delay_s=0.02, max_delay_s=0.25,
+                        retry_on=(OSError,), label="checkpoint")
+
+
+def _atomic_write_json(path: str, doc: Dict) -> None:
+    """tmp + fsync + rename: the sidecar either exists complete or not
+    at all — a crash mid-write leaves only an abandoned ``.tmp``."""
+    import json
+
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
 
 class CheckpointManager:
     """Step-numbered checkpoints with retention (Orbax-backed).
@@ -26,7 +66,7 @@ class CheckpointManager:
 
         ckpt = CheckpointManager(dir, max_to_keep=3)
         ckpt.save(ff, step)
-        step = ckpt.restore(ff)          # latest; or restore(ff, step=N)
+        step = ckpt.restore(ff)          # newest INTACT; or restore(ff, step=N)
     """
 
     def __init__(self, directory: str, max_to_keep: Optional[int] = 3):
@@ -45,25 +85,62 @@ class CheckpointManager:
     def save(self, ffmodel, step: int, extra: Optional[Dict[str, Any]] = None,
              wait: bool = True) -> None:
         """Save params + optimizer state + iteration counter. ``extra`` is
-        a JSON-serializable dict stored in a sidecar file and handed back
-        by :meth:`restore_extra`."""
+        a JSON-serializable dict stored in a sidecar file (atomically)
+        and handed back by :meth:`restore_extra`. ``wait=False`` lets
+        Orbax commit asynchronously — the device->host copy still
+        completes before this returns, so the step loop may immediately
+        donate the live buffers to the next dispatch."""
         cm = ffmodel.compiled
         assert cm is not None, "compile() before saving"
         ocp = self._ocp
         state = {
             "params": cm.params,
             "opt_state": cm.opt_state,
-            "iteration": np.asarray(cm._iteration, np.int64),
+            "iteration": np.asarray(cm.resume_state()["iteration"],
+                                    np.int64),
         }
-        self._mgr.save(step, args=ocp.args.StandardSave(state))
+        # serialize with any still-running async commit before starting
+        # the next one (cheap when idle)
+        self._mgr.wait_until_finished()
+        _IO_RETRY.call(self._mgr.save, step,
+                       args=ocp.args.StandardSave(state))
         if wait:
             self._mgr.wait_until_finished()
         if extra is not None:
-            import json
-
-            with open(self._extra_path(step), "w") as f:
-                json.dump(extra, f)
+            _IO_RETRY.call(_atomic_write_json, self._extra_path(step), extra)
         self._prune_extras()
+        # chaos harness: tear what was just committed (simulating a
+        # crash mid-write at the storage layer) so restore's intact-step
+        # fallback is provable
+        rule = _fault_fire("checkpoint.torn_write")
+        if rule is not None:
+            # the tear must hit COMMITTED files: an async (wait=False)
+            # save may still be writing into Orbax's tmp dir, where
+            # os.walk would find nothing and the "tear" silently no-ops
+            self._mgr.wait_until_finished()
+            self._tear(step, rule.get("target", "payload"))
+
+    def _tear(self, step: int, target: str) -> None:
+        """Deterministic corruption of a committed step (fault site
+        ``checkpoint.torn_write``): truncate every payload file to half
+        (``target='payload'``), or replace the sidecar with a torn JSON
+        prefix (``target='sidecar'`` — the pre-fix bug's exact shape)."""
+        metrics_registry().counter("faults.torn_checkpoints").inc()
+        if target == "sidecar":
+            p = self._extra_path(step)
+            with open(p, "w") as f:
+                f.write('{"schema": 1, "epoch"')  # torn mid-key
+            return
+        root = os.path.join(self.directory, str(step))
+        for dirpath, _dirnames, filenames in sorted(os.walk(root)):
+            for name in sorted(filenames):
+                p = os.path.join(dirpath, name)
+                try:
+                    size = os.path.getsize(p)
+                    if size > 0:
+                        os.truncate(p, size // 2)
+                except OSError:
+                    pass
 
     def _prune_extras(self) -> None:
         """Drop sidecars whose checkpoint step has been retention-deleted."""
@@ -82,15 +159,37 @@ class CheckpointManager:
     def _extra_path(self, step: int) -> str:
         return os.path.join(self.directory, f"extra_{step}.json")
 
-    def restore_extra(self, step: Optional[int] = None) -> Optional[Dict[str, Any]]:
-        """The ``extra`` dict saved alongside a step, or None."""
+    def _load_extra(self, step: int) -> Optional[Dict[str, Any]]:
+        """Parse one step's sidecar; raises ValueError on corruption
+        (the caller decides between counting + None and fallback)."""
         import json
 
-        step = step if step is not None else self._mgr.latest_step()
-        if step is None or not os.path.exists(self._extra_path(step)):
+        path = self._extra_path(step)
+        if not os.path.exists(path):
             return None
-        with open(self._extra_path(step)) as f:
-            return json.load(f)
+        with open(path) as f:
+            doc = json.load(f)
+        if not isinstance(doc, dict):
+            raise ValueError(f"sidecar {path} is not a JSON object")
+        return doc
+
+    def restore_extra(self, step: Optional[int] = None) -> Optional[Dict[str, Any]]:
+        """The ``extra`` dict saved alongside a step, or None. A corrupt
+        sidecar returns None and counts on ``checkpoint.corrupt_sidecars``
+        — callers that need payload+sidecar intact together should use
+        :meth:`restore` (which falls back to an older intact step)."""
+        step = step if step is not None else self._mgr.latest_step()
+        if step is None:
+            return None
+        try:
+            return self._load_extra(step)
+        except ValueError as e:
+            metrics_registry().counter("checkpoint.corrupt_sidecars").inc()
+            import sys
+
+            print(f"[checkpoint] corrupt sidecar for step {step}: {e}",
+                  file=sys.stderr, flush=True)
+            return None
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
@@ -98,15 +197,13 @@ class CheckpointManager:
     def all_steps(self):
         return list(self._mgr.all_steps())
 
-    def restore(self, ffmodel, step: Optional[int] = None) -> int:
-        """Restore into the compiled model in place, with each leaf placed
-        on its compiled sharding. Returns the restored step."""
+    def _restore_step(self, ffmodel, step: int) -> None:
+        """Restore one step's payload into the compiled model in place,
+        each leaf placed on its compiled sharding. Raises on a torn or
+        otherwise unreadable payload; mutations are only applied after
+        the whole restore succeeded."""
         cm = ffmodel.compiled
-        assert cm is not None, "compile() before restoring"
         ocp = self._ocp
-        step = step if step is not None else self._mgr.latest_step()
-        if step is None:
-            raise FileNotFoundError(f"no checkpoints under {self.directory}")
 
         from jax.sharding import NamedSharding, PartitionSpec
 
@@ -127,17 +224,62 @@ class CheckpointManager:
         target = {
             "params": jax.tree.map(_abstract, cm.params),
             "opt_state": jax.tree.map(_abstract, cm.opt_state),
-            "iteration": np.asarray(cm._iteration, np.int64),
+            "iteration": np.asarray(cm.resume_state()["iteration"],
+                                    np.int64),
         }
         restored = self._mgr.restore(step, args=ocp.args.StandardRestore(target))
         cm.params = restored["params"]
         cm.opt_state = restored["opt_state"]
-        cm._iteration = int(restored["iteration"])
+        cm.load_resume_state({"iteration": int(restored["iteration"])})
         if getattr(ffmodel, "pipelined", None) is not None:
             # pipelined training holds per-stage copies; re-seed them so the
             # restored weights AND optimizer moments flow into the pipeline
             ffmodel.pipelined.sync_from(cm)
-        return step
+
+    def restore(self, ffmodel, step: Optional[int] = None,
+                require_extra: bool = False) -> int:
+        """Restore into the compiled model in place. With an explicit
+        ``step`` the restore is strict (corruption raises). Without one,
+        candidates are tried newest-first and a step whose payload OR
+        sidecar is corrupt is skipped — counted on
+        ``checkpoint.corrupt_fallbacks``, printed, never silent — so a
+        crash that tore the newest write still resumes from the newest
+        intact state. ``require_extra=True`` (the fit resume path)
+        additionally demotes steps with NO sidecar: a payload without
+        its resume metadata would silently restart the epoch/shuffle
+        position from zero on mid-run params — loud fallback beats
+        silently-wrong resume. Returns the restored step."""
+        cm = ffmodel.compiled
+        assert cm is not None, "compile() before restoring"
+        if step is not None:
+            self._restore_step(ffmodel, step)
+            return step
+        candidates = sorted(self._mgr.all_steps(), reverse=True)
+        if not candidates:
+            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        last_err: Optional[BaseException] = None
+        for s in candidates:
+            try:
+                # sidecar intactness first (cheap) — a step whose resume
+                # metadata is torn is NOT intact even if its arrays are
+                if self._load_extra(s) is None and require_extra:
+                    raise ValueError(
+                        f"step {s} has no resume sidecar "
+                        f"({self._extra_path(s)})")
+                self._restore_step(ffmodel, s)
+                return s
+            except Exception as e:  # noqa: BLE001 — any torn read demotes
+                last_err = e
+                metrics_registry().counter(
+                    "checkpoint.corrupt_fallbacks").inc()
+                import sys
+
+                print(f"[checkpoint] step {s} is not intact "
+                      f"({type(e).__name__}: {e}); falling back to the "
+                      f"next-newest step", file=sys.stderr, flush=True)
+        raise RuntimeError(
+            f"no intact checkpoint under {self.directory} "
+            f"(tried {candidates})") from last_err
 
     def close(self) -> None:
         self._mgr.close()
@@ -159,3 +301,6 @@ def load_checkpoint(ffmodel, path: str, step: Optional[int] = None) -> int:
         return m.restore(ffmodel, step)
     finally:
         m.close()
+
+
+__all__ = ["CheckpointManager", "load_checkpoint", "save_checkpoint"]

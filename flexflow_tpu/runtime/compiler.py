@@ -90,6 +90,27 @@ class CompiledModel:
     # FFModel.compile; None when the knob is off)
     exec_telemetry: Optional[Dict] = None
 
+    # ---- public resume-state surface ---------------------------------- #
+    # Checkpoint, recompile, playoff and ledger paths all need the step
+    # counter; they go through these instead of reaching into the
+    # private _iteration field.
+    @property
+    def iteration(self) -> int:
+        """Global step counter (monotonic across fits/recompiles)."""
+        return self._iteration
+
+    @iteration.setter
+    def iteration(self, value: int) -> None:
+        self._iteration = int(value)
+
+    def resume_state(self) -> Dict:
+        """The JSON-scalar resume view (checkpoint extra + ledger
+        records); params/opt_state travel separately (sharded arrays)."""
+        return {"iteration": int(self._iteration)}
+
+    def load_resume_state(self, state: Dict) -> None:
+        self._iteration = int((state or {}).get("iteration", 0))
+
 
 def toposort_layers(layers: List[Layer]) -> List[Layer]:
     """Builder order is already topological (each layer only consumes

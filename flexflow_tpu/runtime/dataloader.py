@@ -37,17 +37,38 @@ from jax.sharding import NamedSharding, PartitionSpec
 from ..obs.trace import tracer
 from ..obs.watchdog import beat as _wd_beat
 from ..obs.watchdog import watch as _wd_watch
+from .faults import TransientFault
+from .faults import active as _faults_active
+from .faults import inject as _fault_inject
+from .retry import RetryPolicy
+
+# transient placement failures (and the device_put.transient fault site)
+# back off briefly and retry; a persistent failure surfaces after the
+# budget. Seeded: a replayed chaos plan backs off identically.
+_PUT_RETRY = RetryPolicy(max_attempts=3, base_delay_s=0.002,
+                         max_delay_s=0.02, retry_on=(TransientFault,),
+                         label="device_put", seed=0)
 
 
-def _put(batch: np.ndarray, sharding: Optional[NamedSharding]) -> jax.Array:
+def _put_once(batch: np.ndarray, sharding: Optional[NamedSharding]) -> jax.Array:
     """Place a host batch: sharded placement routes through the
     process-aware path (parallel/multihost.py — single-process it is a
     plain device_put); unsharded falls back to the default device."""
+    _fault_inject("device_put.transient", TransientFault)
     if sharding is None:
         return jax.device_put(batch)
     from ..parallel.multihost import process_local_batch
 
     return process_local_batch(batch, sharding)
+
+
+def _put(batch: np.ndarray, sharding: Optional[NamedSharding]) -> jax.Array:
+    """``_put_once`` behind the retry policy — engaged only while a
+    fault plan is armed (the off path is one global read; real
+    placement errors are not transient on a healthy single host)."""
+    if _faults_active():
+        return _PUT_RETRY.call(_put_once, batch, sharding)
+    return _put_once(batch, sharding)
 
 
 def _super_sharding(sharding: Optional[NamedSharding]) -> Optional[NamedSharding]:
@@ -179,6 +200,22 @@ class DataLoaderGroup:
             perm = self._rng.permutation(self.loaders[0].num_samples)
             for l in self.loaders:
                 l.perm = perm
+
+    def advance_epochs(self, n: int) -> None:
+        """Advance the shuffle stream exactly as ``n`` epoch resets
+        would (crash-safe resume replay: a resumed fit must draw the
+        SAME permutation for its resume epoch that the original run's
+        epoch-``n`` reset drew)."""
+        for _ in range(max(0, int(n))):
+            self.reset(reshuffle=True)
+
+    def skip_batches(self, n: int) -> None:
+        """Consume and discard ``n`` batches (host side only, no device
+        placement) — the resume path's fast-forward within an epoch.
+        Implemented as real host pulls so cursor/wrap/native semantics
+        stay bit-identical to the steps the original run took."""
+        for _ in range(max(0, int(n))):
+            self.next_batch_host()
 
     def next_batch_host(self) -> List[np.ndarray]:
         """One batch per loader, still on host (numpy)."""
@@ -349,12 +386,30 @@ class Prefetcher:
             rem -= s
         return plan
 
-    def epoch(self, reshuffle: bool = True) -> Iterator[Tuple[int, list]]:
+    def epoch(self, reshuffle: bool = True,
+              skip: int = 0) -> Iterator[Tuple[int, list]]:
         """Reset the group and yield one epoch of ``(n_steps, batch)``
         items (placed device arrays); ``batch`` is a stacked super-batch
-        when ``n_steps > 1``."""
+        when ``n_steps > 1``. ``skip`` fast-forwards past the first N
+        steps (crash-safe resume): the shuffle reset still happens, the
+        skipped batches are consumed host-side only, and the remaining
+        items are exactly what the un-skipped epoch would have yielded
+        from step N on — ``skip`` must land on an item boundary of the
+        deterministic dispatch plan (checkpoints are only ever taken
+        there)."""
         self.group.reset(reshuffle)
         plan = self._plan()
+        if skip:
+            done = idx = 0
+            while idx < len(plan) and done < skip:
+                done += plan[idx]
+                idx += 1
+            if done != skip:
+                raise ValueError(
+                    f"resume skip={skip} does not align with the dispatch "
+                    f"plan's item boundaries (prefix sums {plan[:idx]})")
+            self.group.skip_batches(skip)
+            plan = plan[idx:]
         tr = tracer()
         # span name/cat track whichever loop drives us (fit vs eval) so
         # the trace agrees with the registry series the stats feed
@@ -399,6 +454,10 @@ class Prefetcher:
                     # legitimately on a full channel (consumer pacing),
                     # so only the assembly is inside the watched section
                     with _wd_watch("prefetch.worker"):
+                        # fault site: a worker exception here must reach
+                        # the consumer as the raised error (below, via
+                        # _WorkerError) and never leak this thread
+                        _fault_inject("prefetch.worker")
                         item = (k, self.group.assemble_host(k))
                     if not chan.put(item):
                         return  # consumer closed the channel mid-epoch

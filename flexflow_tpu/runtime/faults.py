@@ -1,0 +1,268 @@
+"""Deterministic fault injection: the recovery layer's proof harness.
+
+The reference framework has no failure story ("none — Legion aborts",
+SURVEY.md §5). PRs 6-10 built *detection* (watchdog, sentinel,
+attribution); this module is the other half's test bed: a seeded,
+schema-versioned **fault plan** (``config.fault_plan``) that makes named
+failure sites across the stack fire deterministically — so the recovery
+machinery (crash-safe resume, retry/backoff, serving degradation) can be
+proven by ``tools/chaos_bench.py`` instead of waited for in production.
+
+Design contract (the mode-knob conventions every obs gate follows):
+
+* **zero cost when off** — the plan is a module global; every site costs
+  one ``_PLAN is None`` check (:func:`active`/:func:`fire`) while no
+  plan is armed, and no ``faults.*`` metric series ever appears;
+* **validated at entry** — :func:`configure_faults` runs at
+  ``compile()``/``fit()``/serving-instance construction; a typo'd site
+  name or malformed rule raises ``ValueError`` BEFORE any work is paid;
+* **deterministic** — ``at_step: k`` fires on the k-th evaluation of
+  that site; ``p: x`` draws from a per-site ``random.Random`` seeded by
+  ``(plan seed, site name)``, so a given plan replays identically;
+* **accounted** — every firing increments ``faults.fired`` plus the
+  per-site ``faults.<site>`` counter, and :func:`faults_block` hands the
+  run ledger a ``faults`` block (obs/ledger.py ``record_fit`` /
+  ``record_serving``) so chaotic runs are cohort-excluded by
+  ``tools/perf_sentinel.py`` and never pollute perf baselines.
+
+Plan schema (``FAULT_PLAN_SCHEMA`` = 1)::
+
+    config.fault_plan = {
+        "schema": 1,
+        "seed": 0,                      # optional, default 0
+        "sites": {
+            "train.kill":   {"at_step": 5, "exit_code": 41},
+            "train.stall":  {"at_step": 2, "stall_s": 1.0},
+            "device_put.transient": {"p": 0.2, "max_fires": 3},
+            ...
+        },
+    }
+
+Each rule has exactly one trigger (``at_step`` — 1-based evaluation
+index of that site — or ``p`` — per-evaluation Bernoulli) plus optional
+``max_fires`` and site-specific parameters (see :data:`SITES`).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Any, Dict, Optional
+
+from ..obs.metrics import metrics_registry
+
+FAULT_PLAN_SCHEMA = 1
+
+# site name -> what firing it does (the README's site table is generated
+# from the docs here; chaos_bench exercises every one)
+SITES: Dict[str, str] = {
+    "prefetch.worker": (
+        "raise inside the Prefetcher worker's batch assembly — proves "
+        "worker exceptions surface on the consumer without leaking the "
+        "thread"),
+    "device_put.transient": (
+        "transient host->device placement failure (TransientFault) — "
+        "retried by the shared backoff policy (runtime/retry.py)"),
+    "checkpoint.torn_write": (
+        "tear the just-committed checkpoint (truncate payload files, or "
+        "write a partial sidecar with target='sidecar') — proves "
+        "restore falls back to the newest intact step, counted"),
+    "train.nan_loss": (
+        "multiply the step loss by NaN — proves the TrainingGuard "
+        "rollback + lr-backoff path"),
+    "train.stall": (
+        "sleep stall_s inside the step loop — proves the PR 8 stall "
+        "watchdog trips and writes a black-box dump"),
+    "train.kill": (
+        "hard process kill (os._exit(exit_code), default 41) after the "
+        "step completes — proves crash-safe resume bit-identity"),
+    "serving.worker": (
+        "crash a serving batcher-worker after re-queuing its batch — "
+        "proves the respawn budget and that every accepted future still "
+        "resolves"),
+}
+
+# rule keys accepted per site (trigger keys are shared)
+_TRIGGER_KEYS = {"at_step", "p"}
+_COMMON_KEYS = {"max_fires"}
+_SITE_PARAMS = {
+    "train.stall": {"stall_s"},
+    "train.kill": {"exit_code"},
+    "checkpoint.torn_write": {"target"},
+}
+
+
+class InjectedFault(RuntimeError):
+    """A fault fired by the active fault plan (runtime/faults.py)."""
+
+
+class TransientFault(InjectedFault):
+    """A retryable injected fault — the shared retry policy's target."""
+
+
+class FaultPlan:
+    """Validated, armed fault plan with per-site deterministic state.
+
+    Counters (`evaluated`/`fired` per site) are mutated from the fit
+    loop, the Prefetcher worker, and serving workers concurrently; one
+    lock guards them all (evaluation is off the hot path by definition —
+    a plan only exists on chaos runs).
+    """
+
+    def __init__(self, spec: Dict[str, Any]):
+        self.spec = _validate_plan(spec)
+        self.seed = int(self.spec.get("seed", 0))
+        self._sites: Dict[str, Dict] = dict(self.spec["sites"])
+        self._mu = threading.Lock()
+        self._evaluated: Dict[str, int] = {s: 0 for s in self._sites}
+        self._fired: Dict[str, int] = {s: 0 for s in self._sites}
+        # per-site rng: seeded by (plan seed, site) so one site's draw
+        # sequence never depends on another site's evaluation order
+        self._rngs: Dict[str, random.Random] = {
+            s: random.Random(f"{self.seed}:{s}") for s in self._sites}
+
+    def should_fire(self, site: str) -> Optional[Dict]:
+        """Evaluate ``site`` once; the rule dict when it fires, None
+        otherwise (also None for sites the plan does not mention)."""
+        rule = self._sites.get(site)
+        if rule is None:
+            return None
+        with self._mu:
+            self._evaluated[site] += 1
+            n = self._evaluated[site]
+            mf = rule.get("max_fires")
+            if mf is not None and self._fired[site] >= int(mf):
+                return None
+            if "at_step" in rule:
+                hit = n == int(rule["at_step"])
+            else:
+                hit = self._rngs[site].random() < float(rule["p"])
+            if hit:
+                self._fired[site] += 1
+        if not hit:
+            return None
+        reg = metrics_registry()
+        reg.counter("faults.fired").inc()
+        reg.counter(f"faults.{site}").inc()
+        return dict(rule)
+
+    def snapshot(self) -> Dict:
+        """The ledger ``faults`` block: the plan plus what actually
+        happened — presence of this block on a run record is what makes
+        the sentinel cohort-exclude the run."""
+        with self._mu:
+            fired = dict(self._fired)
+            evaluated = dict(self._evaluated)
+        return {
+            "schema": FAULT_PLAN_SCHEMA,
+            "seed": self.seed,
+            "sites": sorted(self._sites),
+            "evaluated": evaluated,
+            "fired": fired,
+            "total_fired": sum(fired.values()),
+        }
+
+
+def _validate_plan(spec) -> Dict[str, Any]:
+    if not isinstance(spec, dict):
+        raise ValueError(
+            f"fault_plan must be a dict, got {type(spec).__name__}")
+    schema = spec.get("schema")
+    if schema != FAULT_PLAN_SCHEMA:
+        raise ValueError(
+            f"fault_plan schema {schema!r}: this build understands "
+            f"schema {FAULT_PLAN_SCHEMA}")
+    sites = spec.get("sites")
+    if not isinstance(sites, dict) or not sites:
+        raise ValueError("fault_plan needs a non-empty 'sites' dict")
+    for name, rule in sites.items():
+        if name not in SITES:
+            raise ValueError(
+                f"fault_plan site {name!r} is not a known site; known: "
+                f"{sorted(SITES)}")
+        if not isinstance(rule, dict):
+            raise ValueError(f"fault_plan site {name!r}: rule must be a "
+                             f"dict, got {type(rule).__name__}")
+        triggers = _TRIGGER_KEYS & set(rule)
+        if len(triggers) != 1:
+            raise ValueError(
+                f"fault_plan site {name!r}: exactly one trigger of "
+                f"{sorted(_TRIGGER_KEYS)} required, got {sorted(triggers)}")
+        if "p" in rule and not (0.0 < float(rule["p"]) <= 1.0):
+            raise ValueError(f"fault_plan site {name!r}: p must be in "
+                             f"(0, 1], got {rule['p']}")
+        if "at_step" in rule and int(rule["at_step"]) < 1:
+            raise ValueError(f"fault_plan site {name!r}: at_step is "
+                             f"1-based, got {rule['at_step']}")
+        allowed = (_TRIGGER_KEYS | _COMMON_KEYS
+                   | _SITE_PARAMS.get(name, set()))
+        extra = set(rule) - allowed
+        if extra:
+            raise ValueError(
+                f"fault_plan site {name!r}: unknown rule keys "
+                f"{sorted(extra)} (allowed: {sorted(allowed)})")
+    return dict(spec)
+
+
+# ------------------------------------------------------------ global state
+_PLAN: Optional[FaultPlan] = None
+
+
+def configure_faults(config) -> Optional[FaultPlan]:
+    """Arm (or clear) the process fault plan from ``config.fault_plan``.
+
+    Runs at compile()/fit()/serving-instance entry, so a malformed plan
+    fails BEFORE any search/XLA/training work (the mode-knob
+    convention). A config whose ``fault_plan`` is None clears the plan —
+    chaos never leaks from one run into the next. Re-configuring with an
+    EQUAL spec keeps the armed plan's counters (compile -> fit -> serve
+    of one chaotic session accumulate into one ledger block)."""
+    global _PLAN
+    spec = getattr(config, "fault_plan", None)
+    if spec is None:
+        _PLAN = None  # concurrency: race-ok (lock-free plan swap, the tracer's enabled pattern: sites read the reference once; a racing site sees the old or new plan atomically)
+        return None
+    cur = _PLAN
+    if cur is not None and cur.spec == spec:
+        return cur
+    plan = FaultPlan(spec)
+    _PLAN = plan  # concurrency: race-ok (lock-free plan swap, see above)
+    return plan
+
+
+def active() -> bool:
+    """One global read: the off-path cost of the whole subsystem."""
+    return _PLAN is not None
+
+
+def fire(site: str) -> Optional[Dict]:
+    """Evaluate ``site`` against the armed plan; the rule dict when it
+    fires, None when it doesn't (or no plan is armed)."""
+    plan = _PLAN
+    if plan is None:
+        return None
+    return plan.should_fire(site)
+
+
+def inject(site: str, exc: type = InjectedFault) -> None:
+    """Raise ``exc`` when ``site`` fires; no-op otherwise."""
+    rule = fire(site)
+    if rule is not None:
+        raise exc(f"injected fault at site {site!r} (rule {rule})")
+
+
+def faults_block() -> Optional[Dict]:
+    """The ledger ``faults`` block for the armed plan, or None while no
+    plan is armed (clean runs carry no block — that absence is the
+    sentinel's include signal)."""
+    plan = _PLAN
+    if plan is None:
+        return None
+    return plan.snapshot()
+
+
+__all__ = [
+    "FAULT_PLAN_SCHEMA", "FaultPlan", "InjectedFault", "SITES",
+    "TransientFault", "active", "configure_faults", "faults_block",
+    "fire", "inject",
+]

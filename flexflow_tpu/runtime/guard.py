@@ -37,6 +37,14 @@ class TrainingGuard:
         self.lr_backoff = float(lr_backoff)
         self.restores_used = 0
         self._snap: Optional[Tuple[list, list]] = None
+        # recovery narrative: one entry per snapshot/restore, recorded
+        # into the ledger fit record (obs/ledger.py) so explain_run can
+        # narrate divergence recoveries; counts, not payloads. Bounded:
+        # interval snapshots on a long run would otherwise grow this —
+        # and every checkpoint sidecar serializing it — without limit
+        self.events: List[dict] = []
+        self._snapshots_total = 0
+        self._restores_total = 0
 
     # ---- snapshot ----------------------------------------------------------
     @staticmethod
@@ -82,17 +90,73 @@ class TrainingGuard:
                 out.append(jax.numpy.asarray(v))
         return treedef.unflatten(out)
 
-    def snapshot(self, ff) -> None:
-        """Record the current (healthy) params + optimizer state."""
+    def snapshot(self, ff, scope: str = "epoch") -> None:
+        """Record the current (healthy) params + optimizer state.
+        ``scope`` labels the granularity for the event log: "epoch" (the
+        fit loop's healthy-epoch call), "interval" (fit's
+        checkpoint-interval call — sub-epoch rollback points on long
+        epochs), or "init"."""
         cm = ff.compiled
         self._snap = (self._to_host(cm.params), self._to_host(cm.opt_state))
-        self.restores_used = 0  # a healthy epoch resets the budget
+        self.restores_used = 0  # a healthy snapshot resets the budget
+        self._snapshots_total += 1
+        self._log({"kind": "snapshot", "scope": scope,
+                   "step": int(cm.resume_state()["iteration"])})
 
     def ensure_snapshot(self, ff) -> None:
         """Initial snapshot before any step runs, so a first-epoch
         divergence can still roll back (to the init weights)."""
         if self._snap is None:
-            self.snapshot(ff)
+            self.snapshot(ff, scope="init")
+
+    # ---- resume/reporting surface ------------------------------------------
+    # event-log bounds: the in-memory log keeps the newest _EVENTS_KEPT
+    # entries (interval snapshots on a 1M-step run would otherwise grow
+    # without limit), the checkpoint sidecar serializes at most
+    # _EVENTS_SERIALIZED (it is rewritten every interval — an unbounded
+    # list there is quadratic cumulative I/O); totals stay exact in the
+    # dedicated counters either way
+    _EVENTS_KEPT = 256
+    _EVENTS_SERIALIZED = 64
+
+    def _log(self, event: dict) -> None:
+        self.events.append(event)
+        if len(self.events) > self._EVENTS_KEPT:
+            del self.events[:len(self.events) - self._EVENTS_KEPT]
+
+    def state(self) -> dict:
+        """JSON-scalar resume state (checkpoint sidecar): exact totals
+        and the newest events. The host snapshot is NOT serialized — a
+        resumed fit re-snapshots from the restored (healthy,
+        checkpointed) params via :meth:`ensure_snapshot`. Nor is
+        ``restores_used``: a checkpoint is only ever written right
+        after a verified-healthy snapshot, which resets the budget to
+        0 by definition — a resumed run starts from healthy state with
+        a fresh budget, and serializing the always-0 value would imply
+        a round-trip that doesn't exist."""
+        return {"snapshots_total": int(self._snapshots_total),
+                "restores_total": int(self._restores_total),
+                "events": [dict(e)
+                           for e in self.events[-self._EVENTS_SERIALIZED:]]}
+
+    def load_state(self, state: Optional[dict]) -> None:
+        if not state:
+            return
+        self._snapshots_total = int(state.get("snapshots_total", 0))
+        self._restores_total = int(state.get("restores_total", 0))
+        self.events = [dict(e) for e in state.get("events") or []]
+
+    def report(self) -> dict:
+        """The ledger/fit_profile ``guard`` block: budget position plus
+        the recovery narrative (explain_run renders it)."""
+        return {
+            "max_restores": self.max_restores,
+            "lr_backoff": self.lr_backoff,
+            "restores_used": self.restores_used,
+            "snapshots": self._snapshots_total,
+            "restores": self._restores_total,
+            "events": [dict(e) for e in self.events[-32:]],
+        }
 
     # ---- recovery ----------------------------------------------------------
     def recover(self, ff, verbose: bool = True) -> bool:
@@ -106,6 +170,13 @@ class TrainingGuard:
         cm.opt_state = self._to_device(self._snap[1])
         self.restores_used += 1
         opt = cm.optimizer
+        self._restores_total += 1
+        self._log({
+            "kind": "restore",
+            "restores_used": int(self.restores_used),
+            "step": int(cm.resume_state()["iteration"]),
+            "lr_backoff": self.lr_backoff if self.lr_backoff != 1.0 else None,
+        })
         if self.lr_backoff != 1.0 and opt is not None:
             for attr in ("lr", "alpha"):
                 if hasattr(opt, attr):

@@ -15,6 +15,7 @@ drive it.
 from __future__ import annotations
 
 import collections
+import os
 import sys
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
@@ -747,6 +748,11 @@ class FFModel:
         _telemetry_mode(self.config)
         _attr_mode(self.config)
         _corpus_mode(self.config)
+        # a malformed fault plan fails here too — before any search/XLA
+        # work — and arming it at compile covers serving-only flows
+        from .faults import configure_faults as _cfg_faults
+
+        _cfg_faults(self.config)
         # config.obs_server_port arms the scrape/health surface (ratchet-
         # on, like the tracer; a bad port value raises here)
         _cfg_obs_server(self.config)
@@ -1684,7 +1690,7 @@ class FFModel:
             # measured loser is discarded: train plain data-parallel on
             # the ORIGINAL graph (sharding choices AND structural
             # rewrites both lost the race)
-            dp_cm._iteration = self.compiled._iteration
+            dp_cm.iteration = self.compiled.iteration
             self.compiled = dp_cm
             self.pipelined = None
             self._search_strategies = {}
@@ -1777,6 +1783,82 @@ class FFModel:
             "steps_per_dispatch": k,
         }
 
+    def _resume_setup(self, guard, resume_from: Optional[str],
+                      verbose: bool):
+        """fit()'s crash-safety bootstrap. Opens the checkpoint manager
+        (when periodic checkpointing or a resume is requested), restores
+        the newest INTACT checkpoint from ``resume_from`` — params,
+        optimizer state, iteration, rng counter, lr, guard budget — and
+        returns ``(mgr, interval, start_epoch, skip_steps)`` telling the
+        epoch loop where to pick the run back up. An empty resume dir
+        starts fresh (relaunch loops pass ``resume_from``
+        unconditionally)."""
+        cfg = self.config
+        interval = max(0, int(getattr(cfg, "checkpoint_interval_steps", 0)
+                              or 0))
+        mgr = None
+        start_epoch = skip_steps = 0
+        if interval or resume_from:
+            from .checkpoint import CheckpointManager
+
+            ckpt_dir = (resume_from
+                        or getattr(cfg, "checkpoint_dir", None)
+                        or os.path.join(".ffcache", "ckpt"))
+            mgr = CheckpointManager(
+                ckpt_dir,
+                max_to_keep=max(1, int(getattr(
+                    cfg, "checkpoint_max_to_keep", 3) or 3)))
+        if resume_from and mgr.latest_step() is not None:
+            # newest intact step, where intact = payload AND resume
+            # sidecar (a payload-only step would restart the epoch /
+            # shuffle position from zero on mid-run params); fallbacks
+            # are counted, exhaustion raises loudly
+            step = mgr.restore(self, require_extra=True)
+            extra = mgr.restore_extra(step) or {}
+            self._rng_counter = int(
+                extra.get("rng_counter", self._rng_counter))
+            lr = extra.get("lr")
+            if lr is not None:
+                # restores mid-run schedules AND guard backoffs; live
+                # immediately (hyperparams are dynamic step arguments)
+                self.set_learning_rate(float(lr))
+            if guard is not None:
+                guard.load_state(extra.get("guard"))
+            start_epoch = int(extra.get("epoch", 0))
+            skip_steps = int(extra.get("step_in_epoch", 0))
+            metrics_registry().counter("checkpoint.resumes").inc()
+            if verbose or cfg.profiling:
+                print(f"[resume] restored step {step} from "
+                      f"{mgr.directory} (epoch {start_epoch}, "
+                      f"step-in-epoch {skip_steps})", flush=True)
+        return mgr, interval, start_epoch, skip_steps
+
+    def _save_resume_checkpoint(self, mgr, epoch: int, steps_in_epoch: int,
+                                guard) -> None:
+        """One full-resume checkpoint: sharded params/opt state plus the
+        step-loop position (epoch, step-in-epoch, rng counter, lr, guard
+        budget) in the atomic sidecar. Commit is asynchronous (Orbax) —
+        the device->host copy completes before save() returns, so the
+        step loop may immediately donate the live buffers."""
+        cm = self.compiled
+        if self.pipelined is not None:
+            # the stage copies hold the live weights mid-fit; fold them
+            # into the CompiledModel view the checkpoint reads
+            self.pipelined.sync_to(cm)
+        opt = self.optimizer
+        lr = getattr(opt, "lr", getattr(opt, "alpha", None))
+        extra = {
+            "schema": 1,
+            "epoch": int(epoch),
+            "step_in_epoch": int(steps_in_epoch),
+            "rng_counter": int(self._rng_counter),
+            "lr": float(lr) if lr is not None else None,
+            "guard": guard.state() if guard is not None else None,
+            **cm.resume_state(),
+        }
+        mgr.save(self, cm.iteration, extra=extra, wait=False)
+        metrics_registry().counter("checkpoint.saves").inc()
+
     def fit(
         self,
         x: Union[np.ndarray, List[np.ndarray]],
@@ -1787,11 +1869,24 @@ class FFModel:
         verbose: bool = True,
         recompile_state=None,
         guard=None,
+        resume_from: Optional[str] = None,
     ) -> List[PerfMetrics]:
         """``guard``: a :class:`runtime.guard.TrainingGuard` — non-finite
         epoch losses roll back to the last healthy snapshot with lr
         backoff instead of poisoning the run (no reference equivalent:
         SURVEY.md §5 lists failure detection as absent upstream).
+
+        Crash safety: with ``config.checkpoint_interval_steps`` > 0 the
+        loop saves a FULL resume checkpoint (params, optimizer state,
+        step/epoch position, rng counter, dataloader shuffle state,
+        guard budget, lr) every N steps, asynchronously, into
+        ``config.checkpoint_dir``. ``resume_from=dir`` restores the
+        newest intact checkpoint from ``dir`` and replays the loop from
+        exactly there — same shuffle permutations, same rng folds, same
+        batch boundaries — so the resumed run's params are bit-identical
+        to the uninterrupted run's (tools/chaos_bench.py proves it). An
+        empty ``dir`` starts fresh, so a crash-looped launcher can pass
+        ``resume_from`` unconditionally.
 
         The step loop is asynchronous end to end: a Prefetcher assembles
         and device_puts batches ahead of compute (config.prefetch_depth),
@@ -1815,6 +1910,11 @@ class FFModel:
         ledger_mode(self.config)      # same contract for the ledger knob
         attribution_mode(self.config)
         corpus_mode(self.config)
+        # fault plan: validated + armed before any step runs (zero cost
+        # off: every site below is one global None-check)
+        from . import faults as _fx
+
+        _fx.configure_faults(self.config)
         configure_obs_server(self.config)  # ratchet-on scrape surface
         # config.watchdog="on" arms the stall monitor (threshold/dir from
         # config); the step loop below heartbeats it via the Prefetcher's
@@ -1842,6 +1942,10 @@ class FFModel:
                     f"recompile with pipeline=PipelineConfig(...)")
         group = self._make_loader_group(xs, y, bs, cm, shuffle)
         depth, max_inflight, k = self._step_loop_knobs(cm, recompile_state)
+        # crash-safe resume + periodic checkpointing (runtime/checkpoint)
+        ckpt_mgr, ckpt_interval, start_epoch, skip_steps = \
+            self._resume_setup(guard, resume_from, verbose)
+        steps_since_ckpt = 0
         batch_nbytes = group.batch_nbytes
         history: List[PerfMetrics] = []
         epoch_records: List[dict] = []
@@ -1852,14 +1956,21 @@ class FFModel:
         prev_loss = None
         if guard is not None:
             guard.ensure_snapshot(self)  # epoch-0 divergence rolls back too
+        if start_epoch:
+            # replay the skipped epochs' shuffle resets so the resume
+            # epoch draws the SAME permutation the original run drew
+            group.advance_epochs(start_epoch)
         for epoch in range(epochs):
+            if epoch < start_epoch:
+                continue  # completed before the crash (rng replayed above)
             stats = EpochThroughput()
             pf = Prefetcher(group, depth, steps_per_item=k, stats=stats)
             pm = PerfMetrics()
             last_loss = None
             loss_accum = None  # device-side; NaN/inf in ANY batch survives
             inflight = collections.deque()
-            for nk, batch in pf.epoch():
+            steps_in_epoch = skip_steps if epoch == start_epoch else 0
+            for nk, batch in pf.epoch(skip=steps_in_epoch):
                 # span per step: host-side dispatch + window control time
                 # (one flag check when tracing is off)
                 _ts = _tr.now() if _tr.enabled else 0.0
@@ -1891,6 +2002,14 @@ class FFModel:
                         seq_length=self.iter_config.seq_length,
                     )
                     guard_add = loss
+                if _fx.active():
+                    # fault site: NaN loss — poisons the guard's epoch
+                    # accumulator exactly as a real bf16 overflow would
+                    rule = _fx.fire("train.nan_loss")
+                    if rule is not None:
+                        loss = loss * np.float32("nan")
+                        if guard_add is not None:
+                            guard_add = guard_add * np.float32("nan")
                 if bm is not None:  # k>1 accumulated per-step above
                     pm.accumulate(bm)
                 last_loss = loss
@@ -1903,7 +2022,41 @@ class FFModel:
                 self._advance_window(stats, inflight, loss, nk,
                                      batch_nbytes * nk, max_inflight)
                 _wd_beat("fit.loop")  # watchdog heartbeat (no-op when off)
-                cm._iteration += nk
+                cm.iteration += nk
+                steps_in_epoch += nk
+                if ckpt_interval and ckpt_mgr is not None:
+                    steps_since_ckpt += nk
+                    if steps_since_ckpt >= ckpt_interval:
+                        steps_since_ckpt = 0
+                        # with a guard armed, verify the partial epoch's
+                        # loss sum BEFORE snapshotting/persisting: an
+                        # unchecked interval snapshot would capture
+                        # already-diverged params as the rollback point
+                        # (and reset the restore budget), and a NaN
+                        # checkpoint would poison resume. The host sync
+                        # is paid at checkpoint boundaries only — the
+                        # save's device->host copy syncs anyway.
+                        healthy = True
+                        if guard is not None and loss_accum is not None:
+                            healthy = bool(np.isfinite(float(loss_accum)))  # hotpath: sync-ok (checkpoint-boundary only, throttled to checkpoint_interval_steps; the save below syncs regardless)
+                        if healthy:
+                            if guard is not None:
+                                # sub-epoch rollback point: long epochs
+                                # no longer lose a whole epoch to a
+                                # divergence
+                                guard.snapshot(self, scope="interval")
+                            self._save_resume_checkpoint(
+                                ckpt_mgr, epoch, steps_in_epoch, guard)
+                if _fx.active():
+                    # fault sites: a slow step that must trip the PR 8
+                    # watchdog, then a hard kill (AFTER the checkpoint
+                    # save above — "kill at step N" leaves steps <= N)
+                    rule = _fx.fire("train.stall")
+                    if rule is not None:
+                        time.sleep(float(rule.get("stall_s", 1.0)))  # hotpath: sync-ok (float() of a plan-dict scalar, not a device value; chaos-run only — the site is unreachable without an armed fault plan)
+                    rule = _fx.fire("train.kill")
+                    if rule is not None:
+                        os._exit(int(rule.get("exit_code", 41)))
                 if recompile_state is not None:
                     # reference: recompile_on_condition evaluated per
                     # iteration inside the train loop (model.cc:2422).
@@ -1958,8 +2111,13 @@ class FFModel:
                     flush=True,
                 )
             history.append(pm)
+        if ckpt_mgr is not None:
+            ckpt_mgr.close()  # waits out any pending async commit
         self.fit_profile = self._step_loop_profile(
             epoch_records, depth, max_inflight, k)
+        if guard is not None:
+            # recovery narrative for the ledger record + explain_run
+            self.fit_profile["guard"] = guard.report()
         if self.pipelined is not None:
             # per-stage schedule timeline + bubble fraction + measured
             # dispatch counts (runtime/profiling.pipeline_report)

@@ -81,7 +81,7 @@ def recompile_on_condition(ffmodel, state: RecompileState) -> bool:
             op_name: {w: np.asarray(v) for w, v in ws.items()}
             for op_name, ws in cm.params.items()
         }
-        old_iteration = cm._iteration
+        old_iteration = cm.iteration  # public resume-state accessor
     if ffmodel.pipelined is not None:
         # trained weights live in the stage params; fold them into the
         # carried-over snapshot and keep the pipeline schedule on recompile
@@ -124,5 +124,5 @@ def recompile_on_condition(ffmodel, state: RecompileState) -> bool:
                         sp[op_name][wname] = jax.device_put(
                             old.astype(np.asarray(val).dtype), val.sharding
                         )
-    new_cm._iteration = old_iteration
+    new_cm.iteration = old_iteration
     return True

@@ -1,0 +1,77 @@
+"""Shared retry policy: jittered exponential backoff, with accounting.
+
+One policy class serves every transient-failure boundary in the stack —
+checkpoint saves (runtime/checkpoint.py), ledger appends (obs/ledger.py)
+and serving dispatch (serving/engine.py) all wrap their I/O in a
+:class:`RetryPolicy` instead of rolling ad-hoc loops, so retry behavior
+is tunable in one place and every attempt/giveup is visible in the
+metrics registry (``retry.<label>.attempts`` / ``.retries`` /
+``.giveups``).
+
+Determinism: with ``seed`` set, the jitter sequence is a fresh
+``random.Random(seed)`` per :meth:`call`, so a replayed chaos run backs
+off identically; with ``seed`` None the process-global rng jitters
+(production behavior — decorrelated thundering herds).
+
+Lock discipline (concurrency audit): :meth:`call` sleeps BETWEEN
+attempts, never inside ``fn`` — callers that need a lock take it inside
+``fn``, so the backoff sleep always runs lock-free (CCY003).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import time
+from typing import Callable, Optional, Tuple, Type
+
+from ..obs.metrics import metrics_registry
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Jittered exponential backoff: attempt i (0-based) sleeps
+    ``min(base_delay_s * multiplier**i, max_delay_s)`` scaled by a
+    uniform jitter in ``[1 - jitter, 1 + jitter]`` before retrying."""
+
+    max_attempts: int = 3
+    base_delay_s: float = 0.01
+    multiplier: float = 2.0
+    max_delay_s: float = 1.0
+    jitter: float = 0.5
+    retry_on: Tuple[Type[BaseException], ...] = (OSError,)
+    label: str = "io"
+    seed: Optional[int] = None
+
+    def delay_s(self, attempt: int, rng=None) -> float:
+        """The post-``attempt`` sleep (0-based), jitter applied."""
+        d = min(self.base_delay_s * (self.multiplier ** attempt),
+                self.max_delay_s)
+        if self.jitter > 0:
+            u = (rng.random() if rng is not None else random.random())
+            d *= 1.0 - self.jitter + 2.0 * self.jitter * u
+        return max(0.0, d)
+
+    def call(self, fn: Callable, *args, **kwargs):
+        """Run ``fn(*args, **kwargs)``, retrying ``retry_on`` failures
+        up to ``max_attempts`` total attempts; the final failure
+        re-raises (counted as a giveup, never swallowed)."""
+        reg = metrics_registry()
+        rng = None  # seeded rng built lazily: the clean first-attempt
+        #             path (every serving dispatch) stays allocation-free
+        attempts = max(1, int(self.max_attempts))
+        for attempt in range(attempts):
+            reg.counter(f"retry.{self.label}.attempts").inc()
+            try:
+                return fn(*args, **kwargs)
+            except self.retry_on:
+                if attempt + 1 >= attempts:
+                    reg.counter(f"retry.{self.label}.giveups").inc()
+                    raise
+                reg.counter(f"retry.{self.label}.retries").inc()
+                if rng is None and self.seed is not None:
+                    rng = random.Random(self.seed)
+                time.sleep(self.delay_s(attempt, rng))
+
+
+__all__ = ["RetryPolicy"]

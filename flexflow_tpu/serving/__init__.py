@@ -17,8 +17,9 @@ simplification), and the pieces that remain are the serving-specific ones:
 * ONNX / FFModel loading through the existing frontends.
 """
 
-from .engine import InferenceEngine, InferenceRequest, ModelInstance
+from .engine import (DeadlineExceeded, InferenceEngine, InferenceRequest,
+                     ModelInstance, ShedError)
 from .generation import Generator
 
-__all__ = ["InferenceEngine", "InferenceRequest", "ModelInstance",
-           "Generator"]
+__all__ = ["DeadlineExceeded", "InferenceEngine", "InferenceRequest",
+           "ModelInstance", "Generator", "ShedError"]

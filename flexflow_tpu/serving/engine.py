@@ -13,6 +13,28 @@ per model). TPU re-design decisions:
   wait (`batch_timeout_s`) for MXU-efficient full batches;
 * the queue discipline is native C++ (native/src/batcher.cc) with a pure
   Python fallback, mirroring the framework's native-with-fallback pattern.
+
+Graceful degradation (the fault-tolerance layer's serving half): under
+overload or failure the engine **sheds, rejects fast, and respawns**
+instead of queue-collapsing —
+
+* a bounded admission queue (``admission_limit``): requests past the
+  bound raise :class:`ShedError` immediately (counted on
+  ``serving.shed``) instead of growing an unbounded backlog;
+* per-request deadlines (``deadline_s``, engine default
+  ``default_deadline_s``): a request whose deadline passed before a
+  worker picked it up resolves its future with
+  :class:`DeadlineExceeded` right away (``serving.deadline_rejects``)
+  instead of burning an MXU batch on an answer nobody is waiting for;
+* crashed batcher-workers respawn under ``worker_retry_budget``
+  (``serving.worker_respawns``), re-queuing any in-hand batch first so
+  every accepted future still resolves;
+* a failure breaker: ``breaker_threshold`` consecutive batch failures
+  open the breaker for ``breaker_cooldown_s`` — new requests shed
+  (``serving.breaker_shed``) while the backend is presumed down, then
+  the breaker closes and traffic resumes;
+* the dispatch into the compiled executable retries transient failures
+  through the shared backoff policy (runtime/retry.py).
 """
 
 from __future__ import annotations
@@ -29,6 +51,28 @@ import numpy as np
 from ..obs.metrics import metrics_registry
 from ..obs.trace import VIRTUAL_TID_BASE, tracer
 from ..obs.watchdog import watch as _wd_watch
+from ..runtime.faults import InjectedFault, TransientFault
+from ..runtime.faults import fire as _fault_fire
+from ..runtime.faults import inject as _fault_inject
+from ..runtime.retry import RetryPolicy
+
+# transient dispatch failures (incl. the device_put.transient fault
+# site inside ModelInstance.infer) back off briefly before the batch is
+# failed; a persistent error still surfaces per-request
+_DISPATCH_RETRY = RetryPolicy(max_attempts=3, base_delay_s=0.002,
+                              max_delay_s=0.02,
+                              retry_on=(TransientFault,),
+                              label="serving_dispatch", seed=0)
+
+
+class ShedError(RuntimeError):
+    """Request rejected at admission: the queue is past its bound or
+    the failure breaker is open. Callers should back off/re-route —
+    this is load shedding, not a server bug."""
+
+
+class DeadlineExceeded(RuntimeError):
+    """The request's deadline passed before a worker could serve it."""
 
 
 class _PyBatcher:
@@ -110,9 +154,11 @@ class ModelInstance:
         # item 1's SLO-aware serving scrapes for /metrics + /healthz
         from ..obs.server import configure_obs_server
         from ..obs.watchdog import configure_watchdog
+        from ..runtime.faults import configure_faults
 
         configure_watchdog(ff.config)
         configure_obs_server(ff.config)
+        configure_faults(ff.config)  # serving-only chaos arms here
         self.name = name
         self._ff = ff
         cm = ff.compiled
@@ -179,6 +225,9 @@ class ModelInstance:
         n = int(inputs[0].shape[0])
         if n > self.batch_size:
             raise ValueError(f"{n} requests > compiled batch {self.batch_size}")
+        # fault site: a transient placement/dispatch failure — the
+        # engine's retry policy absorbs it (no-op while no plan is armed)
+        _fault_inject("device_put.transient", TransientFault)
         padded = []
         for a in inputs:
             a = np.asarray(a)
@@ -196,13 +245,19 @@ class InferenceRequest:
     ``t_enqueue`` anchors the request's span tree (obs/trace.py) and the
     queue-wait latency metric."""
 
-    __slots__ = ("inputs", "future", "request_id", "t_enqueue")
+    __slots__ = ("inputs", "future", "request_id", "t_enqueue",
+                 "deadline_s")
 
-    def __init__(self, request_id: int, inputs: Sequence[np.ndarray]):
+    def __init__(self, request_id: int, inputs: Sequence[np.ndarray],
+                 deadline_s: Optional[float] = None):
         self.request_id = request_id
         self.inputs = [np.asarray(a) for a in inputs]
         self.future: Future = Future()
         self.t_enqueue = time.perf_counter()
+        # seconds from enqueue after which the request is rejected fast
+        # instead of served late (None = no deadline); t_enqueue is
+        # perf_counter-based, the same clock the workers read
+        self.deadline_s = deadline_s
 
 
 class InferenceEngine:
@@ -215,12 +270,35 @@ class InferenceEngine:
     of rows.
     """
 
-    def __init__(self, batch_timeout_s: float = 0.005):
+    def __init__(self, batch_timeout_s: float = 0.005,
+                 admission_limit: Optional[int] = None,
+                 default_deadline_s: Optional[float] = None,
+                 breaker_threshold: int = 0,
+                 breaker_cooldown_s: float = 1.0,
+                 worker_retry_budget: int = 2):
         self.batch_timeout_s = batch_timeout_s
+        # graceful-degradation knobs (module docstring): None/0 = off —
+        # the historical accept-everything behavior
+        self.admission_limit = (int(admission_limit)
+                                if admission_limit else None)
+        self.default_deadline_s = (float(default_deadline_s)
+                                   if default_deadline_s else None)
+        self.breaker_threshold = max(0, int(breaker_threshold))
+        self.breaker_cooldown_s = float(breaker_cooldown_s)
+        self.worker_retry_budget = max(0, int(worker_retry_budget))
         self._models: Dict[str, List[ModelInstance]] = {}
         self._batchers: Dict[str, object] = {}
         self._requests: Dict[str, Dict[int, InferenceRequest]] = {}
         self._workers: Dict[Tuple[str, int], threading.Thread] = {}
+        # breaker state, per model (guarded by _mu like the registry):
+        # consecutive failed batches + the monotonic instant the open
+        # breaker closes again (inf = dead model, sheds until stop())
+        self._consec_failures: Dict[str, int] = {}
+        self._breaker_open_until: Dict[str, float] = {}
+        # worker slots whose respawn budget is exhausted (guarded by
+        # _mu); when EVERY slot of a model is abandoned the model is
+        # dead — pending futures are failed and admission sheds
+        self._abandoned: set = set()
         self._ids = itertools.count()
         self._mu = threading.Lock()
         self._started = False
@@ -234,7 +312,8 @@ class InferenceEngine:
 
     # ---- model repository --------------------------------------------------
     # Locking discipline (checked statically by analysis/concurrency_check:
-    # CCY001/CCY006 treat _models/_batchers/_requests/_workers/_started as
+    # CCY001/CCY006 treat _models/_batchers/_requests/_workers/_started —
+    # and the breaker state _consec_failures/_breaker_open_until — as
     # _mu-guarded): every read or write of the registry dicts holds _mu;
     # worker join and batcher close/submit happen OUTSIDE _mu so a blocked
     # thread can never stall the registry (CCY003).
@@ -374,7 +453,7 @@ class InferenceEngine:
         for idx in range(len(self._models[name])):
             if (name, idx) in self._workers:
                 continue
-            t = threading.Thread(target=self._worker, args=(name, idx),
+            t = threading.Thread(target=self._worker_main, args=(name, idx),
                                  daemon=True, name=f"ffserve-{name}-{idx}")
             self._workers[(name, idx)] = t
             t.start()
@@ -447,6 +526,11 @@ class InferenceEngine:
                     b.destroy()
                 self._batchers[name] = _make_batcher(
                     self._models[name][0].batch_size, self.batch_timeout_s)
+            # a stopped engine is a clean slate: dead-model markers and
+            # breaker state are session-scoped (a restart re-probes)
+            self._abandoned.clear()
+            self._breaker_open_until.clear()
+            self._consec_failures.clear()
             self._stopping = False
         # durable telemetry: one ledger record per serving session —
         # request/batch/error counters + latency percentile snapshots
@@ -456,12 +540,50 @@ class InferenceEngine:
         record_serving({"models": sorted(batchers)}, config=ledger_cfg)
 
     # ---- request path ------------------------------------------------------
-    def infer_async(self, model: str, inputs: Sequence[np.ndarray]) -> Future:
+    def infer_async(self, model: str, inputs: Sequence[np.ndarray],
+                    deadline_s: Optional[float] = None) -> Future:
         """Submit one request (arrays WITHOUT the batch dim). The future
-        resolves to the model's per-request output array."""
+        resolves to the model's per-request output array.
+
+        Degradation semantics: raises :class:`ShedError` at admission
+        when the queue is past ``admission_limit`` or the model's
+        failure breaker is open — callers back off instead of piling
+        onto a collapsing queue. ``deadline_s`` (default: the engine's
+        ``default_deadline_s``) rejects the request fast with
+        :class:`DeadlineExceeded` if no worker picks it up in time."""
         with self._mu:
             self._start_locked()
             inst = self._models[model][0]  # all group instances share the spec
+            until = self._breaker_open_until.get(model, 0.0)
+            if until:
+                if time.monotonic() < until:
+                    breaker_open = True
+                else:  # cooldown elapsed: close the breaker, let traffic probe
+                    self._breaker_open_until.pop(model, None)
+                    self._consec_failures[model] = 0
+                    breaker_open = False
+            else:
+                breaker_open = False
+        reg = metrics_registry()
+        if breaker_open:
+            reg.counter("serving.breaker_shed").inc()
+            reg.counter("serving.shed").inc()
+            raise ShedError(
+                f"{model!r}: failure breaker is open "
+                f"({self.breaker_threshold} consecutive batch failures); "
+                f"shedding until the cooldown elapses")
+        if self.admission_limit is not None:
+            # bounded admission: pending() takes the batcher's own lock,
+            # never _mu — the bound is advisory under concurrency (two
+            # racing submits may both read limit-1), which is fine: the
+            # point is a BOUNDED queue, not an exact one
+            with self._mu:
+                batcher0 = self._batchers[model]
+            if batcher0.pending() >= self.admission_limit:
+                reg.counter("serving.shed").inc()
+                raise ShedError(
+                    f"{model!r}: admission queue at its bound "
+                    f"({self.admission_limit}); shedding")
         # validate per-request shapes HERE so one malformed request fails
         # alone instead of poisoning every co-batched request
         if len(inputs) != inst.n_inputs:
@@ -473,8 +595,12 @@ class InferenceEngine:
                 raise ValueError(
                     f"{model!r} input {t.name!r}: expected per-request shape "
                     f"{want}, got {np.shape(a)}")
-        req = InferenceRequest(next(self._ids),
-                               [np.asarray(a)[None, ...] for a in inputs])
+        req = InferenceRequest(
+            next(self._ids), [np.asarray(a)[None, ...] for a in inputs],
+            # coerced HERE so a malformed deadline fails the submitting
+            # caller, never the worker with a whole batch in hand
+            deadline_s=(float(deadline_s) if deadline_s is not None
+                        else self.default_deadline_s))
         for attempt in range(64):
             with self._mu:
                 batcher = self._batchers[model]
@@ -497,7 +623,6 @@ class InferenceEngine:
         # stop() (which leaves the engine stopped): respawn the workers
         # that drain it — no-op in the common already-started case
         self.start()
-        reg = metrics_registry()
         reg.counter("serving.requests").inc()
         reg.histogram("serving.queue_depth").observe(batcher.pending())
         return req.future
@@ -507,6 +632,80 @@ class InferenceEngine:
         return self.infer_async(model, inputs).result(timeout)
 
     # ---- worker ------------------------------------------------------------
+    def _worker_main(self, name: str, idx: int = 0) -> None:
+        """Worker supervisor: respawn the drain loop after a crash, up
+        to ``worker_retry_budget`` times (the reference analogue: a
+        Triton instance restart). A clean exit (closed batcher) ends the
+        thread; a crash past the budget abandons the slot LOUDLY —
+        counted, printed — and the engine keeps serving on the group's
+        surviving workers."""
+        reg = metrics_registry()
+        for crashes in range(self.worker_retry_budget + 1):
+            try:
+                self._worker(name, idx)
+                return  # batcher closed — normal shutdown
+            except Exception as e:  # noqa: BLE001 — the drain loop died
+                reg.counter("serving.worker_crashes").inc()
+                if crashes >= self.worker_retry_budget:
+                    reg.counter("serving.worker_abandoned").inc()
+                    print(f"[serving] worker {name}/{idx} crashed "
+                          f"{crashes + 1}x ({type(e).__name__}: {e}); "
+                          f"respawn budget exhausted — abandoning",
+                          file=__import__("sys").stderr, flush=True)
+                    self._abandon(name, idx)
+                    return
+                reg.counter("serving.worker_respawns").inc()
+                print(f"[serving] worker {name}/{idx} crashed "
+                      f"({type(e).__name__}: {e}); respawning "
+                      f"({crashes + 1}/{self.worker_retry_budget})",
+                      file=__import__("sys").stderr, flush=True)
+
+    def _abandon(self, name: str, idx: int) -> None:
+        """Budget-exhausted slot: when the LAST worker of a model dies,
+        nobody will ever drain its queue — fail every pending future
+        loudly (accepted futures must resolve, even with an error) and
+        leave the breaker open forever so admission sheds instead of
+        queueing into the void. stop() clears the dead state; a
+        restart serves again."""
+        with self._mu:
+            self._abandoned.add((name, idx))
+            group = self._models.get(name) or []
+            dead = all((name, i) in self._abandoned
+                       for i in range(len(group)))
+            pending: List[InferenceRequest] = []
+            if dead:
+                self._breaker_open_until[name] = float("inf")
+                pending = list(self._requests[name].values())
+                self._requests[name].clear()
+        if not pending:
+            return
+        metrics_registry().counter("serving.abandoned_failed").inc(
+            len(pending))
+        err = RuntimeError(
+            f"{name!r}: all workers exhausted their respawn budget; "
+            f"request failed (engine sheds until stop()/restart)")
+        for r in pending:
+            if not r.future.done():
+                r.future.set_exception(err)
+
+    def _requeue(self, name: str, ids: List[int]) -> None:
+        """Put a crashed worker's in-hand batch back on the queue so its
+        futures resolve through the respawned worker (accepted futures
+        must ALWAYS resolve). A batcher closed by a concurrent stop()
+        refuses the submit; stop()'s leftover sweep then fails those
+        futures explicitly."""
+        with self._mu:
+            batcher = self._batchers[name]
+        for i in ids:
+            try:
+                batcher.submit(i)
+            except RuntimeError:
+                with self._mu:
+                    req = self._requests[name].pop(i, None)
+                if req is not None and not req.future.done():
+                    req.future.set_exception(
+                        RuntimeError("engine stopped during respawn"))
+
     def _worker(self, name: str, idx: int = 0) -> None:
         import contextlib
 
@@ -519,6 +718,14 @@ class InferenceEngine:
             ids = batcher.next_batch()
             if ids is None:
                 return
+            # fault site: worker crash with a batch in hand — re-queue
+            # it FIRST (futures must resolve through the respawn), then
+            # die so _worker_main's budget is exercised
+            rule = _fault_fire("serving.worker")
+            if rule is not None:
+                self._requeue(name, ids)
+                raise InjectedFault(
+                    f"injected fault at site 'serving.worker' ({rule})")
             with self._mu:
                 reqs = [self._requests[name].pop(i) for i in ids
                         if i in self._requests[name]]
@@ -536,12 +743,34 @@ class InferenceEngine:
             first_batch = False
             with ctx:
                 try:
+                    # deadline gate: reject-fast BEFORE burning a batch
+                    # on requests nobody is waiting for anymore. Inside
+                    # the try on purpose: from the _requests.pop above
+                    # to set_result below, ANY failure must resolve the
+                    # in-hand futures (the except arm does) — popped
+                    # requests can never be re-delivered
+                    expired = [r for r in reqs
+                               if r.deadline_s is not None
+                               and t_pickup - r.t_enqueue > r.deadline_s]
+                    if expired:
+                        for r in expired:
+                            reg.counter("serving.deadline_rejects").inc()
+                            if not r.future.done():
+                                r.future.set_exception(DeadlineExceeded(
+                                    f"request {r.request_id} waited "
+                                    f"{t_pickup - r.t_enqueue:.3f}s > "
+                                    f"deadline {r.deadline_s:.3f}s"))
+                        reqs = [r for r in reqs if r not in expired]
+                    if not reqs:
+                        continue
                     stacked = [
                         np.concatenate([r.inputs[k] for r in reqs], axis=0)
                         for k in range(inst.n_inputs)
                     ]
                     t_assembled = time.perf_counter()
-                    outs = inst.infer(stacked)[0]
+                    # transient dispatch failures retry with backoff
+                    # before the whole batch is failed (runtime/retry.py)
+                    outs = _DISPATCH_RETRY.call(inst.infer, stacked)[0]
                     t_infer = time.perf_counter()
                     row = 0
                     ends = []
@@ -563,11 +792,29 @@ class InferenceEngine:
                             t_end - r.t_enqueue)
                     self._record_request_spans(name, reqs, t_pickup,
                                                t_assembled, t_infer, ends)
+                    if self.breaker_threshold:
+                        with self._mu:  # a served batch closes the streak
+                            self._consec_failures[name] = 0
                 except Exception as e:  # surface per-request, keep serving
                     reg.counter("serving.errors").inc()
                     for r in reqs:
                         if not r.future.done():
                             r.future.set_exception(e)
+                    if self.breaker_threshold:
+                        with self._mu:
+                            n = self._consec_failures.get(name, 0) + 1
+                            self._consec_failures[name] = n
+                            # transition-only (==, not >=): failures of
+                            # already-admitted requests draining behind
+                            # an open breaker must not re-extend the
+                            # cooldown or re-count the same outage
+                            if n == self.breaker_threshold:
+                                # open: shed at admission until cooldown
+                                self._breaker_open_until[name] = (
+                                    time.monotonic()
+                                    + self.breaker_cooldown_s)
+                        if n == self.breaker_threshold:
+                            reg.counter("serving.breaker_opens").inc()
 
     @staticmethod
     def _record_request_spans(model: str, reqs, t_pickup, t_assembled,

@@ -1,0 +1,531 @@
+"""Fault-tolerance layer: deterministic fault injection, crash-safe
+resume, retry/backoff, and serving graceful degradation
+(runtime/faults.py, retry.py, checkpoint.py, serving/engine.py).
+
+Per-site seeded fixtures: each test arms one fault plan, lets the
+failure happen, and asserts the RECOVERY — kill-at-step-N resumes
+bit-identically, a torn checkpoint falls back to the newest intact
+step, an injected NaN rolls back through the guard, a stall trips the
+watchdog, a crashed serving worker respawns with every accepted future
+resolving, and a plan-less run pays nothing and counts nothing.
+"""
+
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel, LossType
+from flexflow_tpu.models.mlp import build_mlp
+from flexflow_tpu.obs.metrics import metrics_registry
+from flexflow_tpu.runtime import faults
+from flexflow_tpu.runtime.checkpoint import CheckpointManager
+from flexflow_tpu.runtime.faults import InjectedFault, TransientFault
+from flexflow_tpu.runtime.guard import TrainingGuard
+from flexflow_tpu.runtime.optimizer import AdamOptimizer
+from flexflow_tpu.runtime.retry import RetryPolicy
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_CHAOS = os.path.join(_REPO, "tools", "chaos_bench.py")
+
+
+@pytest.fixture(autouse=True)
+def _clear_plan():
+    """Chaos must never leak across tests: disarm the plan after each."""
+    yield
+    faults.configure_faults(FFConfig(fault_plan=None))
+
+
+def _model(plan=None, **cfg_kw):
+    cfg_kw.setdefault("ledger", "off")
+    ff = FFModel(FFConfig(batch_size=16, seed=3, fault_plan=plan, **cfg_kw))
+    build_mlp(ff, 16, in_dim=8, hidden_dims=(16,), num_classes=4)
+    ff.compile(optimizer=AdamOptimizer(alpha=0.01),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=[])
+    return ff
+
+
+def _data(n=64):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, 8)).astype(np.float32)
+    w = rng.normal(size=(8, 4)).astype(np.float32)
+    y = np.argmax(x @ w, axis=1).astype(np.int32).reshape(-1, 1)
+    return x, y
+
+
+def _params_sha(ff) -> str:
+    h = hashlib.sha256()
+    for op in sorted(ff.compiled.params):
+        for w in sorted(ff.compiled.params[op]):
+            h.update(np.asarray(ff.compiled.params[op][w]).tobytes())
+    return h.hexdigest()
+
+
+def _ctr(name) -> float:
+    m = metrics_registry().get(name)
+    return m.value if m is not None else 0.0
+
+
+# ------------------------------------------------------- plan off = free
+def test_plan_off_zero_overhead_and_zero_counters():
+    """FIRST in this module on purpose: the registry is process-global,
+    so this asserts the clean fit below creates no faults.* series."""
+    before = {n for n in metrics_registry().names()
+              if n.startswith(("faults.", "retry.device_put"))}
+    ff = _model()
+    assert not faults.active()
+    x, y = _data()
+    ff.fit(x, y, epochs=1, verbose=False)
+    after = {n for n in metrics_registry().names()
+             if n.startswith(("faults.", "retry.device_put"))}
+    assert after == before  # no injection, no retry wrapping engaged
+    assert faults.faults_block() is None
+    # the disarmed per-site cost is one global read — sub-microsecond
+    n = 50_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        faults.active()
+    assert (time.perf_counter() - t0) / n < 5e-6
+
+
+# -------------------------------------------------------- plan validation
+@pytest.mark.parametrize("plan, msg", [
+    ({"schema": 99, "sites": {"train.kill": {"at_step": 1}}}, "schema"),
+    ({"schema": 1, "sites": {"bogus.site": {"at_step": 1}}}, "bogus.site"),
+    ({"schema": 1, "sites": {"train.kill": {}}}, "trigger"),
+    ({"schema": 1, "sites": {"train.kill": {"at_step": 1, "p": 0.5}}},
+     "trigger"),
+    ({"schema": 1, "sites": {"train.nan_loss": {"p": 2.0}}}, "p must"),
+    ({"schema": 1, "sites": {"train.kill": {"at_step": 1,
+                                            "whoops": 3}}}, "whoops"),
+    ({"schema": 1, "sites": {}}, "non-empty"),
+])
+def test_fault_plan_validation_fails_at_entry(plan, msg):
+    with pytest.raises(ValueError, match=msg):
+        faults.configure_faults(FFConfig(fault_plan=plan))
+
+
+def test_fault_plan_deterministic_probability():
+    """p-sites replay identically under one seed (per-site rng)."""
+    spec = {"schema": 1, "seed": 7,
+            "sites": {"train.nan_loss": {"p": 0.5}}}
+    fires_a = [bool(faults.FaultPlan(spec).should_fire("train.nan_loss"))
+               for _ in range(1)]
+    plan_a = faults.FaultPlan(spec)
+    plan_b = faults.FaultPlan(spec)
+    seq_a = [bool(plan_a.should_fire("train.nan_loss")) for _ in range(64)]
+    seq_b = [bool(plan_b.should_fire("train.nan_loss")) for _ in range(64)]
+    assert seq_a == seq_b and any(seq_a) and not all(seq_a)
+    assert fires_a  # evaluated at least once without error
+
+
+# -------------------------------------------------------- retry policy
+def test_retry_policy_retries_then_succeeds():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    p = RetryPolicy(max_attempts=4, base_delay_s=0.001, max_delay_s=0.005,
+                    label="test_ok", seed=0)
+    assert p.call(flaky) == "ok"
+    assert len(calls) == 3
+    assert _ctr("retry.test_ok.retries") == 2
+
+
+def test_retry_policy_gives_up_and_reraises():
+    p = RetryPolicy(max_attempts=2, base_delay_s=0.001, max_delay_s=0.002,
+                    retry_on=(ValueError,), label="test_giveup", seed=0)
+    with pytest.raises(ValueError):
+        p.call(lambda: (_ for _ in ()).throw(ValueError("persistent")))
+    assert _ctr("retry.test_giveup.giveups") == 1
+    # non-matching exceptions pass straight through, uncounted as retry
+    with pytest.raises(KeyError):
+        p.call(lambda: (_ for _ in ()).throw(KeyError("other")))
+
+
+# ------------------------------------------------- per-site: step loop
+def test_prefetch_worker_fault_surfaces_without_thread_leak():
+    plan = {"schema": 1, "sites": {"prefetch.worker": {"at_step": 2}}}
+    ff = _model(plan, prefetch_depth=2)
+    x, y = _data()
+    with pytest.raises(InjectedFault, match="prefetch.worker"):
+        ff.fit(x, y, epochs=1, verbose=False)
+    assert not [t for t in threading.enumerate()
+                if t.name == "ff-prefetch" and t.is_alive()]
+    assert _ctr("faults.prefetch.worker") >= 1
+
+
+def test_device_put_transient_is_retried_to_success():
+    before = _ctr("retry.device_put.retries")
+    plan = {"schema": 1,
+            "sites": {"device_put.transient": {"at_step": 1}}}
+    ff = _model(plan)
+    x, y = _data()
+    hist = ff.fit(x, y, epochs=1, verbose=False)  # survives the transient
+    assert len(hist) == 1
+    assert _ctr("retry.device_put.retries") > before
+    assert _ctr("faults.device_put.transient") >= 1
+
+
+def test_nan_loss_triggers_guard_rollback():
+    before = _ctr("faults.train.nan_loss")
+    plan = {"schema": 1, "sites": {"train.nan_loss": {"at_step": 2}}}
+    ff = _model(plan)
+    x, y = _data()
+    guard = TrainingGuard(max_restores=2, lr_backoff=0.5)
+    ff.fit(x, y, epochs=2, verbose=False, guard=guard)
+    rep = ff.fit_profile["guard"]
+    assert rep["restores"] == 1
+    restore = [e for e in rep["events"] if e["kind"] == "restore"][0]
+    assert restore["lr_backoff"] == 0.5
+    # lr actually backed off (Adam alpha halved from 0.01)
+    assert abs(ff.optimizer.alpha - 0.005) < 1e-12
+    assert _ctr("faults.train.nan_loss") - before == 1
+
+
+def test_stall_trips_watchdog_dump(tmp_path):
+    from flexflow_tpu.obs.watchdog import configure_watchdog, watchdog
+
+    plan = {"schema": 1, "sites": {"train.stall": {"at_step": 2,
+                                                   "stall_s": 1.2}}}
+    ff = _model(plan, watchdog="on", watchdog_threshold_s=0.25,
+                watchdog_dir=str(tmp_path))
+    x, y = _data()
+    try:
+        ff.fit(x, y, epochs=1, verbose=False)
+    finally:
+        configure_watchdog(enabled=False)  # never leak a tight monitor
+        # the stall exercised the PROCESS watchdog: zero its dump
+        # counters back out so later healthy-run smokes (obs_report,
+        # ledger dumps==0 assertions) see a pristine monitor
+        wd = watchdog()
+        with wd._cv:
+            wd._dumps = 0
+            wd._dumped.clear()
+    dumps = [n for n in os.listdir(tmp_path) if n.startswith("blackbox-")]
+    assert dumps, "stall did not produce a black-box dump"
+    doc = json.loads((tmp_path / dumps[0]).read_text())
+    assert any(src.startswith("fit.") for src in doc["stalled"])
+
+
+# -------------------------------------------- checkpoint: torn + resume
+def test_torn_payload_falls_back_to_intact_step(tmp_path):
+    x, y = _data()
+    ff = _model()
+    ff.fit(x, y, epochs=1, verbose=False)
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=4)
+    mgr.save(ff, 1, extra={"epoch": 0})
+    good = {op: {w: np.asarray(v) for w, v in ws.items()}
+            for op, ws in ff.compiled.params.items()}
+    ff.fit(x, y, epochs=1, verbose=False)
+    faults.configure_faults(FFConfig(fault_plan={
+        "schema": 1, "sites": {"checkpoint.torn_write": {"at_step": 1}}}))
+    mgr.save(ff, 2, extra={"epoch": 1})  # committed, then torn
+    faults.configure_faults(FFConfig(fault_plan=None))
+    before = _ctr("checkpoint.corrupt_fallbacks")
+    ff2 = _model()
+    step = mgr.restore(ff2)
+    assert step == 1
+    assert _ctr("checkpoint.corrupt_fallbacks") > before
+    for op in good:
+        for w in good[op]:
+            np.testing.assert_array_equal(
+                np.asarray(ff2.compiled.params[op][w]), good[op][w])
+    # an EXPLICIT step request stays strict: corruption raises
+    with pytest.raises(Exception):
+        mgr.restore(ff2, step=2)
+    mgr.close()
+
+
+def test_torn_sidecar_falls_back_and_restore_extra_counts(tmp_path):
+    x, y = _data()
+    ff = _model()
+    ff.fit(x, y, epochs=1, verbose=False)
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=4)
+    mgr.save(ff, 1, extra={"epoch": 0, "step_in_epoch": 4})
+    ff.fit(x, y, epochs=1, verbose=False)
+    faults.configure_faults(FFConfig(fault_plan={
+        "schema": 1, "sites": {"checkpoint.torn_write": {
+            "at_step": 1, "target": "sidecar"}}}))
+    mgr.save(ff, 2, extra={"epoch": 1, "step_in_epoch": 4})
+    faults.configure_faults(FFConfig(fault_plan=None))
+    # restore_extra on the torn step: counted, None — never a crash
+    before = _ctr("checkpoint.corrupt_sidecars")
+    assert mgr.restore_extra(2) is None
+    assert _ctr("checkpoint.corrupt_sidecars") > before
+    # the un-pinned restore treats the torn-sidecar step as NOT intact
+    ff2 = _model()
+    assert mgr.restore(ff2) == 1
+    assert mgr.restore_extra(1) == {"epoch": 0, "step_in_epoch": 4}
+    mgr.close()
+
+
+def test_sidecar_write_is_atomic(tmp_path):
+    ff = _model()
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=3)
+    mgr.save(ff, 5, extra={"epoch": 2, "rng_counter": 11})
+    # no torn tmp remnants; the sidecar parses whole
+    assert not [n for n in os.listdir(tmp_path) if ".tmp." in n]
+    assert mgr.restore_extra(5)["rng_counter"] == 11
+    mgr.close()
+
+
+def test_periodic_checkpoint_extra_carries_full_resume_state(tmp_path):
+    d = str(tmp_path / "ck")
+    ff = _model(checkpoint_interval_steps=3, checkpoint_dir=d)
+    x, y = _data()
+    guard = TrainingGuard(max_restores=3)
+    ff.fit(x, y, epochs=2, verbose=False, guard=guard)  # 8 steps: saves @3,6
+    mgr = CheckpointManager(d)
+    steps = mgr.all_steps()
+    assert steps and max(steps) == 6
+    extra = mgr.restore_extra(6)
+    assert extra["schema"] == 1
+    assert extra["epoch"] == 1 and extra["step_in_epoch"] == 2
+    assert extra["rng_counter"] == 6
+    assert extra["iteration"] == 6
+    assert extra["lr"] == pytest.approx(0.01)
+    # no restores_used in the sidecar by design: a checkpoint is only
+    # written after a verified-healthy snapshot (budget 0 by definition)
+    assert extra["guard"]["restores_total"] == 0
+    assert extra["guard"]["snapshots_total"] >= 1
+    # interval snapshots recorded at checkpoint granularity, not epoch
+    scopes = [e["scope"] for e in extra["guard"]["events"]
+              if e["kind"] == "snapshot"]
+    assert "interval" in scopes
+    mgr.close()
+
+
+def test_resume_from_empty_dir_starts_fresh(tmp_path):
+    ff = _model()
+    x, y = _data()
+    hist = ff.fit(x, y, epochs=1, verbose=False,
+                  resume_from=str(tmp_path / "nothing_here"))
+    assert len(hist) == 1
+
+
+def test_in_process_crash_resume_bit_identical(tmp_path):
+    """Mid-epoch crash (worker fault at step 6 of 12) + resume from the
+    periodic checkpoint == the uninterrupted run, bit for bit — the
+    in-process half of the acceptance invariant (the subprocess
+    os._exit half is test_subprocess_kill_resume below)."""
+    x, y = _data()
+    ff_a = _model()
+    ff_a.fit(x, y, epochs=3, verbose=False)
+    sha_a = _params_sha(ff_a)
+
+    d = str(tmp_path / "ck")
+    plan = {"schema": 1, "sites": {"prefetch.worker": {"at_step": 6}}}
+    ff_b = _model(plan, checkpoint_interval_steps=2, checkpoint_dir=d,
+                  prefetch_depth=2)
+    with pytest.raises(InjectedFault):
+        ff_b.fit(x, y, epochs=3, verbose=False)
+
+    ff_c = _model()
+    ff_c.fit(x, y, epochs=3, verbose=False, resume_from=d)
+    assert _params_sha(ff_c) == sha_a
+    assert ff_c.compiled.resume_state() == ff_a.compiled.resume_state()
+    assert _ctr("checkpoint.resumes") >= 1
+
+
+def test_subprocess_kill_resume_bit_identical(tmp_path):
+    """The acceptance test: a child process is HARD-killed (os._exit)
+    at step 6 under periodic checkpointing; the resumed child's final
+    params and loss trajectory match an uninterrupted child exactly."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["FLEXFLOW_TPU_LEDGER_DIR"] = str(tmp_path / "ledger")
+
+    def child(out, extra_args):
+        return subprocess.run(
+            [sys.executable, _CHAOS, "--child", "fit", "--out", out]
+            + extra_args,
+            cwd=_REPO, env=env, capture_output=True, text=True,
+            timeout=570)
+
+    ckpt = str(tmp_path / "ck")
+    plan = {"schema": 1,
+            "sites": {"train.kill": {"at_step": 6, "exit_code": 41}}}
+    a = child(str(tmp_path / "a.json"), [])
+    assert a.returncode == 0, a.stderr[-2000:]
+    b = child(str(tmp_path / "b.json"),
+              ["--plan-json", json.dumps(plan), "--interval", "2",
+               "--ckpt-dir", ckpt])
+    assert b.returncode == 41, (b.returncode, b.stderr[-2000:])
+    assert not (tmp_path / "b.json").exists()  # died before the epilogue
+    c = child(str(tmp_path / "c.json"), ["--resume-from", ckpt])
+    assert c.returncode == 0, c.stderr[-2000:]
+    base = json.loads((tmp_path / "a.json").read_text())
+    res = json.loads((tmp_path / "c.json").read_text())
+    assert res["params_sha"] == base["params_sha"]
+    assert res["iteration"] == base["iteration"]
+    # loss trajectory: the final (fully re-run) epoch matches bit-exactly
+    assert res["epoch_loss"][-1] == base["epoch_loss"][-1]
+
+
+# ------------------------------------------------- serving degradation
+def _serving_model(plan=None):
+    from flexflow_tpu import ActiMode, DataType
+
+    ff = FFModel(FFConfig(batch_size=8, seed=0, ledger="off",
+                          fault_plan=plan))
+    xt = ff.create_tensor((8, 8), DataType.FLOAT, name="sx")
+    t = ff.dense(xt, 16, ActiMode.RELU)
+    t = ff.dense(t, 4)
+    ff.softmax(t)
+    ff.compile(optimizer=None, loss_type=None, metrics=[])
+    return ff
+
+
+def test_serving_worker_crash_respawns_and_futures_resolve():
+    from flexflow_tpu.serving.engine import InferenceEngine
+
+    before = _ctr("serving.worker_respawns")
+    plan = {"schema": 1, "sites": {"serving.worker": {"at_step": 2}}}
+    eng = InferenceEngine(batch_timeout_s=0.002, worker_retry_budget=2)
+    eng.register_ffmodel(_serving_model(plan), "m")
+    futs = [eng.infer_async("m", [np.zeros(8, np.float32)])]
+    futs[0].result(120)  # batch 1 done; batch 2 crashes the worker
+    futs += [eng.infer_async("m", [np.zeros(8, np.float32)])
+             for _ in range(7)]
+    for f in futs:  # every accepted future resolves through the respawn
+        assert f.result(60) is not None
+    eng.stop()
+    assert _ctr("serving.worker_respawns") > before
+    assert _ctr("faults.serving.worker") >= 1
+
+
+def test_serving_abandoned_worker_fails_futures_and_sheds():
+    """Respawn budget exhausted on the model's ONLY worker: pending
+    futures must resolve (with the abandonment error), and admission
+    must shed — never queue into the void."""
+    from flexflow_tpu.serving.engine import InferenceEngine, ShedError
+
+    plan = {"schema": 1, "sites": {"serving.worker": {"p": 1.0}}}
+    eng = InferenceEngine(batch_timeout_s=0.002, worker_retry_budget=1)
+    eng.register_ffmodel(_serving_model(plan), "doomed")
+    futs = [eng.infer_async("doomed", [np.zeros(8, np.float32)])
+            for _ in range(4)]
+    for f in futs:  # every accepted future resolves — with the error
+        with pytest.raises(RuntimeError, match="respawn budget"):
+            f.result(60)
+    with pytest.raises(ShedError):  # dead model: shed at admission
+        eng.infer_async("doomed", [np.zeros(8, np.float32)])
+    eng.stop()
+    assert _ctr("serving.worker_abandoned") >= 1
+    assert _ctr("serving.abandoned_failed") >= 4
+
+
+def test_serving_admission_shed_and_deadline_reject():
+    from flexflow_tpu.serving.engine import (DeadlineExceeded,
+                                             InferenceEngine, ShedError)
+
+    eng = InferenceEngine(batch_timeout_s=0.05, admission_limit=4,
+                          default_deadline_s=0.0002)
+    eng.register_ffmodel(_serving_model(), "m")
+    shed_before = _ctr("serving.shed")
+    accepted, shed = [], 0
+    for _ in range(40):
+        try:
+            accepted.append(eng.infer_async("m", [np.zeros(8, np.float32)]))
+        except ShedError:
+            shed += 1
+    assert 0 < shed < 40  # bounded: some shed, never queue-collapse
+    assert _ctr("serving.shed") - shed_before >= shed
+    resolved = deadline = 0
+    for f in accepted:
+        try:
+            f.result(60)
+            resolved += 1
+        except DeadlineExceeded:
+            deadline += 1
+    assert resolved + deadline == len(accepted)  # all accepted resolve
+    eng.stop()
+
+
+def test_serving_breaker_opens_then_recovers():
+    from flexflow_tpu.serving.engine import InferenceEngine, ShedError
+
+    eng = InferenceEngine(batch_timeout_s=0.002, breaker_threshold=2,
+                          breaker_cooldown_s=0.3)
+    inst = eng.register_ffmodel(_serving_model(), "m")
+    real_infer = inst.infer
+    inst.infer = lambda *a, **k: (_ for _ in ()).throw(
+        RuntimeError("dead backend"))
+    for _ in range(2):
+        with pytest.raises(RuntimeError):
+            eng.infer_async("m", [np.zeros(8, np.float32)]).result(60)
+    with pytest.raises(ShedError, match="breaker"):  # open: shed fast
+        eng.infer_async("m", [np.zeros(8, np.float32)])
+    assert _ctr("serving.breaker_opens") >= 1
+    inst.infer = real_infer
+    time.sleep(0.35)  # cooldown elapses: breaker closes, traffic resumes
+    assert eng.infer_async(
+        "m", [np.zeros(8, np.float32)]).result(60) is not None
+    eng.stop()
+
+
+# ------------------------------------------------- ledger + sentinel
+def test_fit_record_carries_faults_and_guard_blocks(tmp_path):
+    from flexflow_tpu.obs.ledger import scan_ledger
+
+    plan = {"schema": 1, "sites": {"train.nan_loss": {"at_step": 2}}}
+    ff = _model(plan, ledger="on", ledger_dir=str(tmp_path))
+    x, y = _data()
+    ff.fit(x, y, epochs=2, verbose=False, guard=TrainingGuard())
+    recs = [r for r in scan_ledger(str(tmp_path))["runs"]
+            if r["kind"] == "fit"]
+    assert recs
+    rec = recs[-1]
+    assert rec["faults"]["fired"]["train.nan_loss"] == 1
+    assert rec["guard"]["restores"] == 1
+    assert rec["resume"]["iteration"] == 8
+
+
+def test_sentinel_excludes_faulted_runs_from_cohorts(tmp_path):
+    """Regression test for the baseline-pollution contract: a chaotic
+    run (faults block) must be excluded — not judged, not a baseline."""
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    try:
+        from perf_sentinel import run_sentinel
+    finally:
+        sys.path.pop(0)
+
+    def rec(run_id, value, ts, faulted):
+        r = {"schema": 1, "kind": "fit", "run_id": run_id,
+             "ts_unix_s": ts, "pid": 1,
+             "machine": {"backend": "cpu"}, "model_sig": "cafe",
+             "mesh": {"data": 8}, "knobs": {"batch_size": 16},
+             "perf": {"metric": "fit.steps_per_s", "value": value,
+                      "higher_is_better": True}}
+        if faulted:
+            r["faults"] = {"schema": 1, "total_fired": 3,
+                           "fired": {"train.stall": 3}}
+        return r
+
+    lines = [rec("r1", 100.0, 1.0, False), rec("r2", 101.0, 2.0, False),
+             rec("r3", 99.0, 3.0, False),
+             # newest: a chaos run 10x slower — must NOT read as a
+             # regression, and must not poison future baselines
+             rec("r4", 10.0, 4.0, True)]
+    with open(tmp_path / "runs-1.jsonl", "w") as f:
+        for r in lines:
+            f.write(json.dumps(r) + "\n")
+    out = run_sentinel(ledger_dir=str(tmp_path))
+    assert out["ledger"]["faulted_excluded"] == 1
+    assert out["exit"] == 0 and not out["regressions"]
+    (row,) = out["cohorts"]
+    assert row["newest_run_id"] == "r3"  # the newest CLEAN run is judged
+    assert row["verdict"] == "ok"

@@ -1,0 +1,503 @@
+#!/usr/bin/env python
+"""Chaos bench: run the fault-plan matrix and assert every recovery
+invariant — one JSON line.
+
+The fault-tolerance layer's acceptance gate (``make chaos``, wired into
+``make ci`` after the sentinel): each scenario arms a deterministic
+fault plan (runtime/faults.py), lets the failure happen, and asserts
+the RECOVERY — not just the failure — worked:
+
+* ``off_overhead`` — with no plan armed the machinery is measurably
+  free (one global read per site; asserted < 5us/check) and a clean fit
+  produces ZERO ``faults.*`` metric series;
+* ``resume_bit_identity`` — a subprocess is hard-killed
+  (``os._exit``) mid-epoch at step N under periodic checkpointing; a
+  second subprocess resumes from the checkpoint dir and its final
+  params (sha256 over raw bytes) and full-epoch loss trajectory are
+  **bit-identical** to an uninterrupted subprocess run;
+* ``torn_checkpoint_fallback`` — the newest checkpoint is torn
+  post-commit; restore falls back to the newest INTACT step (counted),
+  and the restored params match that step exactly (no torn read);
+* ``nan_guard_rollback`` — an injected NaN loss rolls back through the
+  TrainingGuard with lr backoff; the run finishes healthy;
+* ``stall_watchdog_dump`` — an injected slow step trips the PR 8 stall
+  watchdog, which writes a black-box dump;
+* ``serving_degradation`` — under a crash-respawn plan plus overload:
+  every ACCEPTED future resolves (result or DeadlineExceeded), the
+  shed rate stays bounded and counted, the crashed worker respawns
+  within its budget, and the breaker opens after consecutive failures;
+* ``ledger_cohort_exclusion`` — chaotic fit records carry a ``faults``
+  block and ``tools/perf_sentinel.py`` excludes them from every perf
+  cohort (``faulted_excluded`` > 0).
+
+Prints ONE line::
+
+    {"scenarios": {...}, "violations": [...], "runtime_s": ..., "exit": 0|1}
+
+Exit status 1 on ANY violated invariant.
+
+Usage::
+
+    python tools/chaos_bench.py
+    python tools/chaos_bench.py --skip-subprocess   # in-process matrix only
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+# hermetic multi-device CPU mesh when launched standalone (mirrors
+# tests/conftest.py; a real TPU/GPU environment overrides via env)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8").strip()
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+KILL_EXIT = 41
+EPOCHS = 3
+
+
+# --------------------------------------------------------------- workload
+def _data():
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 8)).astype(np.float32)
+    w = rng.normal(size=(8, 4)).astype(np.float32)
+    y = np.argmax(x @ w, axis=1).astype(np.int32).reshape(-1, 1)
+    return x, y
+
+
+def _model(**cfg_kw):
+    """The canonical chaos workload: a tiny MLP, 4 steps/epoch at
+    bs=16 — small enough for subprocess matrix runs, real enough to
+    exercise the full step loop."""
+    from flexflow_tpu import FFConfig, FFModel, LossType
+    from flexflow_tpu.models.mlp import build_mlp
+    from flexflow_tpu.runtime.optimizer import AdamOptimizer
+
+    ff = FFModel(FFConfig(batch_size=16, seed=3, **cfg_kw))
+    build_mlp(ff, 16, in_dim=8, hidden_dims=(16,), num_classes=4)
+    ff.compile(optimizer=AdamOptimizer(alpha=0.01),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=["sparse_categorical_crossentropy"])
+    return ff
+
+
+def _params_sha(ff) -> str:
+    import numpy as np
+
+    h = hashlib.sha256()
+    for op in sorted(ff.compiled.params):
+        for w in sorted(ff.compiled.params[op]):
+            h.update(np.asarray(ff.compiled.params[op][w]).tobytes())
+    return h.hexdigest()
+
+
+# ------------------------------------------------------------- child mode
+def _child_fit(ns) -> int:
+    """One fit run in a fresh process (the subprocess matrix's unit):
+    deterministic workload, optional fault plan / checkpointing /
+    resume, result JSON written at the end (a killed child never writes
+    it — that's the parent's crash signal)."""
+    plan = json.loads(ns.plan_json) if ns.plan_json else None
+    ff = _model(fault_plan=plan,
+                checkpoint_interval_steps=ns.interval,
+                checkpoint_dir=ns.ckpt_dir)
+    x, y = _data()
+    history = ff.fit(x, y, epochs=EPOCHS, verbose=False,
+                     resume_from=ns.resume_from)
+    out = {
+        "params_sha": _params_sha(ff),
+        "iteration": ff.compiled.resume_state()["iteration"],
+        # per-epoch accumulated CE loss: bit-exact floats, the loss
+        # trajectory the parent compares across runs (full epochs only)
+        "epoch_loss": [pm.sparse_cce_loss for pm in history],
+        "epochs_run": len(history),
+    }
+    with open(ns.out, "w") as f:
+        json.dump(out, f)
+    return 0
+
+
+def _spawn_child(out: str, plan=None, interval: int = 0, ckpt_dir=None,
+                 resume_from=None, ledger_dir=None) -> subprocess.CompletedProcess:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    if ledger_dir:
+        env["FLEXFLOW_TPU_LEDGER_DIR"] = ledger_dir
+    cmd = [sys.executable, os.path.abspath(__file__), "--child", "fit",
+           "--out", out, "--interval", str(interval)]
+    if plan is not None:
+        cmd += ["--plan-json", json.dumps(plan)]
+    if ckpt_dir:
+        cmd += ["--ckpt-dir", ckpt_dir]
+    if resume_from:
+        cmd += ["--resume-from", resume_from]
+    return subprocess.run(cmd, cwd=_REPO, env=env, capture_output=True,
+                          text=True, timeout=600)
+
+
+# -------------------------------------------------------------- scenarios
+def _scenario_off_overhead(violations) -> dict:
+    """No plan armed: the per-site cost is one global read, and a clean
+    fit leaves zero faults.* series. MUST run first — later in-process
+    scenarios arm plans in this registry."""
+    from flexflow_tpu.obs.metrics import metrics_registry
+    from flexflow_tpu.runtime import faults
+
+    n = 200_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        faults.active()
+    per_call_us = (time.perf_counter() - t0) / n * 1e6
+    ff = _model()  # no fault_plan
+    x, y = _data()
+    ff.fit(x, y, epochs=1, verbose=False)
+    fault_series = [m for m in metrics_registry().names()
+                    if m.startswith("faults.")]
+    row = {"per_check_us": round(per_call_us, 4),
+           "fault_series_after_clean_fit": fault_series,
+           "fired_this_fit": 0 if faults.faults_block() is None else -1}
+    if per_call_us > 5.0:
+        violations.append(f"off_overhead: {per_call_us:.2f}us per "
+                          f"disarmed site check (> 5us)")
+    if fault_series:
+        violations.append(f"off_overhead: clean fit produced faults.* "
+                          f"series {fault_series}")
+    return row
+
+
+def _scenario_resume_bit_identity(violations, ledger_dir) -> dict:
+    """Hard kill at step N under periodic checkpointing; resume must be
+    bit-identical to the uninterrupted run (params + loss trajectory)."""
+    td = tempfile.mkdtemp(prefix="chaos_resume_")
+    ckpt = os.path.join(td, "ckpt")
+    a_out, c_out = os.path.join(td, "a.json"), os.path.join(td, "c.json")
+    # A: uninterrupted baseline
+    a = _spawn_child(a_out, ledger_dir=ledger_dir)
+    # B: killed hard at step 6 of 12 (checkpoints every 2 steps)
+    plan = {"schema": 1, "seed": 0,
+            "sites": {"train.kill": {"at_step": 6,
+                                     "exit_code": KILL_EXIT}}}
+    b = _spawn_child(os.path.join(td, "b.json"), plan=plan, interval=2,
+                     ckpt_dir=ckpt, ledger_dir=ledger_dir)
+    # C: auto-resume from the kill's checkpoint dir
+    c = _spawn_child(c_out, resume_from=ckpt, ledger_dir=ledger_dir)
+    row = {"baseline_rc": a.returncode, "kill_rc": b.returncode,
+           "resume_rc": c.returncode}
+    if a.returncode != 0:
+        violations.append(f"resume: baseline child failed rc={a.returncode}"
+                          f": {a.stderr[-800:]}")
+        return row
+    if b.returncode != KILL_EXIT:
+        violations.append(f"resume: kill child exited rc={b.returncode}, "
+                          f"expected {KILL_EXIT}: {b.stderr[-800:]}")
+    if c.returncode != 0:
+        violations.append(f"resume: resumed child failed rc={c.returncode}"
+                          f": {c.stderr[-800:]}")
+        return row
+    with open(a_out) as f:
+        base = json.load(f)
+    with open(c_out) as f:
+        res = json.load(f)
+    row.update({"baseline_sha": base["params_sha"],
+                "resumed_sha": res["params_sha"],
+                "bit_identical": base["params_sha"] == res["params_sha"],
+                "final_epoch_loss": [base["epoch_loss"][-1],
+                                     res["epoch_loss"][-1]]})
+    if base["params_sha"] != res["params_sha"]:
+        violations.append("resume: final params NOT bit-identical to the "
+                          "uninterrupted run")
+    # loss trajectory: every epoch fully run post-resume must match the
+    # baseline's bit for bit (the resume epoch itself is partial in the
+    # resumed history — by construction it re-runs only the tail)
+    if base["epoch_loss"][-1] != res["epoch_loss"][-1]:
+        violations.append(
+            f"resume: final-epoch loss diverged "
+            f"({base['epoch_loss'][-1]} vs {res['epoch_loss'][-1]})")
+    if base["iteration"] != res["iteration"]:
+        violations.append(f"resume: iteration {res['iteration']} != "
+                          f"baseline {base['iteration']}")
+    return row
+
+
+def _scenario_torn_checkpoint(violations) -> dict:
+    """Tear the newest checkpoint post-commit; restore must fall back to
+    the newest intact step — counted, with no torn read."""
+    import numpy as np
+
+    from flexflow_tpu.obs.metrics import metrics_registry
+    from flexflow_tpu.runtime.checkpoint import CheckpointManager
+
+    td = tempfile.mkdtemp(prefix="chaos_torn_")
+    x, y = _data()
+    ff = _model()
+    ff.fit(x, y, epochs=1, verbose=False)
+    mgr = CheckpointManager(td, max_to_keep=4)
+    mgr.save(ff, 1)
+    good = {op: {w: np.asarray(v) for w, v in ws.items()}
+            for op, ws in ff.compiled.params.items()}
+    ff.fit(x, y, epochs=1, verbose=False)
+    # arm the torn-write site for the NEXT save only
+    from flexflow_tpu.runtime import faults
+
+    class _P:  # minimal config carrier for configure_faults
+        fault_plan = {"schema": 1, "sites": {
+            "checkpoint.torn_write": {"at_step": 1}}}
+
+    faults.configure_faults(_P)
+    mgr.save(ff, 2)  # committed, then torn
+    faults.configure_faults(type("_Off", (), {"fault_plan": None}))
+    before = (metrics_registry().get("checkpoint.corrupt_fallbacks")
+              or type("z", (), {"value": 0})).value
+    ff2 = _model()
+    step = mgr.restore(ff2)
+    fell_back = (metrics_registry().get("checkpoint.corrupt_fallbacks")
+                 .value if metrics_registry().get(
+                     "checkpoint.corrupt_fallbacks") else 0) - before
+    mgr.close()
+    intact = all(
+        np.array_equal(np.asarray(ff2.compiled.params[op][w]), good[op][w])
+        for op in good for w in good[op])
+    row = {"restored_step": step, "fallbacks": fell_back,
+           "restored_matches_intact": bool(intact)}
+    if step != 1:
+        violations.append(f"torn: restore landed on step {step}, "
+                          f"expected fallback to 1")
+    if fell_back < 1:
+        violations.append("torn: fallback was not counted")
+    if not intact:
+        violations.append("torn: restored params do not match the intact "
+                          "step (torn read)")
+    return row
+
+
+def _scenario_nan_guard(violations) -> dict:
+    """Injected NaN loss -> TrainingGuard rollback + lr backoff; the
+    run finishes with finite loss and the ledger guard block says so."""
+    import numpy as np
+
+    from flexflow_tpu.runtime.guard import TrainingGuard
+
+    plan = {"schema": 1, "sites": {"train.nan_loss": {"at_step": 2}}}
+    ff = _model(fault_plan=plan)
+    x, y = _data()
+    guard = TrainingGuard(max_restores=2, lr_backoff=0.5)
+    history = ff.fit(x, y, epochs=2, verbose=False, guard=guard)
+    rep = (ff.fit_profile or {}).get("guard") or {}
+    final_loss = history[-1].sparse_cce_loss
+    row = {"restores": rep.get("restores"), "events": len(
+        rep.get("events") or []), "final_loss_finite":
+        bool(np.isfinite(final_loss))}
+    if not rep.get("restores"):
+        violations.append("nan: guard recorded no restore")
+    if not np.isfinite(final_loss):
+        violations.append("nan: final loss is not finite after rollback")
+    return row
+
+
+def _scenario_stall_watchdog(violations) -> dict:
+    """Injected slow step -> the stall watchdog dumps a black box."""
+    from flexflow_tpu.obs.watchdog import configure_watchdog
+
+    td = tempfile.mkdtemp(prefix="chaos_stall_")
+    plan = {"schema": 1, "sites": {"train.stall": {"at_step": 2,
+                                                   "stall_s": 1.2}}}
+    ff = _model(fault_plan=plan, watchdog="on", watchdog_threshold_s=0.25,
+                watchdog_dir=td)
+    x, y = _data()
+    ff.fit(x, y, epochs=1, verbose=False)
+    configure_watchdog(enabled=False)  # disarm for later scenarios
+    dumps = [n for n in os.listdir(td) if n.startswith("blackbox-")]
+    row = {"dumps": len(dumps)}
+    if not dumps:
+        violations.append("stall: watchdog wrote no black-box dump")
+    return row
+
+
+def _scenario_serving(violations) -> dict:
+    """Crash-respawn + overload: accepted futures all resolve, shed is
+    bounded+counted, the breaker opens on consecutive failures."""
+    import numpy as np
+
+    from flexflow_tpu.obs.metrics import metrics_registry
+    from flexflow_tpu.serving.engine import (DeadlineExceeded,
+                                             InferenceEngine, ShedError)
+
+    reg = metrics_registry()
+
+    def _ctr(name):
+        m = reg.get(name)
+        return m.value if m is not None else 0
+
+    # --- crash + respawn: every accepted future resolves ------------------
+    plan = {"schema": 1, "sites": {"serving.worker": {"at_step": 2}}}
+    ff = _model(fault_plan=plan)
+    eng = InferenceEngine(batch_timeout_s=0.002, worker_retry_budget=2)
+    eng.register_ffmodel(ff, "chaos")
+    # batch 1 completes (and pays the cold compile) before the rest are
+    # submitted, so the crash site — armed for the worker's SECOND
+    # batch — deterministically fires with requests in hand
+    futs = [eng.infer_async("chaos", [np.zeros(8, np.float32)])]
+    futs[0].result(120)
+    futs += [eng.infer_async("chaos", [np.zeros(8, np.float32)])
+             for _ in range(11)]
+    unresolved = 0
+    for f in futs:
+        try:
+            f.result(60)
+        except Exception:  # noqa: BLE001 — resolution is what's asserted
+            unresolved += 0 if f.done() else 1
+    eng.stop()
+    respawns = _ctr("serving.worker_respawns")
+    # --- overload: bounded admission + deadlines --------------------------
+    ff2 = _model()
+    eng2 = InferenceEngine(batch_timeout_s=0.05, admission_limit=4,
+                           default_deadline_s=0.0002)
+    eng2.register_ffmodel(ff2, "overload")
+    shed = 0
+    accepted = []
+    for _ in range(40):
+        try:
+            accepted.append(eng2.infer_async(
+                "overload", [np.zeros(8, np.float32)]))
+        except ShedError:
+            shed += 1
+    resolved = 0
+    for f in accepted:
+        try:
+            f.result(60)
+            resolved += 1
+        except DeadlineExceeded:
+            resolved += 1
+        except Exception:  # noqa: BLE001
+            resolved += 1 if f.done() else 0
+    eng2.stop()
+    # --- breaker: consecutive failures open it ----------------------------
+    ff3 = _model()
+    eng3 = InferenceEngine(batch_timeout_s=0.002, breaker_threshold=2,
+                           breaker_cooldown_s=5.0)
+    inst = eng3.register_ffmodel(ff3, "broken")
+    inst.infer = lambda *a, **k: (_ for _ in ()).throw(
+        RuntimeError("dead backend"))
+    for _ in range(2):
+        try:
+            eng3.infer_async("broken", [np.zeros(8, np.float32)]).result(60)
+        except RuntimeError:
+            pass
+    breaker_shed = False
+    try:
+        eng3.infer_async("broken", [np.zeros(8, np.float32)])
+    except ShedError:
+        breaker_shed = True
+    eng3.stop()
+    row = {"respawns": respawns, "unresolved_futures": unresolved,
+           "shed": shed, "accepted": len(accepted),
+           "accepted_resolved": resolved, "breaker_shed": breaker_shed,
+           "shed_counter": _ctr("serving.shed")}
+    if unresolved:
+        violations.append(f"serving: {unresolved} accepted future(s) never "
+                          f"resolved across the worker crash")
+    if respawns < 1:
+        violations.append("serving: crashed worker was not respawned")
+    if resolved != len(accepted):
+        violations.append(f"serving: {len(accepted) - resolved} accepted "
+                          f"future(s) unresolved under overload")
+    if not (0 < shed < 40):
+        violations.append(f"serving: shed rate unbounded or zero "
+                          f"({shed}/40 — admission bound not engaging)")
+    if _ctr("serving.shed") < shed:
+        violations.append("serving: shed events under-counted")
+    if not breaker_shed:
+        violations.append("serving: breaker did not open after consecutive "
+                          "failures")
+    return row
+
+
+def _scenario_ledger_exclusion(violations, ledger_dir) -> dict:
+    """Chaotic records carry the faults block; the sentinel excludes
+    them from every perf cohort."""
+    from flexflow_tpu.obs.ledger import scan_ledger
+    from perf_sentinel import run_sentinel
+
+    runs = scan_ledger(ledger_dir)["runs"]
+    chaotic = [r for r in runs if r.get("kind") == "fit" and r.get("faults")]
+    clean = [r for r in runs if r.get("kind") == "fit"
+             and not r.get("faults")]
+    out = run_sentinel(ledger_dir=ledger_dir)
+    excluded = (out.get("ledger") or {}).get("faulted_excluded", 0)
+    judged_ids = {row.get("newest_run_id") for row in out.get("cohorts", [])}
+    leaked = [r["run_id"] for r in chaotic if r["run_id"] in judged_ids]
+    row = {"fit_records": len(clean) + len(chaotic),
+           "chaotic_records": len(chaotic), "faulted_excluded": excluded,
+           "chaotic_judged": leaked}
+    if not chaotic:
+        violations.append("ledger: no chaotic fit record carried a faults "
+                          "block")
+    if excluded < len(chaotic):
+        violations.append(f"ledger: sentinel excluded {excluded} < "
+                          f"{len(chaotic)} chaotic records")
+    if leaked:
+        violations.append(f"ledger: chaotic run(s) {leaked} were judged "
+                          f"as a cohort's newest run")
+    return row
+
+
+# ------------------------------------------------------------------- main
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--child", choices=["fit"], default=None)
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--plan-json", default=None)
+    ap.add_argument("--interval", type=int, default=0)
+    ap.add_argument("--ckpt-dir", default=None)
+    ap.add_argument("--resume-from", default=None)
+    ap.add_argument("--skip-subprocess", action="store_true",
+                    help="skip the (slower) kill/resume subprocess matrix")
+    ns = ap.parse_args(argv)
+    if ns.child == "fit":
+        return _child_fit(ns)
+
+    t0 = time.perf_counter()
+    # the whole bench runs against its own ledger (chaos records must
+    # not leak into the repo's perf corpus; the exclusion scenario
+    # still proves the sentinel contract on this dir)
+    ledger_dir = tempfile.mkdtemp(prefix="chaos_ledger_")
+    os.environ["FLEXFLOW_TPU_LEDGER_DIR"] = ledger_dir
+    violations: list = []
+    scenarios = {}
+    scenarios["off_overhead"] = _scenario_off_overhead(violations)
+    if not ns.skip_subprocess:
+        scenarios["resume_bit_identity"] = _scenario_resume_bit_identity(
+            violations, ledger_dir)
+    scenarios["torn_checkpoint_fallback"] = _scenario_torn_checkpoint(
+        violations)
+    scenarios["nan_guard_rollback"] = _scenario_nan_guard(violations)
+    scenarios["stall_watchdog_dump"] = _scenario_stall_watchdog(violations)
+    scenarios["serving_degradation"] = _scenario_serving(violations)
+    scenarios["ledger_cohort_exclusion"] = _scenario_ledger_exclusion(
+        violations, ledger_dir)
+    out = {
+        "scenarios": scenarios,
+        "violations": violations,
+        "runtime_s": round(time.perf_counter() - t0, 3),
+        "exit": 1 if violations else 0,
+    }
+    print(json.dumps(out, sort_keys=True, default=str))
+    return out["exit"]
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -141,6 +141,11 @@ def explain(run_id: Optional[str] = None,
             "findings": div.get("findings"),
         } if div else None),
         "watchdog": rec.get("watchdog"),
+        # fault-tolerance narrative: the TrainingGuard recovery block
+        # (divergence restores + lr backoffs) and the fault-injection
+        # block (chaos runs), when the record carries them
+        "guard": rec.get("guard"),
+        "faults": rec.get("faults"),
         "cohort": _cohort_trend(rec, runs),
         "ledger": {"dir": ledger_dir or _ledger_dir(),
                    "runs": len(runs),
@@ -200,6 +205,28 @@ def _render_text(doc: Dict) -> str:
             f"divergence: e2e_ratio={d.get('e2e_ratio')} "
             f"(source {d.get('source')}; per-op rows "
             f"{d.get('per_op_total')}, {trunc or 0} truncated)")
+    g = doc.get("guard")
+    if g:
+        restores = [e for e in g.get("events") or []
+                    if e.get("kind") == "restore"]
+        if restores:
+            lines.append(
+                f"guard: {g.get('restores', len(restores))} divergence "
+                f"recovery(ies) — rolled back at step(s) "
+                f"{[e.get('step') for e in restores]} with lr backoff "
+                f"x{g.get('lr_backoff')}; budget "
+                f"{g.get('restores_used')}/{g.get('max_restores')} used")
+        else:
+            lines.append(
+                f"guard: armed, no divergence ({g.get('snapshots')} "
+                f"snapshot(s), budget {g.get('restores_used')}/"
+                f"{g.get('max_restores')})")
+    f = doc.get("faults")
+    if f:
+        lines.append(
+            f"faults: CHAOS RUN — plan seed {f.get('seed')} fired "
+            f"{f.get('total_fired')} fault(s) {f.get('fired')}; this "
+            f"record is excluded from perf baselines")
     c = doc.get("cohort") or {}
     if c.get("verdict") == "ok":
         lines.append(

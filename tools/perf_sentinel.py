@@ -2,7 +2,10 @@
 """Perf sentinel: the run ledger's regression tripwire — one JSON line.
 
 Reads the durable run ledger (``.ffcache/obs/runs/``, written by every
-``fit``/``eval`` and bench-tool run), groups records into (model, mesh,
+``fit``/``eval`` and bench-tool run), drops fault-injected chaos runs
+(records carrying a ``faults`` block — their throughput measures the
+injected failures, not the code; the drop count surfaces as
+``ledger.faulted_excluded``), groups the rest into (model, mesh,
 knobs, backend) cohorts — cross-cohort ratios are meaningless — and
 compares each cohort's NEWEST run against its baseline, the median of
 the cohort's prior values (the existing bench methodology: medians, and
@@ -63,6 +66,11 @@ def _cohorts(runs: List[Dict]) -> Dict[str, List[Dict]]:
 
     out: Dict[str, List[Dict]] = {}
     for r in runs:
+        if r.get("faults"):
+            # a fault-injected (chaos) run: its throughput measures the
+            # injected failures, not the code — never a baseline, never
+            # a judged newest run (counted by the caller)
+            continue
         perf = r.get("perf") or {}
         if not isinstance(perf.get("value"), (int, float)) \
                 or perf["value"] <= 0 or not perf.get("metric"):
@@ -185,6 +193,9 @@ def run_sentinel(ledger_dir: Optional[str] = None,
             "files": scan["files"],
             "runs": len(runs),
             "corrupt_lines": scan["corrupt_lines"],
+            # chaos runs (ledger "faults" block) excluded from every
+            # cohort — injected failures must not move perf baselines
+            "faulted_excluded": sum(1 for r in runs if r.get("faults")),
             "by_kind": _by_kind(runs),
         },
         "exec": exec_block,
