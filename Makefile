@@ -62,8 +62,8 @@ PY ?= python
 CPU_MESH = JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
 .PHONY: ci native native-check lint concurrency-lint pcg-lint audit \
-        test dryrun bench bench-fit bench-pipe obs-report sentinel chaos \
-        explain
+        test dryrun bench bench-fit bench-pipe bench-pipe-smoke \
+        obs-report sentinel chaos explain
 
 # sentinel runs AFTER obs-report so a fresh checkout's first ci already
 # has ledger records to judge (first run: no baseline -> clean exit);
@@ -71,7 +71,7 @@ CPU_MESH = JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8
 # never the corpus the sentinel just judged); explain runs last and
 # narrates the newest of those records
 ci: native native-check lint concurrency-lint test dryrun obs-report \
-    sentinel chaos explain audit
+    bench-pipe-smoke sentinel chaos explain audit
 
 lint:
 	$(PY) -c "from flexflow_tpu.analysis.hotpath_lint import main; \
@@ -108,6 +108,12 @@ bench-fit:
 
 bench-pipe:
 	$(CPU_MESH) $(PY) tools/pipe_bench.py
+
+# tier-1 envelope guard: forces engine="compiled" for an interleaved
+# schedule and a pipe×data submesh point — exits non-zero if either
+# falls back to the host engine (mirrors tests/test_pipe_bench.py)
+bench-pipe-smoke:
+	$(CPU_MESH) $(PY) tools/pipe_bench.py --smoke
 
 obs-report:
 	$(CPU_MESH) $(PY) tools/obs_report.py

@@ -250,7 +250,7 @@ def cohort_key(rec: Dict) -> str:
 _KNOB_FIELDS = ("batch_size", "compute_dtype", "prefetch_depth",
                 "steps_per_dispatch", "max_inflight_steps",
                 "grad_accum_steps", "zero_optimizer", "pipeline_schedule",
-                "search_cache", "perform_fusion")
+                "pipeline_interleave", "search_cache", "perform_fusion")
 
 
 def model_context(ff) -> Dict:
@@ -275,8 +275,19 @@ def model_context(ff) -> Dict:
 
         ctx["mesh"] = dict(mesh_axis_sizes(cm.mesh))
     if ff.pipelined is not None:
-        # the schedule actually running (an "auto" knob resolves here)
-        ctx["knobs"]["pipeline_schedule"] = ff.pipelined.cfg.schedule
+        # the RESOLVED pipeline envelope, not the requested knobs: an
+        # "auto" schedule resolves here, and the engine family plus the
+        # stage-submesh shape are cohort dimensions — a new-envelope run
+        # (compiled interleaved, pipe×data submesh) must never be
+        # sentinel-judged against an old-envelope baseline that executed
+        # a different engine on the same mesh
+        pm = ff.pipelined
+        ctx["knobs"]["pipeline_schedule"] = pm.cfg.schedule
+        ctx["knobs"]["pipeline_interleave"] = pm.cfg.interleave
+        ctx["knobs"]["pipeline_engine"] = pm.engine_name
+        ctx["knobs"]["pipeline_submesh"] = json.dumps(
+            sorted((a, s) for a, s in mesh_axis_sizes(pm.mesh).items()
+                   if a != pm.cfg.axis and s > 1))
     return ctx
 
 

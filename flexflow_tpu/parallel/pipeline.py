@@ -20,9 +20,12 @@ Two engines execute the same schedule IR (:mod:`.schedule`):
   **single-dispatch** engine (:mod:`.pipeline_compiled`): the whole
   warmup/steady/cooldown schedule lowered into ONE jitted program
   (``lax.scan`` over schedule ticks, stage-boundary transfers as
-  collective permutes inside ``shard_map``). Requires one device per
-  stage; :func:`make_pipelined_model` picks it automatically when the
-  mesh and schedule allow and falls back to the host engine otherwise.
+  collective permutes over the pipe ring inside ``shard_map``). Covers
+  every schedule (gpipe/1f1b/interleaved) on the ``pipe`` and
+  ``pipe×data`` mesh families (batch-linear graphs only under a data
+  submesh); :func:`make_pipelined_model` picks it automatically when
+  the envelope holds and falls back to the host engine otherwise,
+  recording the reason on ``fallback_reason``.
 
 Both engines share the stage split, per-chunk programs, parameter
 placement, and gradient-accumulation order (backwards run in microbatch
@@ -90,10 +93,11 @@ class PipelineConfig:
     activations are ever stored.
 
     ``engine``: ``"auto"`` picks the single-dispatch compiled engine
-    (:mod:`.pipeline_compiled`) when the mesh has one device per stage
-    and the schedule supports it, else the host-driven engine;
-    ``"host"``/``"compiled"`` force one (forcing ``"compiled"`` outside
-    its envelope raises).
+    (:mod:`.pipeline_compiled`) when its envelope holds — any schedule,
+    on the pipe or pipe×data mesh families with a batch-linear graph —
+    else the host-driven engine (with the reason recorded on
+    ``fallback_reason``); ``"host"``/``"compiled"`` force one (forcing
+    ``"compiled"`` outside its envelope raises).
     """
 
     num_stages: int
@@ -148,6 +152,12 @@ class PipelinedModel:
     """
 
     engine_name = "host"
+    # set by make_pipelined_model when engine="auto" picked this host
+    # engine although the caller might have expected the compiled one;
+    # None on the compiled engine and on forced-host builds. profile()
+    # publishes it so explain_run can tell a deliberate fallback from a
+    # silent one.
+    fallback_reason: Optional[str] = None
 
     def __init__(self, ops, mesh: Mesh, cfg: PipelineConfig, optimizer,
                  loss_fn, metrics_fn, input_ids: List[int], logits_id: int,
@@ -709,6 +719,15 @@ class PipelinedModel:
         rec = schedule_summary(self.schedule,
                                bwd_ratio=OpCostModel.BWD_FACTOR)
         rec["engine"] = self.engine_name
+        rec["requested_engine"] = self.cfg.engine
+        rec["fallback_reason"] = self.fallback_reason
+        # the envelope verdict for THIS mesh family (schedule/op checks
+        # aside): explain_run flags a compiled-eligible mesh that ran
+        # host with no recorded reason as a silent fallback
+        from ..sim.simulator import compiled_envelope_ok
+
+        rec["compiled_mesh_eligible"] = compiled_envelope_ok(
+            mesh_axis_sizes(self.mesh), self.cfg.axis)
         rec["remat"] = bool(self.cfg.remat)
         rec["dispatches_per_step"] = self.step_dispatches
         rec["transfers_per_step"] = self.step_transfers
@@ -801,7 +820,9 @@ def make_pipelined_model(ops, mesh, cfg: PipelineConfig, optimizer,
         return PipelinedModel(ops, mesh, cfg, **kw)
     from .pipeline_compiled import (CompiledPipelinedModel,
                                     compiled_engine_unsupported)
-    reason = compiled_engine_unsupported(mesh, cfg)
+    reason = compiled_engine_unsupported(
+        mesh, cfg, ops=ops,
+        batch_size=getattr(audit_config, "batch_size", None))
     if reason is None:
         try:
             return CompiledPipelinedModel(ops, mesh, cfg, **kw)
@@ -812,4 +833,9 @@ def make_pipelined_model(ops, mesh, cfg: PipelineConfig, optimizer,
     if cfg.engine == "compiled":
         raise ValueError(
             f"pipeline engine 'compiled' unsupported here: {reason}")
-    return PipelinedModel(ops, mesh, cfg, **kw)
+    pm = PipelinedModel(ops, mesh, cfg, **kw)
+    # auto requested, host delivered: keep the reason on the engine so
+    # fit_profile["pipeline"]/the ledger record WHY (explain_run's
+    # silent-fallback gate reads it)
+    pm.fallback_reason = reason
+    return pm

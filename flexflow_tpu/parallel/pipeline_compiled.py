@@ -9,10 +9,21 @@ jitted SPMD program:
 * ``lax.scan`` over schedule ticks; per tick every stage executes its
   scheduled action (``lax.switch`` over {idle, F, B, FB}, with an inner
   switch over the per-stage chunk programs — stages are heterogeneous op
-  sub-graphs, not a repeated layer);
-* stage-boundary transfers are **collective permutes** over the mesh's
-  pipe axis inside ``shard_map`` — the ICI hop, expressed where it
-  happens instead of as host-driven ``device_put`` edges;
+  sub-graphs, not a repeated layer). Interleaved virtual stages ride the
+  same tick table: the chunk a stage runs at tick t comes from a static
+  per-(tick, stage) chunk table, so V chunks per stage cost nothing but
+  table entries;
+* stage-boundary transfers are **collective permutes over the pipe
+  ring** inside ``shard_map`` — the ICI hop, expressed where it happens
+  instead of as host-driven ``device_put`` edges. The ring (with its
+  wrap edge) is what lets chunk c on stage S-1 feed chunk c+1 back onto
+  stage 0 under interleaving; with V == 1 the wrap edge only ever
+  carries zeros;
+* edge-buffer and saved-input slots are **statically allocated by an
+  interval pass** over the tick table (allocate at arrival/save, free
+  after the consuming tick), so in-flight values never collide even
+  when an interleaved stage consumes across chunks out of arrival
+  order;
 * gradients accumulate into a per-stage packed buffer in fixed
   microbatch order (the same order as the host engine, so per-step
   losses/grads match bit for bit up to XLA refusion);
@@ -31,21 +42,37 @@ to the host engine.
 
 Envelope (checked by :func:`compiled_engine_unsupported`):
 
-* one device per stage — every mesh axis except the pipe axis has size 1
-  (the CPU/TPU SPMD partitioner cannot nest GSPMD inside a manual
-  shard_map region on this backend, so dp/tp inside a stage stays with
-  the host engine);
-* schedule ``gpipe`` or ``1f1b`` with ``interleave == 1`` (interleaved
-  virtual stages stay host-driven);
+* mesh families ``pipe`` and ``pipe×data``: every mesh axis except the
+  pipe axis and the data axis has size 1. Under a data submesh the
+  program shard_maps over BOTH axes manually: microbatches stay
+  batch-sharded over the data axis, each backward's gradient
+  contribution is ``psum`` over data (one unconditional collective per
+  tick, outside the action switch, so every ``lax.switch`` branch
+  agrees on the collective signature — the AUD005 contract), and the
+  recorded per-microbatch losses/aux reduce once after the scan
+  (``psum * 1/dp`` — the mean-of-equal-shard-means identity, exact for
+  power-of-two shard counts). The cotangent seed carries the extra
+  ``1/dp`` so local-mean vjps reproduce the host engine's global-mean
+  gradients;
+* schedules ``gpipe``, ``1f1b`` and ``interleaved`` (any interleave the
+  schedule IR accepts);
+* under a data submesh the graph must be batch-linear: ops whose
+  forward or aux losses couple examples across the batch (BatchNorm
+  statistics, the MoE gating/aggregation family, Dropout's full-batch
+  mask) would compute different numbers per data shard than the host
+  engine's GSPMD lowering — those graphs stay host-driven
+  (:func:`dp_unsupported_reason`);
 * backward is remat-by-construction: each backward replays its chunk's
   forward from the saved packed boundary input — only stage-boundary
   activations ever live in the scan carry, which is what makes the 1F1B
   O(num_stages) activation bound real at the buffer level
-  (``saved: (K, A)`` with K = the schedule's peak live count).
+  (``saved: (K+1, A)`` with K = the interval pass's peak concurrent
+  saved inputs; row K is the scratch slot chunk-0 forwards write).
 """
 
 from __future__ import annotations
 
+import heapq
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -55,29 +82,71 @@ import jax.numpy as jnp
 from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-from ..core.machine import mesh_axis_sizes
+from ..core.machine import DATA_AXIS, mesh_axis_sizes
+from ..ffconst import OpType
 from .pipeline import PipelineConfig, PipelinedModel
 
 _PACK_DTYPES = (jnp.float32, jnp.bfloat16, jnp.int32)
 
+# ops whose math couples examples ACROSS the batch: under the manual
+# data-submesh lowering each data shard would compute its own statistics
+# (BatchNorm), routing fractions (the MoE family's load-balance aux is a
+# product of batch means, not a mean of per-example terms), or dropout
+# mask stream — valid training, but not bit-identical to the host
+# engine's GSPMD full-batch lowering, so those graphs stay host-driven.
+_DP_BATCH_COUPLED_OPS = frozenset({
+    OpType.BATCHNORM, OpType.DROPOUT, OpType.GROUP_BY, OpType.AGGREGATE,
+    OpType.AGGREGATE_SPEC, OpType.GROUP_BY_STACKED, OpType.EXPERT_LINEAR,
+    OpType.AGGREGATE_STACKED, OpType.CACHE,
+})
 
-def compiled_engine_unsupported(mesh: Mesh,
-                                cfg: PipelineConfig) -> Optional[str]:
+
+def dp_unsupported_reason(ops, dp: int) -> Optional[str]:
+    """None when the op graph is batch-linear (safe under the manual
+    data-submesh lowering); else the one-line reason. dp == 1 is always
+    fine — there is no data axis to disagree over."""
+    if dp <= 1 or ops is None:
+        return None
+    bad = sorted({op.op_type.value for op in ops
+                  if op.op_type in _DP_BATCH_COUPLED_OPS})
+    if bad:
+        return (f"batch-coupled op(s) {bad} under a data submesh "
+                f"(per-shard statistics would diverge from the host "
+                f"engine's full-batch lowering)")
+    return None
+
+
+def compiled_engine_unsupported(mesh: Mesh, cfg: PipelineConfig,
+                                ops=None,
+                                batch_size: Optional[int] = None
+                                ) -> Optional[str]:
     """None when the single-dispatch engine can run on (mesh, cfg); else
     a one-line reason (the factory's fallback message and the forced-
-    engine error)."""
-    if cfg.schedule not in ("gpipe", "1f1b"):
+    engine error). ``ops``/``batch_size`` sharpen the data-submesh
+    checks when the caller has them (the factory and the engine ctor
+    do; mesh-only callers get the mesh-family answer)."""
+    if cfg.schedule not in ("gpipe", "1f1b", "interleaved"):
         return (f"schedule {cfg.schedule!r} is host-driven "
-                f"(compiled supports gpipe|1f1b)")
-    if cfg.interleave != 1:
-        return "interleaved virtual stages are host-driven"
+                f"(compiled supports gpipe|1f1b|interleaved)")
     sizes = mesh_axis_sizes(mesh)
-    extra = {a: s for a, s in sizes.items() if a != cfg.axis and s > 1}
+    extra = {a: s for a, s in sizes.items()
+             if a not in (cfg.axis, DATA_AXIS) and s > 1}
     if extra:
         return (f"mesh has non-trivial axes {extra} besides "
-                f"'{cfg.axis}' — one device per stage required")
+                f"'{cfg.axis}'/'{DATA_AXIS}' — compiled covers the pipe "
+                f"and pipe×data families only")
     if sizes.get(cfg.axis, 1) < 2:
         return f"mesh {cfg.axis} axis has degree < 2"
+    dp = sizes.get(DATA_AXIS, 1)
+    if dp > 1:
+        reason = dp_unsupported_reason(ops, dp)
+        if reason:
+            return reason
+        if batch_size is not None:
+            M = max(1, int(cfg.num_microbatches))
+            if batch_size % M or (batch_size // M) % dp:
+                return (f"batch {batch_size} does not split into "
+                        f"{M} microbatches × {dp} data shards")
     return None
 
 
@@ -150,90 +219,99 @@ def _unpack(buf: jax.Array, segs, treedef, cotangent: bool = False):
 _IDLE, _F, _B, _FB = 0, 1, 2, 3
 
 
-def _build_tables(sched) -> Dict[str, np.ndarray]:
+def _interval_slots(T: int, S: int, produces: Dict, consumes: Dict
+                    ) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Static slot assignment by interval allocation: ``produces`` maps
+    ``(chunk, mb) -> (tick, stage)`` where the value lands in a stage's
+    buffer, ``consumes`` maps the same key to the tick/stage that reads
+    it. A slot is taken from the stage's free pool at the producing
+    tick and returned AFTER the consuming tick (an arrival and a
+    same-tick consumption of an older value therefore never share a
+    slot — the engine integrates arrivals at tick start, before the
+    read). Returns (write_table, read_table, ring_size); write entries
+    with no event point at the scratch slot ``ring_size``."""
+    w = np.full((T, S), -1, np.int64)
+    r = np.zeros((T, S), np.int64)
+    arr_by_tick: Dict[int, List] = {}
+    con_by_tick: Dict[int, List] = {}
+    for key, (t, s) in produces.items():
+        arr_by_tick.setdefault(t, []).append((s, key))
+    for key, (t, s) in consumes.items():
+        con_by_tick.setdefault(t, []).append((s, key))
+    free: List[List[int]] = [[] for _ in range(S)]
+    hi = [0] * S
+    slot_of: Dict = {}
+    R = 0
+    for t in range(T):
+        for s, key in sorted(arr_by_tick.get(t, ())):
+            if key not in consumes:
+                continue  # produced but never read (cannot happen for a
+                #            validated schedule; defensive)
+            if free[s]:
+                slot = heapq.heappop(free[s])
+            else:
+                slot = hi[s]
+                hi[s] += 1
+                R = max(R, hi[s])
+            slot_of[key] = slot
+            w[t, s] = slot
+        ends = []
+        for s, key in sorted(con_by_tick.get(t, ())):
+            slot = slot_of.pop(key)
+            r[t, s] = slot
+            ends.append((s, slot))
+        for s, slot in ends:
+            heapq.heappush(free[s], slot)
+    R = max(R, 1)
+    w = np.where(w >= 0, w, R)
+    return w.astype(np.int32), r.astype(np.int32), R
+
+
+def _build_tables(sched) -> Dict[str, Any]:
     """Static per-(tick, stage) control tables driving the scan body:
-    action kind/microbatch, edge-buffer read/write slots (round-robin
-    over the max in-flight count per direction; the scratch slot R
-    absorbs ticks with no arrival), and the saved-input ring slot."""
+    action kind/microbatch/chunk, edge-buffer write/read slots, and the
+    saved-input save/read slots. Edge arrivals ride the ring permute in
+    the scan carry — a value produced at tick t integrates at the START
+    of tick t+1 on the destination stage ``(chunk±1) % S`` (the modular
+    stage arithmetic is what makes interleaved wrap edges work)."""
     S, T = sched.num_stages, sched.num_ticks
+    C = S * sched.interleave
     kinds = np.zeros((T, S), np.int32)
     mbs = np.zeros((T, S), np.int32)
+    chs = np.zeros((T, S), np.int32)
     karr = {"F": _F, "B": _B, "FB": _FB}
-    for t, row in enumerate(sched.ticks):
-        for s, a in enumerate(row):
-            if a is not None:
-                kinds[t, s] = karr[a.kind]
-                mbs[t, s] = a.mb
-    # edge-buffer slot assignment: FIFO arrival/consumption per edge
-    # (validate_buffers guarantees in-order consumption), so slot =
-    # sequence index mod R is collision-free
-    arr_f = [0] * S
-    use_f = [0] * S
-    arr_b = [0] * S
-    use_b = [0] * S
-    wf = np.full((T, S), -1, np.int32)
-    rf = np.zeros((T, S), np.int32)
-    wb = np.full((T, S), -1, np.int32)
-    rb = np.zeros((T, S), np.int32)
-    # a value produced at tick t arrives (via the permute in the carry)
-    # at the START of tick t+1 on the neighbor
-    for t, row in enumerate(sched.ticks):
-        if t > 0:
-            prev = sched.ticks[t - 1]
-            for s, a in enumerate(prev):
-                if a is None:
-                    continue
-                if a.kind == "F" and s + 1 < S:
-                    wf[t, s + 1] = arr_f[s + 1]
-                    arr_f[s + 1] += 1
-                if a.kind in ("B", "FB") and s - 1 >= 0:
-                    wb[t, s - 1] = arr_b[s - 1]
-                    arr_b[s - 1] += 1
-        for s, a in enumerate(row):
-            if a is None:
-                continue
-            if a.kind in ("F", "FB") and s > 0:
-                rf[t, s] = use_f[s]
-                use_f[s] += 1
-            if a.kind == "B":
-                rb[t, s] = use_b[s]
-                use_b[s] += 1
-    return dict(kinds=kinds, mbs=mbs, wf=wf, rf=rf, wb=wb, rb=rb)
-
-
-def _slot_mod(tables: Dict[str, np.ndarray], sched) -> Dict[str, Any]:
-    """Finalize slot tables: compute per-direction ring sizes R from the
-    schedule's max in-flight counts (an exact replay of pending values
-    over the tick table), reduce sequence indices mod R, and point
-    no-arrival ticks at the scratch slot R."""
-    S = sched.num_stages
-    pend_f = [0] * S
-    pend_b = [0] * S
-    R_f = R_b = 1
+    prod_f: Dict = {}
+    cons_f: Dict = {}
+    prod_b: Dict = {}
+    cons_b: Dict = {}
+    prod_s: Dict = {}
+    cons_s: Dict = {}
     for t, row in enumerate(sched.ticks):
         for s, a in enumerate(row):
             if a is None:
                 continue
-            if a.kind in ("F", "FB") and s > 0:
-                pend_f[s] -= 1
-            if a.kind == "B" and s < S - 1:
-                pend_b[s] -= 1
-        for s, a in enumerate(row):
-            if a is None:
-                continue
-            if a.kind == "F" and s + 1 < S:
-                pend_f[s + 1] += 1
-                R_f = max(R_f, pend_f[s + 1])
-            if a.kind in ("B", "FB") and s - 1 >= 0:
-                pend_b[s - 1] += 1
-                R_b = max(R_b, pend_b[s - 1])
-    out = dict(tables)
-    out["wf"] = np.where(tables["wf"] >= 0, tables["wf"] % R_f, R_f)
-    out["rf"] = tables["rf"] % R_f
-    out["wb"] = np.where(tables["wb"] >= 0, tables["wb"] % R_b, R_b)
-    out["rb"] = tables["rb"] % R_b
-    out["R_f"], out["R_b"] = R_f, R_b
-    return out
+            kinds[t, s] = karr[a.kind]
+            mbs[t, s] = a.mb
+            chs[t, s] = a.chunk
+            if a.kind == "F" and a.chunk < C - 1:
+                prod_f[(a.chunk + 1, a.mb)] = (t + 1, (a.chunk + 1) % S)
+            if a.kind in ("F", "FB") and a.chunk > 0:
+                cons_f[(a.chunk, a.mb)] = (t, s)
+            if a.kind in ("B", "FB") and a.chunk > 0:
+                prod_b[(a.chunk - 1, a.mb)] = (t + 1, (a.chunk - 1) % S)
+            if a.kind == "B" and a.chunk < C - 1:
+                cons_b[(a.chunk, a.mb)] = (t, s)
+            # saved inputs for the remat backward: chunk-0 forwards
+            # replay from the model inputs and save nothing
+            if a.kind == "F" and a.chunk > 0:
+                prod_s[(a.chunk, a.mb)] = (t, s)
+            if a.kind == "B" and a.chunk > 0:
+                cons_s[(a.chunk, a.mb)] = (t, s)
+    wf, rf, R_f = _interval_slots(T, S, prod_f, cons_f)
+    wb, rb, R_b = _interval_slots(T, S, prod_b, cons_b)
+    sv, rs, K = _interval_slots(T, S, prod_s, cons_s)
+    return dict(kinds=kinds, mbs=mbs, chunks=chs, wf=wf, rf=rf, wb=wb,
+                rb=rb, sv=sv, rs=rs, R_f=R_f, R_b=R_b, K=K)
 
 
 class CompiledPipelinedModel(PipelinedModel):
@@ -257,14 +335,25 @@ class CompiledPipelinedModel(PipelinedModel):
     _views_stale = False
 
     def __init__(self, ops, mesh, cfg: PipelineConfig, **kw):
-        reason = compiled_engine_unsupported(mesh, cfg)
+        reason = compiled_engine_unsupported(
+            mesh, cfg, ops=ops,
+            batch_size=getattr(kw.get("audit_config"), "batch_size",
+                               None))
         if reason is not None:
             raise NotImplementedError(reason)
         super().__init__(ops, mesh, cfg, **kw)
         S = len(self.stages)
+        sizes = mesh_axis_sizes(mesh)
+        self._dp = sizes.get(DATA_AXIS, 1)
         pipe_index = list(mesh.axis_names).index(cfg.axis)
-        flat = np.moveaxis(mesh.devices, pipe_index, 0).reshape(S)
-        self._pmesh = Mesh(flat, ("pipe",))
+        if self._dp > 1:
+            data_index = list(mesh.axis_names).index(DATA_AXIS)
+            flat = np.moveaxis(mesh.devices, (pipe_index, data_index),
+                               (0, 1)).reshape(S, self._dp)
+            self._pmesh = Mesh(flat, ("pipe", DATA_AXIS))
+        else:
+            flat = np.moveaxis(mesh.devices, pipe_index, 0).reshape(S)
+            self._pmesh = Mesh(flat, ("pipe",))
         # static packing metadata (raises NotImplementedError on
         # unpackable dtypes BEFORE any device work — the factory's
         # fallback point)
@@ -275,8 +364,7 @@ class CompiledPipelinedModel(PipelinedModel):
             _leaf_segments(self.stage_opt_state[s]) for s in range(S)]
         self._Lp = max(seg[2] for seg in self._param_segs)
         self._Lo = max(max(seg[2] for seg in self._opt_segs), 1)
-        self._tables = _slot_mod(_build_tables(self.schedule),
-                                 self.schedule)
+        self._tables = _build_tables(self.schedule)
         self._packed = None       # (theta, opt) device buffers
         self._views_stale = False
         self._programs: Dict[Tuple, Any] = {}  # per (mb_shape sig) jit
@@ -365,10 +453,11 @@ class CompiledPipelinedModel(PipelinedModel):
 
     # ------------------------------------------------------- boundaries
     def _boundary_segments(self, mb: int):
-        """Per-boundary packed-activation segments at microbatch size
-        ``mb``, derived by chaining jax.eval_shape over the chunk
-        programs (the ONLY reliable source of boundary dtypes under
-        mixed precision / integer pass-through)."""
+        """Per-boundary packed-activation segments at PER-DEVICE
+        microbatch size ``mb`` (the data-shard slice under pipe×data),
+        derived by chaining jax.eval_shape over the chunk programs (the
+        ONLY reliable source of boundary dtypes under mixed precision /
+        integer pass-through)."""
         C = len(self.chunks)
         tid_dims = {}
         tid_dtype = {}
@@ -407,23 +496,37 @@ class CompiledPipelinedModel(PipelinedModel):
                        with_metrics: bool):
         S = len(self.stages)
         C = len(self.chunks)
+        V = self.cfg.interleave
         M = self.cfg.num_microbatches
+        dp = self._dp
         tb = self._tables
-        bsegs, A = self._boundary_segments(mb)
-        K = max(self.schedule.peak_live(s) for s in range(S))
+        mb_local = mb // dp
+        bsegs, A = self._boundary_segments(mb_local)
+        K = tb["K"]
         R_f, R_b = tb["R_f"], tb["R_b"]
         kinds = jnp.asarray(tb["kinds"])
         mbs_t = jnp.asarray(tb["mbs"])
+        chs_t = jnp.asarray(tb["chunks"])
         wf = jnp.asarray(tb["wf"])
         rf = jnp.asarray(tb["rf"])
         wb = jnp.asarray(tb["wb"])
         rb = jnp.asarray(tb["rb"])
+        sv = jnp.asarray(tb["sv"])
+        rs = jnp.asarray(tb["rs"])
         T = tb["kinds"].shape[0]
         loss_fn = self.loss_fn
         logits_id = self.logits_id
         cdt = self.compute_dtype
         chunk_fns = [self._chunk_apply(c, training=True, mesh=False)
                      for c in range(C)]
+        # 1/dp as a STRONG-typed constant: under a data submesh the
+        # chunk programs see local batch shards, so the recorded
+        # local-mean losses reduce by psum * inv_dp (mean of equal-shard
+        # means) and the vjp cotangent seed carries the same factor —
+        # exact scalings for power-of-two shard counts, which is what
+        # keeps the data-submesh family bit-identical to the host
+        # engine's GSPMD full-batch means
+        inv_dp = jnp.float32(1.0 / dp)
         # logits shape for the metrics buffer (from the tail chunk)
         logits_sds = None
         if with_metrics:
@@ -445,8 +548,12 @@ class CompiledPipelinedModel(PipelinedModel):
             lg_dt = jnp.float32 if cdt is not None else lg.dtype
             logits_sds = (lg.shape, lg_dt)
 
-        fwd_perm = [(i, i + 1) for i in range(S - 1)]
-        bwd_perm = [(i + 1, i) for i in range(S - 1)]
+        # ring permutes over the pipe axis: chunk c lives on stage
+        # c % S, so EVERY forward send goes to the ring-next stage and
+        # every backward send to ring-prev — including the wrap edges
+        # interleaving needs (with V == 1 the wrap only carries zeros)
+        fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+        bwd_perm = [(i, (i - 1) % S) for i in range(S)]
 
         def shard_body(theta, opt, rng, hyper, inv_m_t, y_st, *xs_st):
             # theta: (1, Lp) local row; squeeze to (Lp,)
@@ -455,9 +562,11 @@ class CompiledPipelinedModel(PipelinedModel):
             sidx = jax.lax.axis_index("pipe")
             # 1/M arrives as a TRACED argument (not a closure): a baked
             # scalar closure is exactly the AUD006 retrace hazard the
-            # program audit flags, and the traced form is bit-identical
-            daux = inv_m_t
-            cot = inv_m_t
+            # program audit flags, and the traced form is bit-identical.
+            # Under a data submesh the seed gains the exact 1/dp factor
+            # (local-mean vjp -> global-mean cotangents, see above).
+            daux = inv_m_t * inv_dp if dp > 1 else inv_m_t
+            cot = daux
 
             def inputs_for(m):
                 return {tid: jax.lax.dynamic_index_in_dim(
@@ -467,17 +576,23 @@ class CompiledPipelinedModel(PipelinedModel):
             def mb_rng(m, c):
                 return jax.random.fold_in(rng, m * 131 + c)
 
-            # ---- per-kind branches; uniform operand/result signatures
+            # ---- per-kind branches; uniform operand/result signatures.
+            # Every branch returns (send_f, send_b, saved, g_contrib,
+            # losses, auxes, logits_b): the gradient contribution comes
+            # OUT of the switch so the data-axis psum (when dp > 1) is
+            # one unconditional collective per tick — every switch
+            # branch agrees on the collective signature (AUD005).
             def idle_fn(opr):
-                (m, rfv, rbv, fsl, bsl, saved, gacc, losses, auxes,
-                 logits_b) = opr
+                (m, ch, rfv, rbv, svv, rsv, fsl, bsl, saved, losses,
+                 auxes, logits_b) = opr
                 return (jnp.zeros((A,), jnp.float32),
                         jnp.zeros((A,), jnp.float32),
-                        saved, gacc, losses, auxes, logits_b)
+                        saved, jnp.zeros((self._Lp,), jnp.float32),
+                        losses, auxes, logits_b)
 
             def f_fn(opr):
-                (m, rfv, rbv, fsl, bsl, saved, gacc, losses, auxes,
-                 logits_b) = opr
+                (m, ch, rfv, rbv, svv, rsv, fsl, bsl, saved, losses,
+                 auxes, logits_b) = opr
                 inbuf = jax.lax.dynamic_index_in_dim(fsl, rfv, 0,
                                                      keepdims=False)
 
@@ -498,26 +613,29 @@ class CompiledPipelinedModel(PipelinedModel):
                     return run
 
                 send_f, aux = jax.lax.switch(
-                    sidx, [br(c) for c in range(C - 1)], 0)
-                # save the packed input for the backward replay (stage 0
-                # replays from xs directly; its slot holds zeros)
-                slot = jnp.mod(m, K)
+                    ch, [br(c) for c in range(C - 1)], 0)
+                # save the packed input for the backward replay
+                # (chunk-0 forwards replay from xs directly; the static
+                # slot table points them at the scratch row K)
                 saved = jax.lax.dynamic_update_index_in_dim(
-                    saved, jnp.where(sidx > 0, inbuf,
+                    saved, jnp.where(ch > 0, inbuf,
                                      jnp.zeros((A,), jnp.float32)),
-                    slot, 0)
-                auxes = auxes.at[m].set(aux)
+                    svv, 0)
+                # per-(virtual-chunk, microbatch) aux cell — one row per
+                # chunk the stage hosts, so interleaved chunks never
+                # clobber each other's aux terms
+                auxes = auxes.at[ch // S, m].set(aux)
                 return (send_f, jnp.zeros((A,), jnp.float32), saved,
-                        gacc, losses, auxes, logits_b)
+                        jnp.zeros((self._Lp,), jnp.float32),
+                        losses, auxes, logits_b)
 
             def b_fn(opr):
-                (m, rfv, rbv, fsl, bsl, saved, gacc, losses, auxes,
-                 logits_b) = opr
+                (m, ch, rfv, rbv, svv, rsv, fsl, bsl, saved, losses,
+                 auxes, logits_b) = opr
                 d_out_buf = jax.lax.dynamic_index_in_dim(
                     bsl, rbv, 0, keepdims=False)
-                slot = jnp.mod(m, K)
                 saved_in = jax.lax.dynamic_index_in_dim(
-                    saved, slot, 0, keepdims=False)
+                    saved, rsv, 0, keepdims=False)
 
                 def br(c):
                     def run(_):
@@ -548,13 +666,13 @@ class CompiledPipelinedModel(PipelinedModel):
                     return run
 
                 send_b, g = jax.lax.switch(
-                    sidx, [br(c) for c in range(C - 1)], 0)
+                    ch, [br(c) for c in range(C - 1)], 0)
                 return (jnp.zeros((A,), jnp.float32), send_b, saved,
-                        gacc + g, losses, auxes, logits_b)
+                        g, losses, auxes, logits_b)
 
             def fb_fn(opr):
-                (m, rfv, rbv, fsl, bsl, saved, gacc, losses, auxes,
-                 logits_b) = opr
+                (m, ch, rfv, rbv, svv, rsv, fsl, bsl, saved, losses,
+                 auxes, logits_b) = opr
                 c = C - 1
                 inbuf = jax.lax.dynamic_index_in_dim(fsl, rfv, 0,
                                                      keepdims=False)
@@ -581,27 +699,38 @@ class CompiledPipelinedModel(PipelinedModel):
                 send_b = _pack(jax.tree_util.tree_flatten(dacts)[0],
                                bsegs[c - 1][0], A)
                 losses = losses.at[m].set(loss)
-                auxes = auxes.at[m].set(jnp.asarray(aux, jnp.float32))
+                auxes = auxes.at[V - 1, m].set(jnp.asarray(aux,
+                                                           jnp.float32))
                 if logits_b is not None:
                     logits_b = jax.lax.dynamic_update_index_in_dim(
                         logits_b, logits.astype(logits_b.dtype), m, 0)
                 return (jnp.zeros((A,), jnp.float32), send_b, saved,
-                        gacc + g, losses, auxes, logits_b)
+                        g, losses, auxes, logits_b)
 
             def tick(carry, t):
                 (fsl, bsl, saved, in_f, in_b, gacc, losses, auxes,
                  logits_b) = carry
-                # integrate last tick's arrivals (scratch slot R absorbs
+                # integrate last tick's arrivals (scratch slots absorb
                 # no-arrival ticks)
                 fsl = jax.lax.dynamic_update_index_in_dim(
                     fsl, in_f, wf[t, sidx], 0)
                 bsl = jax.lax.dynamic_update_index_in_dim(
                     bsl, in_b, wb[t, sidx], 0)
-                opr = (mbs_t[t, sidx], rf[t, sidx], rb[t, sidx], fsl,
-                       bsl, saved, gacc, losses, auxes, logits_b)
-                send_f, send_b, saved, gacc, losses, auxes, logits_b = \
+                opr = (mbs_t[t, sidx], chs_t[t, sidx], rf[t, sidx],
+                       rb[t, sidx], sv[t, sidx], rs[t, sidx], fsl, bsl,
+                       saved, losses, auxes, logits_b)
+                send_f, send_b, saved, g, losses, auxes, logits_b = \
                     jax.lax.switch(kinds[t, sidx],
                                    [idle_fn, f_fn, b_fn, fb_fn], opr)
+                if dp > 1:
+                    # gradient-sync collective per backward, OUTSIDE the
+                    # action switch: idle/forward ticks psum exact zeros
+                    # (x + 0 is bit-exact), backward ticks reduce their
+                    # contribution over the data axis BEFORE it joins
+                    # the accumulator — the host engine's per-microbatch
+                    # all-reduce-then-accumulate order, bit for bit
+                    g = jax.lax.psum(g, DATA_AXIS)
+                gacc = gacc + g
                 in_f2 = jax.lax.ppermute(send_f, "pipe", fwd_perm)
                 in_b2 = jax.lax.ppermute(send_b, "pipe", bwd_perm)
                 return (fsl, bsl, saved, in_f2, in_b2, gacc, losses,
@@ -611,17 +740,24 @@ class CompiledPipelinedModel(PipelinedModel):
             carry0 = (
                 jnp.zeros((R_f + 1, A), jnp.float32),
                 jnp.zeros((R_b + 1, A), jnp.float32),
-                jnp.zeros((K, A), jnp.float32),
+                jnp.zeros((K + 1, A), jnp.float32),
                 zeros_a, zeros_a,
                 jnp.zeros((self._Lp,), jnp.float32),
                 jnp.zeros((M,), jnp.float32),
-                jnp.zeros((M,), jnp.float32),
+                jnp.zeros((V, M), jnp.float32),
                 (jnp.zeros((M,) + logits_sds[0], logits_sds[1])
                  if logits_sds is not None else None),
             )
             carry, _ = jax.lax.scan(tick, carry0, jnp.arange(T))
             (_fsl, _bsl, _saved, _inf, _inb, gacc, losses, auxes,
              logits_b) = carry
+            if dp > 1:
+                # the recorded per-microbatch losses/aux are local
+                # shard means; one reduction turns them into the global
+                # means the host engine reports (exact for power-of-two
+                # shard counts)
+                losses = jax.lax.psum(losses, DATA_AXIS) * inv_dp
+                auxes = jax.lax.psum(auxes, DATA_AXIS) * inv_dp
 
             # ---- per-stage optimizer update, inside the same program
             def upd(s):
@@ -649,12 +785,14 @@ class CompiledPipelinedModel(PipelinedModel):
 
         P = PartitionSpec
         rep = P()
-        in_specs = (P("pipe", None), P("pipe", None), rep, rep, rep, rep) \
-            + tuple(rep for _ in xs_shapes)
+        batch_spec = P(None, DATA_AXIS) if dp > 1 else rep
+        in_specs = (P("pipe", None), P("pipe", None), rep, rep, rep,
+                    batch_spec) + tuple(batch_spec for _ in xs_shapes)
         out_specs = (P("pipe", None), P("pipe", None), P("pipe", None),
-                     P("pipe", None))
+                     P("pipe", None, None))
         if with_metrics:
-            out_specs = out_specs + (P("pipe"),)
+            out_specs = out_specs + (
+                P("pipe", None, DATA_AXIS) if dp > 1 else P("pipe"),)
         fn = shard_map(shard_body, self._pmesh, in_specs=in_specs,
                        out_specs=out_specs, check_rep=False)
         return jax.jit(fn, donate_argnums=(0, 1))
@@ -736,15 +874,25 @@ class CompiledPipelinedModel(PipelinedModel):
         assert xs[0].shape[0] % M == 0, (
             f"batch {xs[0].shape[0]} not divisible by microbatches {M}")
         mb = xs[0].shape[0] // M
+        if self._dp > 1 and mb % self._dp != 0:
+            raise ValueError(
+                f"microbatch {mb} not divisible by the stage submesh's "
+                f"data degree {self._dp} (compiled pipe×data "
+                f"engine shards each microbatch over the data axis)")
         self._ensure_packed()
         self.step_dispatches = 0
         self.step_transfers = self.schedule.transfer_edges()
+        batch_sh = NamedSharding(
+            self._pmesh,
+            PartitionSpec(None, DATA_AXIS) if self._dp > 1
+            else PartitionSpec())
         rep = NamedSharding(self._pmesh, PartitionSpec())
 
         def stack(a):
             a = jnp.asarray(a)
             return jax.device_put(
-                jnp.reshape(a, (M, a.shape[0] // M) + a.shape[1:]), rep)
+                jnp.reshape(a, (M, a.shape[0] // M) + a.shape[1:]),
+                batch_sh)
 
         xs_st = [stack(x) for x in xs]
         y_st = stack(y)
@@ -787,8 +935,10 @@ class CompiledPipelinedModel(PipelinedModel):
         self._views_stale = True
         losses = [losses_all[S - 1, m] for m in range(M)]
         # (microbatch-major, chunk-ascending) — the host engines' (and
-        # the historical) loss-combine order, bit for bit
-        aux_flat = [auxes_all[c, m] for m in range(M) for c in range(C)]
+        # the historical) loss-combine order, bit for bit; chunk c's aux
+        # cell lives at stage c % S, virtual row c // S
+        aux_flat = [auxes_all[c % S, c // S, m]
+                    for m in range(M) for c in range(C)]
         if not sync:
             return losses, aux_flat
         loss = float(

@@ -559,22 +559,35 @@ def compile_model(
     # grads -> update chain), so K scanned steps are numerically
     # equivalent to K serial dispatches; per-dispatch host/infeed overhead
     # is paid once instead of K times (the small-step regime where
-    # dispatch dominates — Kaufman et al. 2020). Per-step losses AND
-    # per-step batch metrics come back stacked (k, ...) — the fit loop
-    # accumulates the metric slices in step order, so the reduction
-    # order (hence the reported trajectory, bit for bit) matches k
-    # serial dispatches.
+    # dispatch dominates — Kaufman et al. 2020). The WHOLE step lives in
+    # the one program: forward/backward, gradient-sync collectives, the
+    # optimizer update, AND the per-step batch-metric fold — the metric
+    # accumulator rides the scan carry and folds each step's metrics in
+    # step order, so the returned totals match k serial accumulates bit
+    # for bit while the host parks exactly ONE device dict per dispatch
+    # instead of k. Per-step losses still come back stacked (k,) — the
+    # loss trajectory, guard sum, and recompile trigger need step
+    # granularity and k scalars are free.
     def train_k_steps(seq_length, hyper, params, opt_state, rngs, *stacked):
+        bm_spec = jax.eval_shape(
+            train_step, seq_length, hyper, params, opt_state, rngs[0],
+            *(s[0] for s in stacked))[3]
+        bm0 = jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), bm_spec)
+
         def body(carry, per_step):
-            params_i, opt_i = carry
+            params_i, opt_i, bm_acc = carry
             rng_i, batch_i = per_step[0], per_step[1:]
             params_i, opt_i, loss_i, bm_i = train_step(
                 seq_length, hyper, params_i, opt_i, rng_i, *batch_i)
-            return (params_i, opt_i), (loss_i, bm_i)
+            # device-side metric folding in step order (zero + x is
+            # bit-exact, so the k-fold equals k serial host folds)
+            bm_acc = {k: bm_acc[k] + bm_i[k] for k in bm_acc}
+            return (params_i, opt_i, bm_acc), loss_i
 
-        (params, opt_state), (losses, bms) = jax.lax.scan(
-            body, (params, opt_state), (rngs,) + stacked)
-        return params, opt_state, losses, bms
+        (params, opt_state, bm_folded), losses = jax.lax.scan(
+            body, (params, opt_state, bm0), (rngs,) + stacked)
+        return params, opt_state, losses, bm_folded
 
     # ---- standalone grad step (for the manual backward() verb) ------------
     def grad_step(seq_length, params, rng, *batch):
@@ -627,6 +640,7 @@ def compile_model(
     jit_train_k = None
     jit_grad = None
     _train_exec = None
+    _train_k_exec = None
     if optimizer is not None and loss_type is not None:
         _train_exec = jax.jit(train_step, static_argnums=0,
                               donate_argnums=(2, 3))
@@ -634,8 +648,9 @@ def compile_model(
         # one executable per distinct super size (the leading dim is part
         # of the trace shape) — the Prefetcher's plan only uses power-of-
         # two sizes up to k, so at most log2(k) entries compile
-        jit_train_k = _wrap_train(
-            jax.jit(train_k_steps, static_argnums=0, donate_argnums=(2, 3)))
+        _train_k_exec = jax.jit(train_k_steps, static_argnums=0,
+                                donate_argnums=(2, 3))
+        jit_train_k = _wrap_train(_train_k_exec)
         jit_grad = _wrap(jax.jit(grad_step, static_argnums=0))
     # ---- AUD002-driven donation: the eval label buffer -------------------
     # For dense losses the label tensor's aval equals the logits output's
@@ -694,6 +709,22 @@ def compile_model(
             (-1, optimizer.hyperparams(), _params_sds, _opt_sds,
              jax.random.key(config.seed), *_batch_sds),
             static_args={"seq_length": -1}))
+        # whole-program multi-step executable: when the step loop will
+        # actually dispatch it (steps_per_dispatch > 1), the audit gate
+        # covers it too — donation, baked consts, collective legality
+        # and the in-scan metric fold all live in THIS program, and its
+        # AOT trace is the one the first super-batch dispatch replays
+        _k = max(1, int(getattr(config, "steps_per_dispatch", 1)))
+        if _k > 1:
+            _rngs_k = jnp.stack([jax.random.key(config.seed)] * _k)
+            _batch_k = [jax.ShapeDtypeStruct((_k,) + tuple(b.shape),
+                                             b.dtype)
+                        for b in _batch_sds]
+            audit_exec.insert(1, ExecutableSpec(
+                "train_k_steps", _train_k_exec,
+                (-1, optimizer.hyperparams(), _params_sds, _opt_sds,
+                 _rngs_k, *_batch_k),
+                static_args={"seq_length": -1}))
 
     cm = CompiledModel(
         config=config,

@@ -50,34 +50,24 @@ class PerfMetrics:
     # bounded number of device scalars, never an unbounded list.
     _PENDING_CAP = 256
 
-    def _park(self, batch: Dict, n) -> None:
+    def accumulate(self, batch: Dict) -> None:
+        """Park one per-dispatch metric dict. The multi-step executable
+        folds its k per-step dicts device-side in step order before
+        returning (runtime/compiler.py train_k_steps), so every caller
+        parks exactly one dict per dispatch."""
         pending = getattr(self, "_dev_pending", None)
         if pending is None:
             pending = self._dev_pending = []
-        pending.append((batch, n))
+        pending.append(batch)
         if len(pending) >= self._PENDING_CAP:
             self._compact()
 
-    def accumulate(self, batch: Dict) -> None:
-        self._park(batch, None)
-
-    def accumulate_stacked(self, batch: Dict, n: int) -> None:
-        """Park a dict of (n, ...)-stacked per-step metrics (the
-        multi-step executable's output); the fold consumes the n slices
-        in step order, so the reduction sequence — and therefore the
-        reported totals, bit for bit — matches n serial accumulates."""
-        self._park(batch, n)
-
     def _compact(self) -> None:
-        """Fold parked entries (in arrival order, stacked slices in step
-        order) into the running device accumulator."""
+        """Fold parked entries (in arrival order) into the running
+        device accumulator."""
         acc = getattr(self, "_dev_acc", None)
-        for batch, n in getattr(self, "_dev_pending", None) or []:
-            if n is None:
-                acc = self._fold(acc, batch)
-            else:
-                for i in range(n):
-                    acc = self._fold(acc, {k: v[i] for k, v in batch.items()})
+        for batch in getattr(self, "_dev_pending", None) or []:
+            acc = self._fold(acc, batch)
         self._dev_acc = acc
         self._dev_pending = []
 

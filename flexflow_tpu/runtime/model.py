@@ -1090,9 +1090,10 @@ class FFModel:
         from ..search.unity import _stage_cut_bytes
         from ..sim import (OpCostModel, detect_machine_model,
                            load_machine_model)
-        from ..sim.simulator import (pipeline_schedule_candidates,
-                                     rank_pipeline_schedules,
-                                     single_device_stages)
+        from ..parallel.pipeline_compiled import dp_unsupported_reason
+        from ..sim.simulator import (compiled_envelope_ok,
+                                     pipeline_schedule_candidates,
+                                     rank_pipeline_schedules)
 
         machine = (load_machine_model(cfg.machine_model_file)
                    if cfg.machine_model_file
@@ -1110,11 +1111,19 @@ class FFModel:
             return (float("inf") if nc > n_ops
                     else _stage_cut_bytes(layers, nc))
 
+        # the compiled envelope verdict for THIS mesh AND graph: the
+        # pipe/pipe×data mesh families, minus batch-coupled graphs
+        # under a data submesh — so auto ranks with the dispatch
+        # overhead the engine selection will actually deliver
+        compiled_ok = (
+            compiled_envelope_ok(sizes, pipeline.axis)
+            and dp_unsupported_reason(
+                cm.ops, sizes.get("data", 1)) is None)
         kind, v, recs = rank_pipeline_schedules(
             cands, pipeline.num_stages, pipeline.num_microbatches,
             t_sub, machine, cut_bytes_fn=cut_fn,
             data_degree=sizes.get("data", 1),
-            compiled_ok=single_device_stages(sizes, pipeline.axis),
+            compiled_ok=compiled_ok,
             bwd_ratio=OpCostModel.BWD_FACTOR)
         self._pipe_schedule_records = recs
         if cfg.profiling:
@@ -1985,16 +1994,21 @@ class FFModel:
                     # steps would
                     rngs = jnp.stack(
                         [self._next_rng() for _ in range(nk)])
-                    cm.params, cm.opt_state, losses, bms = cm.train_k_steps(
-                        cm.params, cm.opt_state, rngs, *batch,
-                        seq_length=self.iter_config.seq_length,
-                    )
+                    cm.params, cm.opt_state, losses, bm_folded = \
+                        cm.train_k_steps(
+                            cm.params, cm.opt_state, rngs, *batch,
+                            seq_length=self.iter_config.seq_length,
+                        )
                     loss = losses[-1]
-                    # park the stacked per-step metrics; flush folds them
-                    # IN STEP ORDER, so the reported epoch metrics match
-                    # nk serial steps bit for bit
+                    # the nk per-step metric dicts were ALREADY folded
+                    # in step order inside the scanned program (the
+                    # whole-program discipline: optimizer, grad-sync
+                    # collectives and metric fold in one dispatch); the
+                    # host parks exactly one device dict per dispatch,
+                    # so epoch totals still match nk serial steps bit
+                    # for bit at 1/nk the host fold work
                     bm = None
-                    pm.accumulate_stacked(bms, nk)
+                    pm.accumulate(bm_folded)
                     guard_add = losses.sum() if guard is not None else None
                 else:
                     cm.params, cm.opt_state, loss, bm = cm.train_step(

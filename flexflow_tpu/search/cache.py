@@ -69,7 +69,12 @@ CACHE_VERSION = 2
 # (pipe_schedule/pipe_interleave) — a pre-schedule-knob entry would
 # otherwise rehydrate with an UNDEFINED schedule, so it demotes to a
 # clean, attributed CacheSchemaWarning miss instead.
-PAYLOAD_SCHEMA = 3
+# v4: plans additionally carry ``pipe_engine`` — the engine family
+# (compiled|host) the schedule ranking priced. The compiled envelope
+# widened (interleaved + pipe×data submeshes, COST_MODEL_VERSION 3), so
+# a v3 entry's est_step_time may embed host-engine dispatch overhead a
+# compiled run no longer pays; demote rather than replay a stale price.
+PAYLOAD_SCHEMA = 4
 
 # required payload fields and their validators: rehydration checks every
 # one of these BEFORE constructing a GraphSearchResult
@@ -94,6 +99,10 @@ _PAYLOAD_FIELDS = {
     "pipe_interleave": lambda v: (isinstance(v, int)
                                   and not isinstance(v, bool)
                                   and v >= 1),
+    # the engine family the schedule ranking priced (None on un-piped
+    # plans): the widened compiled envelope makes this a pricing
+    # dimension, not a runtime detail
+    "pipe_engine": lambda v: v is None or v in ("compiled", "host"),
 }
 
 
@@ -283,6 +292,7 @@ def result_to_payload(result: GraphSearchResult,
         "pruned": result.pruned,
         "pipe_schedule": result.pipe_schedule,
         "pipe_interleave": result.pipe_interleave,
+        "pipe_engine": result.pipe_engine,
     }
     if names_src is not None:
         payload["layer_names"] = [l.name for l in names_src]
@@ -416,6 +426,7 @@ def result_from_payload(payload: Dict, layers, config=None,
             pruned=int(payload.get("pruned", 0)),
             pipe_schedule=payload.get("pipe_schedule"),
             pipe_interleave=int(payload.get("pipe_interleave", 1)),
+            pipe_engine=payload.get("pipe_engine"),
         )
     except (KeyError, TypeError, ValueError):
         return None

@@ -74,6 +74,11 @@ class GraphSearchResult:
     # never runs with an undefined schedule
     pipe_schedule: Optional[str] = None
     pipe_interleave: int = 1
+    # engine family (compiled|host) the winning schedule was priced
+    # with: the widened single-dispatch envelope (interleaved +
+    # pipe×data submeshes) makes dispatch overhead a first-class
+    # pricing dimension, so the cache must replay the same assumption
+    pipe_engine: Optional[str] = None
     # per-candidate pricing records from the schedule ranking (not
     # persisted; profiling/debug surface)
     pipe_schedule_records: List = dataclasses.field(default_factory=list)
@@ -936,9 +941,9 @@ def _pipe_adjusted(
     only its layers). No reference equivalent — PP is reserved but
     unimplemented upstream (model.h:190-192).
     """
-    from ..sim.simulator import (pipeline_schedule_candidates,
-                                 rank_pipeline_schedules,
-                                 single_device_stages)
+    from ..sim.simulator import (compiled_envelope_ok,
+                                 pipeline_schedule_candidates,
+                                 rank_pipeline_schedules)
 
     M = pipe_microbatches(batch_size)
     data_degree = max(1, r.mesh_shape.get("data", 1))
@@ -958,19 +963,30 @@ def _pipe_adjusted(
     cands = pipeline_schedule_candidates(
         getattr(config, "pipeline_schedule", "auto") or "auto",
         getattr(config, "pipeline_interleave", 2), pipe, n_ops)
-    # the single-dispatch engine needs one device per stage: every
-    # non-pipe axis of the winning mesh must be trivial
-    compiled_ok = single_device_stages(r.mesh_shape)
+    # the single-dispatch engine covers the pipe and pipe×data mesh
+    # families; a batch-coupled graph (BatchNorm / MoE gating /
+    # Dropout) under a data submesh stays host-driven, so price it
+    # that way. pipeline_compiled owns the verdict; layers satisfy its
+    # op_type interface, so the search can never drift from the engine.
+    from ..parallel.pipeline_compiled import dp_unsupported_reason
+
+    dp_deg = max(1, r.mesh_shape.get("data", 1))
+    compiled_ok = (
+        compiled_envelope_ok({"pipe": pipe, **r.mesh_shape})
+        and dp_unsupported_reason(layers, dp_deg) is None)
     best_kind, best_v, records = rank_pipeline_schedules(
         cands, pipe, M, r.est_step_time, machine, cut_bytes_fn=cut_fn,
         data_degree=data_degree, compiled_ok=compiled_ok,
         bwd_ratio=OpCostModel.BWD_FACTOR)
+    best_engine = "compiled" if compiled_ok else "host"
     if records:
         rec = next(x for x in records if x["schedule"] == best_kind
                    and x["interleave"] == best_v)
         est = rec["est_step_time"]
+        best_engine = rec.get("engine", best_engine)
     else:  # no candidate legal (e.g. M too small) — fall back to gpipe
         best_kind, best_v = "gpipe", 1
+        best_engine = "host"
         bubble = ((M + pipe - 1) / (M * pipe)
                   if machine.effective_parallelism(pipe) > 1.0 else 1.0)
         est = (r.est_step_time * bubble
@@ -987,6 +1003,7 @@ def _pipe_adjusted(
     )
     res.rewrites, res.layers = r.rewrites, r.layers
     res.pipe_schedule, res.pipe_interleave = best_kind, best_v
+    res.pipe_engine = best_engine
     res.pipe_schedule_records = records
     return res
 

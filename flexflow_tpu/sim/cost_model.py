@@ -131,7 +131,12 @@ MEASURE_CALLS = 0
 # v2: pipe-prefixed plans priced by the schedule-aware model
 # (sim/simulator.py pipeline_schedule_cost: per-schedule tick replay +
 # engine-aware dispatch overhead) instead of the fixed GPipe bubble.
-COST_MODEL_VERSION = 2
+# v3: the single-dispatch compiled engine's envelope widened to
+# interleaved schedules and the pipe×data stage-submesh family
+# (simulator.compiled_envelope_ok) — interleaved candidates and
+# composite meshes now price ONE dispatch instead of the host engine's
+# O(S·M), which reorders schedule rankings on every pipe mesh.
+COST_MODEL_VERSION = 3
 
 
 class OpCostModel:

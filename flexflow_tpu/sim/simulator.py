@@ -512,11 +512,16 @@ def pipeline_schedule_candidates(requested: str, interleave: int,
     return [(requested, 1)]
 
 
-def single_device_stages(axis_sizes: Dict[str, int],
+def compiled_envelope_ok(axis_sizes: Dict[str, int],
                          pipe_axis: str = "pipe") -> bool:
-    """The compiled single-dispatch engine's mesh envelope: every
-    non-pipe axis trivial (one device per stage)."""
-    return all(s == 1 for a, s in axis_sizes.items() if a != pipe_axis)
+    """The single-dispatch engine's MESH envelope: the pipe-only and
+    pipe×data families (every axis besides pipe and data trivial).
+    Schedule legality and the batch-coupled-op check are separate
+    (parallel/pipeline_compiled.compiled_engine_unsupported owns the
+    full verdict); this is the mesh-shape half the search and the
+    schedule ranker price with."""
+    return all(s == 1 for a, s in axis_sizes.items()
+               if a not in (pipe_axis, "data"))
 
 
 def rank_pipeline_schedules(
@@ -534,8 +539,10 @@ def rank_pipeline_schedules(
 
     ``cut_bytes_fn(chunk_count) -> bytes`` supplies boundary traffic per
     chunk granularity (interleaved pays ~V× more cuts); ``compiled_ok``
-    says whether the single-dispatch engine's envelope holds on the
-    target mesh (it halves the dispatch-overhead story). Ties on
+    says whether the single-dispatch engine's envelope holds for the
+    target mesh AND graph (pipe/pipe×data family, batch-linear under a
+    data submesh — the caller owns that verdict), pricing EVERY
+    candidate schedule at one dispatch instead of O(S·M). Ties on
     est_step_time resolve toward the smaller activation footprint, then
     lexicographic schedule name — fully deterministic. Returns
     (best_schedule, best_interleave, all_records)."""
@@ -547,8 +554,10 @@ def rank_pipeline_schedules(
             sched = build_schedule(kind, num_stages, num_microbatches, V)
         except ScheduleError:
             continue
-        engine = ("compiled" if compiled_ok and V == 1
-                  and kind in ("gpipe", "1f1b") else "host")
+        # the compiled engine covers every schedule the IR accepts
+        # (gpipe/1f1b/interleaved) on an eligible mesh; ``compiled_ok``
+        # is the caller's envelope verdict for the target mesh/graph
+        engine = "compiled" if compiled_ok else "host"
         cut = cut_bytes_fn(num_stages * V) if cut_bytes_fn else 0.0
         records.append(pipeline_schedule_cost(
             sched, submesh_step_time, machine, cut_bytes=cut,

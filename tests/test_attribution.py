@@ -536,3 +536,67 @@ def test_profile_ops_backward_timing(tmp_path):
     # forward-only callers see the historical record shape
     recs_fwd = profile_ops(ff, iters=1, warmup=0)
     assert all("backward_ms" not in r for r in recs_fwd)
+
+
+def test_explain_run_envelope_narration_and_silent_fallback_gate(tmp_path):
+    """PR 12 satellite: explain_run narrates the compiled-vs-host
+    envelope choice from the fit record's pipeline block, and exits 1
+    when a compiled-eligible mesh fell back to the host engine with NO
+    recorded reason (an engine-selection bug, not an explanation)."""
+    from tools.explain_run import _render_text, explain
+
+    def write(rec):
+        with open(tmp_path / "runs-999.jsonl", "a") as f:
+            f.write(json.dumps(rec) + "\n")
+
+    base = {"schema": 1, "kind": "fit", "ts_unix_s": 1.0, "pid": 999,
+            "machine": {"backend": "cpu"}}
+    # honest fallback: reason recorded -> narrated, exit 0
+    write({**base, "run_id": "aa" * 16,
+           "pipeline": {"engine": "host", "schedule": "1f1b",
+                        "interleave": 1, "requested_engine": "auto",
+                        "compiled_mesh_eligible": True,
+                        "fallback_reason": "batch-coupled op(s) "
+                                           "['batch_norm'] under a "
+                                           "data submesh",
+                        "dispatches_per_step": 40,
+                        "bubble_fraction": 0.3}})
+    doc = explain(run_id="aa", ledger_dir=str(tmp_path))
+    assert doc["envelope"]["silent_fallback"] is False
+    assert doc["exit"] == 0
+    assert "batch-coupled" in _render_text(doc)
+    # SILENT fallback: eligible mesh, auto engine, no reason -> exit 1
+    write({**base, "run_id": "bb" * 16,
+           "pipeline": {"engine": "host", "schedule": "1f1b",
+                        "interleave": 1, "requested_engine": "auto",
+                        "compiled_mesh_eligible": True,
+                        "fallback_reason": None,
+                        "dispatches_per_step": 40}})
+    doc = explain(run_id="bb", ledger_dir=str(tmp_path))
+    assert doc["envelope"]["silent_fallback"] is True
+    assert doc["exit"] == 1
+    assert "SILENT" in _render_text(doc)
+    # compiled run: narrated as such, exit 0
+    write({**base, "run_id": "cc" * 16,
+           "pipeline": {"engine": "compiled", "schedule": "interleaved",
+                        "interleave": 2, "requested_engine": "auto",
+                        "compiled_mesh_eligible": True,
+                        "fallback_reason": None,
+                        "dispatches_per_step": 3,
+                        "bubble_fraction": 0.22}})
+    doc = explain(run_id="cc", ledger_dir=str(tmp_path))
+    assert doc["envelope"]["engine"] == "compiled"
+    assert doc["exit"] == 0
+    txt = _render_text(doc)
+    assert "single-dispatch compiled engine" in txt
+    assert "interleaved x2" in txt
+    # a deliberately forced host engine is not "silent"
+    write({**base, "run_id": "dd" * 16,
+           "pipeline": {"engine": "host", "schedule": "gpipe",
+                        "interleave": 1, "requested_engine": "host",
+                        "compiled_mesh_eligible": True,
+                        "fallback_reason": None,
+                        "dispatches_per_step": 40}})
+    doc = explain(run_id="dd", ledger_dir=str(tmp_path))
+    assert doc["envelope"]["silent_fallback"] is False
+    assert doc["exit"] == 0

@@ -486,3 +486,61 @@ def test_fit_bench_main_appends_ledger_record(tmp_path, monkeypatch):
     assert rec["knobs"] == {"batch": 64, "prefetch_depth": 2,
                             "steps_per_dispatch": 2, "steps": 4}
     assert rec["result"]["losses_bit_identical"] is True
+
+
+def test_ledger_cohort_covers_resolved_pipeline_envelope():
+    """PR 12 satellite: the RESOLVED pipeline envelope — interleave,
+    engine family, stage-submesh shape — is part of the ledger cohort
+    key, so a new-envelope run (compiled interleaved / pipe×data) is
+    never sentinel-judged against an old-envelope baseline on the same
+    mesh."""
+    import jax
+
+    from flexflow_tpu import (FFConfig, FFModel, LossType, SGDOptimizer,
+                              make_mesh)
+    from flexflow_tpu.obs.ledger import cohort_key, model_context
+    from flexflow_tpu.parallel.pipeline import PipelineConfig
+
+    def build(engine, schedule="1f1b", interleave=1):
+        ff = FFModel(FFConfig(batch_size=16, seed=0))
+        x = ff.create_tensor((16, 16), name="x")
+        t = ff.dense(x, 32, name="fc1")
+        t = ff.relu(t, name="a1")
+        t = ff.dense(t, 32, name="fc2")
+        t = ff.relu(t, name="a2")
+        t = ff.dense(t, 4, name="head")
+        ff.softmax(t, name="sm")
+        mesh = make_mesh({"pipe": 2, "data": 2},
+                         devices=jax.devices()[:4])
+        ff.compile(optimizer=SGDOptimizer(lr=0.1),
+                   loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                   metrics=[], mesh=mesh,
+                   pipeline=PipelineConfig(
+                       num_stages=2, num_microbatches=4,
+                       schedule=schedule, interleave=interleave,
+                       engine=engine))
+        return ff
+
+    ff_host = build("host")
+    ff_comp = build("auto")
+    assert ff_comp.pipelined.engine_name == "compiled"
+    ctx_h, ctx_c = model_context(ff_host), model_context(ff_comp)
+    # resolved envelope knobs present on the record
+    assert ctx_c["knobs"]["pipeline_engine"] == "compiled"
+    assert ctx_h["knobs"]["pipeline_engine"] == "host"
+    assert ctx_c["knobs"]["pipeline_interleave"] == 1
+    assert json.loads(ctx_c["knobs"]["pipeline_submesh"]) == [["data", 2]]
+    # same model, same mesh, different engine -> DIFFERENT cohorts
+    rec_h = {"kind": "fit", "perf": {"metric": "fit.steps_per_s"},
+             **ctx_h}
+    rec_c = {"kind": "fit", "perf": {"metric": "fit.steps_per_s"},
+             **ctx_c}
+    assert cohort_key(rec_h) != cohort_key(rec_c)
+    # interleave is a cohort dimension too
+    ff_il = build("auto", schedule="interleaved", interleave=2)
+    assert ff_il.pipelined.engine_name == "compiled"
+    ctx_il = model_context(ff_il)
+    assert ctx_il["knobs"]["pipeline_interleave"] == 2
+    rec_il = {"kind": "fit", "perf": {"metric": "fit.steps_per_s"},
+              **ctx_il}
+    assert cohort_key(rec_il) != cohort_key(rec_c)

@@ -228,3 +228,84 @@ def test_search_selects_and_caches_schedule(tmp_path):
     assert back is not None
     assert back.pipe_schedule == sr.pipe_schedule
     assert back.pipe_interleave == sr.pipe_interleave
+
+
+# --------------------------------------------- widened-envelope ranking
+def test_auto_never_ranks_illegal_pair():
+    """Regression (PR 12): across a grid of (stages, microbatches,
+    graph size, interleave) the auto ranking only ever returns
+    (schedule, interleave) pairs the schedule IR accepts — the PCG015
+    legality source — and the candidate construction never offers an
+    interleaved chunk count the graph cannot host."""
+    from flexflow_tpu.parallel.schedule import check_schedule
+    from flexflow_tpu.sim import detect_machine_model
+    from flexflow_tpu.sim.simulator import (pipeline_schedule_candidates,
+                                            rank_pipeline_schedules)
+
+    machine = detect_machine_model(4)
+    for S in (2, 3, 4):
+        for M in (1, 2, 4, 8):
+            for n_ops in (2, 3, 5, 8, 16, 40):
+                for ilv in (2, 3):
+                    cands = pipeline_schedule_candidates(
+                        "auto", ilv, S, n_ops)
+                    for compiled_ok in (False, True):
+                        kind, v, recs = rank_pipeline_schedules(
+                            cands, S, M, 1e-3, machine,
+                            compiled_ok=compiled_ok)
+                        # the winner must be buildable as-is
+                        check_schedule(kind, S, M, v)
+                        for rec in recs:
+                            check_schedule(rec["schedule"], S, M,
+                                           rec["interleave"])
+                            assert rec["engine"] == (
+                                "compiled" if compiled_ok else "host")
+
+
+def test_rank_prices_compiled_for_interleaved():
+    """The widened envelope prices interleaved candidates at ONE
+    dispatch when the compiled engine covers the mesh — the pre-PR
+    ranking charged interleaved the host engine's O(S*M) overhead and
+    could never select it on dispatch-dominated workloads."""
+    from flexflow_tpu.sim import detect_machine_model
+    from flexflow_tpu.sim.simulator import rank_pipeline_schedules
+
+    machine = detect_machine_model(2)
+    _, _, recs = rank_pipeline_schedules(
+        [("interleaved", 2)], 2, 8, 1e-3, machine, compiled_ok=True)
+    assert len(recs) == 1
+    assert recs[0]["engine"] == "compiled"
+    assert recs[0]["dispatches"] == 1
+
+
+def test_cache_payload_roundtrips_pipe_engine(tmp_path):
+    """Schema v4: the engine family the ranking priced rides the cache
+    payload, so a rehydrated plan replays the same dispatch-overhead
+    assumption the search priced."""
+    from flexflow_tpu import FFConfig, FFModel, LossType, SGDOptimizer
+    from flexflow_tpu.search.cache import (result_from_payload,
+                                           result_to_payload)
+
+    cfg = FFConfig(batch_size=8, search_budget=-1,
+                   mesh_shape={"pipe": 2, "data": 4})
+    ff = FFModel(cfg)
+    x = ff.create_tensor((8, 16), name="x")
+    t = ff.dense(x, 32, name="fc1")
+    t = ff.dense(t, 32, name="fc2")
+    t = ff.dense(t, 32, name="fc3")
+    t = ff.dense(t, 4, name="fc4")
+    ff.softmax(t, name="sm")
+    ff.compile(optimizer=SGDOptimizer(lr=0.1),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY)
+    sr = ff.search_result
+    assert sr.pipe_engine in ("compiled", "host")
+    payload = result_to_payload(sr, layers=ff.layers)
+    assert payload["pipe_engine"] == sr.pipe_engine
+    back = result_from_payload(payload, ff.layers, cfg)
+    assert back is not None and back.pipe_engine == sr.pipe_engine
+    # a payload with a corrupt engine family is a validation miss
+    from flexflow_tpu.search.cache import validate_payload
+
+    bad = dict(payload)
+    bad["pipe_engine"] = "warp"
+    assert any("pipe_engine" in p for p in validate_payload(bad))

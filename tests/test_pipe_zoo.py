@@ -43,6 +43,7 @@ VARIANTS = [
     ("gpipe", 1, "compiled"),
     ("1f1b", 1, "compiled"),
     ("interleaved", 2, "host"),
+    ("interleaved", 2, "compiled"),
 ]
 
 _FAST = ("mlp", "moe")
@@ -55,11 +56,16 @@ def _params_np(pm):
             for k, ws in pm.all_params().items()}
 
 
-def _build_and_data(name: str):
-    """Build the zoo model on the pipe-only mesh and synthesize one
-    batch (inputs via the shared synthesizer; labels from the logits
-    shape: 2-D logits -> sparse CE, otherwise MSE)."""
+def _build_and_data(name: str, mesh_shape=None):
+    """Build the zoo model on the pipe-only mesh (or the given mesh
+    shape) and synthesize one batch (inputs via the shared synthesizer;
+    labels from the logits shape: 2-D logits -> sparse CE, otherwise
+    MSE)."""
     builder = zoo_smoke_builders()[name]
+    mesh_shape = dict(mesh_shape or {"pipe": 2})
+    n_dev = 1
+    for v in mesh_shape.values():
+        n_dev *= v
 
     def make(schedule, interleave, engine):
         # auto-generated layer names embed a process-global counter and
@@ -73,7 +79,7 @@ def _build_and_data(name: str):
         layer_mod._layer_ids = itertools.count(10**6)
         ff = FFModel(FFConfig(batch_size=BS, seed=0))
         builder(ff, BS)
-        mesh = make_mesh({"pipe": 2}, devices=jax.devices()[:2])
+        mesh = make_mesh(mesh_shape, devices=jax.devices()[:n_dev])
         logits = ff._final_output()
         loss = (LossType.SPARSE_CATEGORICAL_CROSSENTROPY
                 if len(logits.dims) == 2
@@ -160,3 +166,59 @@ def test_zoo_schedule_equivalence(name):
 @pytest.mark.parametrize("name", _SLOW)
 def test_zoo_schedule_equivalence_slow(name):
     _sweep(name)
+
+
+# ------------------------------------------------------------------- #
+# pipe×data stage-submesh family (PR 12): on a composite mesh the      #
+# compiled engine must either run — bit-identical to the host          #
+# engine's GSPMD lowering — or fall back with a recorded reason when   #
+# the graph is batch-coupled (the envelope's honesty contract).        #
+# ------------------------------------------------------------------- #
+def _sweep_submesh(name: str):
+    from flexflow_tpu.ffconst import OpType
+    from flexflow_tpu.parallel.pipeline_compiled import \
+        dp_unsupported_reason
+
+    make, ref_ff, xs, y = _build_and_data(name, {"pipe": 2, "data": 2})
+    ref_losses, ref_params = _run(ref_ff, xs, y)
+    assert ref_ff.pipelined.engine_name == "host"
+    reason = dp_unsupported_reason(ref_ff.compiled.ops, 2)
+    has_conv = any(op.op_type is OpType.CONV2D
+                   for op in ref_ff.compiled.ops)
+    tol = (dict(rtol=2e-3, atol=2e-4) if has_conv
+           else dict(rtol=1e-6, atol=1e-7))
+    ptol = (dict(rtol=2e-2, atol=2e-3) if has_conv
+            else dict(rtol=1e-5, atol=1e-6))
+    ff, _ = make("1f1b", 1, "auto")
+    if reason is not None:
+        # batch-coupled graph: honest fallback, reason recorded where
+        # explain_run's silent-fallback gate reads it
+        assert ff.pipelined.engine_name == "host", name
+        assert "batch-coupled" in (ff.pipelined.fallback_reason or "")
+        assert ff.pipelined.profile()["fallback_reason"] \
+            == ff.pipelined.fallback_reason
+        return
+    assert ff.pipelined.engine_name == "compiled", (
+        f"{name}: compiled engine fell back on the pipe×data mesh "
+        f"({ff.pipelined.fallback_reason})")
+    losses, params = _run(ff, xs, y)
+    assert ff.pipelined.step_dispatches <= 2 + len(xs)
+    np.testing.assert_allclose(losses, ref_losses, **tol,
+                               err_msg=f"{name} submesh losses")
+    assert set(params) == set(ref_params)
+    for k in ref_params:
+        for w in ref_params[k]:
+            np.testing.assert_allclose(
+                params[k][w], ref_params[k][w], **ptol,
+                err_msg=f"{name} submesh {k}/{w}")
+
+
+@pytest.mark.parametrize("name", _FAST)
+def test_zoo_submesh_equivalence(name):
+    _sweep_submesh(name)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", _SLOW)
+def test_zoo_submesh_equivalence_slow(name):
+    _sweep_submesh(name)

@@ -435,11 +435,99 @@ def test_compiled_engine_bit_identical_and_single_dispatch():
             for w in p_ref[k]:
                 np.testing.assert_array_equal(
                     p[k][w], p_ref[k][w], err_msg=f"{schedule} {k}/{w}")
-    # forcing the compiled engine outside its envelope raises with the
-    # reason instead of silently running the wrong engine
-    with pytest.raises(ValueError, match="one device per stage"):
+    # forcing the compiled engine outside its envelope (a non-trivial
+    # axis that is neither pipe nor data) raises with the reason instead
+    # of silently running the wrong engine
+    with pytest.raises(ValueError, match="families only"):
         _train_variant("1f1b", engine="compiled",
-                       mesh_shape={"pipe": 2, "data": 4}, steps=0)
+                       mesh_shape={"pipe": 2, "model": 2}, steps=0)
+
+
+def test_compiled_engine_interleaved_bit_identical():
+    """PR 12 tentpole (a): interleaved virtual stages inside the
+    single-dispatch envelope — chunk round-robin rides the tick-table
+    chunk/slot tables, losses/params bit-identical to the host engine,
+    still O(1) dispatches."""
+    ff_h, l_h, p_h = _train_variant(
+        "interleaved", engine="host", interleave=2,
+        mesh_shape={"pipe": 2})
+    assert ff_h.pipelined.engine_name == "host"
+    ff_c, l_c, p_c = _train_variant(
+        "interleaved", engine="auto", interleave=2,
+        mesh_shape={"pipe": 2})
+    pm = ff_c.pipelined
+    assert pm.engine_name == "compiled"
+    assert pm.step_dispatches <= 3
+    assert pm.step_dispatches < ff_h.pipelined.step_dispatches
+    assert l_c == l_h, (l_c, l_h)
+    for k in p_h:
+        for w in p_h[k]:
+            np.testing.assert_array_equal(p_c[k][w], p_h[k][w],
+                                          err_msg=f"{k}/{w}")
+
+
+def test_compiled_engine_pipe_data_submesh_bit_identical():
+    """PR 12 tentpole (b): the pipe×data stage-submesh family — the
+    compiled engine shard_maps over BOTH axes, psums each backward's
+    gradient over data in host-engine order, and reduces the recorded
+    local-mean losses once after the scan. Bit-identical to the host
+    engine's GSPMD lowering on the same mesh, for plain and interleaved
+    schedules."""
+    for kw in (dict(schedule="1f1b"),
+               dict(schedule="interleaved", interleave=2)):
+        ff_h, l_h, p_h = _train_variant(
+            engine="host", mesh_shape={"pipe": 2, "data": 2}, **kw)
+        ff_c, l_c, p_c = _train_variant(
+            engine="auto", mesh_shape={"pipe": 2, "data": 2}, **kw)
+        pm = ff_c.pipelined
+        assert pm.engine_name == "compiled", kw
+        assert pm.step_dispatches <= 3
+        assert pm.step_dispatches < ff_h.pipelined.step_dispatches
+        assert l_c == l_h, (kw, l_c, l_h)
+        for k in p_h:
+            for w in p_h[k]:
+                np.testing.assert_array_equal(
+                    p_c[k][w], p_h[k][w], err_msg=f"{kw} {k}/{w}")
+
+
+def test_compiled_engine_dp_batch_coupled_falls_back_with_reason():
+    """A batch-coupled graph (MoE gating family) under a data submesh
+    must stay host-driven — per-shard routing statistics would diverge
+    from the GSPMD full-batch lowering — and the fallback must carry
+    its reason into the profile (explain_run's silent-fallback gate)."""
+    from flexflow_tpu import SGDOptimizer, make_mesh
+    from flexflow_tpu.models import MoeConfig, build_moe_mnist
+
+    ff = FFModel(FFConfig(batch_size=16, seed=0))
+    build_moe_mnist(ff, 16, MoeConfig(input_dim=32, num_classes=4,
+                                      num_exp=4, num_select=2,
+                                      expert_hidden_size=16, alpha=2.0))
+    mesh = make_mesh({"pipe": 2, "data": 2}, devices=jax.devices()[:4])
+    ff.compile(optimizer=SGDOptimizer(lr=0.05),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=[], mesh=mesh,
+               pipeline=PipelineConfig(num_stages=2, num_microbatches=2,
+                                       schedule="1f1b", engine="auto"))
+    pm = ff.pipelined
+    assert pm.engine_name == "host"
+    assert "batch-coupled" in (pm.fallback_reason or "")
+    rec = pm.profile()
+    assert rec["fallback_reason"] == pm.fallback_reason
+    assert rec["compiled_mesh_eligible"] is True
+    # the same graph on a pipe-only mesh IS compiled-eligible (integer
+    # routing tensors pack via bitcast; aux losses ride the (V, M) cells)
+    ff2 = FFModel(FFConfig(batch_size=16, seed=0))
+    build_moe_mnist(ff2, 16, MoeConfig(input_dim=32, num_classes=4,
+                                       num_exp=4, num_select=2,
+                                       expert_hidden_size=16, alpha=2.0))
+    ff2.compile(optimizer=SGDOptimizer(lr=0.05),
+                loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                metrics=[], mesh=make_mesh({"pipe": 2},
+                                           devices=jax.devices()[:2]),
+                pipeline=PipelineConfig(num_stages=2,
+                                        num_microbatches=2,
+                                        schedule="1f1b", engine="auto"))
+    assert ff2.pipelined.engine_name == "compiled"
 
 
 def test_sync_roundtrip_params_and_opt_state():

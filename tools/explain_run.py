@@ -115,6 +115,28 @@ def explain(run_id: Optional[str] = None,
     attr = rec.get("attribution") or {}
     rcn = attr.get("reconciliation") or {}
     div = rec.get("divergence") or {}
+    pipe = rec.get("pipeline") or {}
+    # envelope verdict: which engine ran, and WHY a compiled-eligible
+    # mesh fell back (a fallback with no recorded reason is a bug in
+    # the engine-selection path, not an explanation to prettify)
+    envelope = None
+    if pipe:
+        silent = bool(
+            pipe.get("engine") == "host"
+            and pipe.get("compiled_mesh_eligible")
+            and pipe.get("requested_engine") in (None, "auto")
+            and not pipe.get("fallback_reason"))
+        envelope = {
+            "engine": pipe.get("engine"),
+            "requested_engine": pipe.get("requested_engine"),
+            "schedule": pipe.get("schedule"),
+            "interleave": pipe.get("interleave"),
+            "dispatches_per_step": pipe.get("dispatches_per_step"),
+            "bubble_fraction": pipe.get("bubble_fraction"),
+            "compiled_mesh_eligible": pipe.get("compiled_mesh_eligible"),
+            "fallback_reason": pipe.get("fallback_reason"),
+            "silent_fallback": silent,
+        }
     doc: Dict = {
         "run_id": rec.get("run_id"),
         "kind": rec.get("kind"),
@@ -124,6 +146,7 @@ def explain(run_id: Optional[str] = None,
         "mesh": rec.get("mesh"),
         "knobs": rec.get("knobs"),
         "steps_per_s": (rec.get("throughput") or {}).get("steps_per_s"),
+        "envelope": envelope,
         "phases": attr.get("phases"),
         "phase_order": attr.get("phase_order"),
         "measured_step_s": attr.get("measured_step_s"),
@@ -152,8 +175,13 @@ def explain(run_id: Optional[str] = None,
                    "corrupt_lines": scan["corrupt_lines"]},
     }
     # exit contract: a selected record whose phase table does not
-    # reconcile is a bug upstream — fail the gate, don't prettify it
-    doc["exit"] = 1 if (attr and rcn and not rcn.get("reconciles")) else 0
+    # reconcile is a bug upstream — fail the gate, don't prettify it.
+    # Likewise a compiled-eligible mesh that SILENTLY fell back to the
+    # host engine (no recorded reason): the engine-selection path lost
+    # its honesty guarantee.
+    bad_attr = bool(attr and rcn and not rcn.get("reconciles"))
+    doc["exit"] = 1 if (bad_attr
+                        or (envelope or {}).get("silent_fallback")) else 0
     return doc
 
 
@@ -168,6 +196,34 @@ def _render_text(doc: Dict) -> str:
     ]
     if doc.get("steps_per_s"):
         lines.append(f"throughput {doc['steps_per_s']} steps/s")
+    env = doc.get("envelope")
+    if env:
+        sched = env.get("schedule") or "?"
+        if (env.get("interleave") or 1) > 1:
+            sched += f" x{env['interleave']}"
+        if env.get("engine") == "compiled":
+            lines.append(
+                f"envelope: single-dispatch compiled engine ({sched}, "
+                f"{env.get('dispatches_per_step')} dispatches/step, "
+                f"bubble {env.get('bubble_fraction')})")
+        elif env.get("silent_fallback"):
+            lines.append(
+                f"envelope: SILENT host fallback on a compiled-eligible "
+                f"mesh ({sched}) — no reason recorded; this is an "
+                f"engine-selection bug (exit 1)")
+        elif env.get("fallback_reason"):
+            lines.append(
+                f"envelope: host engine ({sched}, "
+                f"{env.get('dispatches_per_step')} dispatches/step) — "
+                f"compiled fallback because: {env['fallback_reason']}")
+        else:
+            lines.append(
+                f"envelope: host engine ({sched}, "
+                f"{env.get('dispatches_per_step')} dispatches/step; "
+                f"requested engine="
+                f"{env.get('requested_engine') or 'auto'}, mesh "
+                f"{'eligible' if env.get('compiled_mesh_eligible') else 'not eligible'} "
+                f"for compiled)")
     if doc.get("phases"):
         from flexflow_tpu.obs.attribution import format_phase_table
 
