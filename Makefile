@@ -29,6 +29,18 @@
 #   make bench-pipe — pipeline schedule/engine bench (host GPipe vs 1F1B
 #                     vs single-dispatch compiled): dispatch counts, step
 #                     time, peak activation bytes; one JSON line
+#   make serve-bench-smoke — continuous-batching serving guard
+#                   (tools/serve_bench.py --smoke): replays a seeded
+#                   open-arrival trace of heterogeneous generation
+#                   requests through the static-batch baseline AND the
+#                   continuous-batching engine (paged KV cache, split
+#                   prefill/decode executables); one JSON line with
+#                   tokens/s + p50/p99 TTFT/per-token for both; exits
+#                   non-zero unless continuous strictly wins on
+#                   tokens/s and the decode loop issued exactly one
+#                   dispatch per decode step; appends the
+#                   serving.tokens_per_s ledger record the sentinel
+#                   cohorts
 #   make obs-report — flight-recorder smoke (obs/): traced pipelined fit
 #                     + serving requests -> one JSON line with the trace
 #                     event counts (schema-validated), the metrics
@@ -63,7 +75,7 @@ CPU_MESH = JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8
 
 .PHONY: ci native native-check lint concurrency-lint pcg-lint audit \
         test dryrun bench bench-fit bench-pipe bench-pipe-smoke \
-        obs-report sentinel chaos explain
+        serve-bench serve-bench-smoke obs-report sentinel chaos explain
 
 # sentinel runs AFTER obs-report so a fresh checkout's first ci already
 # has ledger records to judge (first run: no baseline -> clean exit);
@@ -71,7 +83,7 @@ CPU_MESH = JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8
 # never the corpus the sentinel just judged); explain runs last and
 # narrates the newest of those records
 ci: native native-check lint concurrency-lint test dryrun obs-report \
-    bench-pipe-smoke sentinel chaos explain audit
+    bench-pipe-smoke serve-bench-smoke sentinel chaos explain audit
 
 lint:
 	$(PY) -c "from flexflow_tpu.analysis.hotpath_lint import main; \
@@ -114,6 +126,15 @@ bench-pipe:
 # falls back to the host engine (mirrors tests/test_pipe_bench.py)
 bench-pipe-smoke:
 	$(CPU_MESH) $(PY) tools/pipe_bench.py --smoke
+
+serve-bench:
+	$(CPU_MESH) $(PY) tools/serve_bench.py
+
+# continuous-batching guard: continuous must strictly beat static on
+# tokens/s over the seeded heterogeneous open-arrival trace, with one
+# decode dispatch per step regardless of active-request count
+serve-bench-smoke:
+	$(CPU_MESH) $(PY) tools/serve_bench.py --smoke
 
 obs-report:
 	$(CPU_MESH) $(PY) tools/obs_report.py

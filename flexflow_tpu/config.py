@@ -258,6 +258,28 @@ class FFConfig:
     # None = .ffcache/ckpt; fit(resume_from=...) overrides per call
     checkpoint_dir: Optional[str] = None
     checkpoint_max_to_keep: int = 3
+    # --- continuous-batching serving (serving/scheduler.py) ---------------
+    # decode-slot width of the single compiled decode program: all
+    # in-flight requests batch into these slots, one dispatch per decode
+    # step regardless of how many are live
+    serving_decode_slots: int = 4
+    # paged KV cache geometry (serving/kv_cache.py): tokens per block,
+    # and the pool size in blocks (0 = auto: decode_slots worst-case
+    # requests + the reserved null block). Admission reserves each
+    # request's worst case (prompt + max_new_tokens) and SHEDS when it
+    # cannot, so the pool bound is a hard memory bound.
+    serving_block_size: int = 16
+    serving_num_blocks: int = 0
+    # longest servable sequence (prompt + generated); 0 = the model's
+    # position-embedding capacity
+    serving_max_length: int = 0
+    # prefill pad-to-bucket ladder, comma-separated lengths (e.g.
+    # "16,64,256"); None = powers of two up to max_length. One compile
+    # per bucket, cached and counted.
+    serving_prefill_buckets: Optional[str] = None
+    # prompts prefilled between two decode steps while requests are
+    # active — bounds the decode stall a prompt burst can cause
+    serving_max_prefills_per_step: int = 1
     # numerics
     computation_mode: CompMode = CompMode.TRAINING
     # mixed precision: "bfloat16" runs activations/matmuls in bf16 on the
@@ -498,6 +520,18 @@ class FFConfig:
                 cfg.max_inflight_steps = int(_next())
             elif a == "--steps-per-dispatch":
                 cfg.steps_per_dispatch = int(_next())
+            elif a == "--serving-decode-slots":
+                cfg.serving_decode_slots = int(_next())
+            elif a == "--serving-block-size":
+                cfg.serving_block_size = int(_next())
+            elif a == "--serving-num-blocks":
+                cfg.serving_num_blocks = int(_next())
+            elif a == "--serving-max-length":
+                cfg.serving_max_length = int(_next())
+            elif a == "--serving-prefill-buckets":
+                cfg.serving_prefill_buckets = _next()
+            elif a == "--serving-max-prefills":
+                cfg.serving_max_prefills_per_step = int(_next())
             # unknown flags are ignored, matching the reference's tolerance
             i += 1
         return cfg

@@ -445,7 +445,13 @@ def record_serving(extra: Optional[Dict] = None,
             if m is not None:
                 rec["counters"][name] = m.to_json()
         for name in ("serving.queue_wait_s", "serving.e2e_s",
-                     "serving.infer_s", "serving.batch_size"):
+                     "serving.infer_s", "serving.batch_size",
+                     # continuous-batching generation series (process-
+                     # cumulative like the rest; the per-SESSION phase
+                     # percentiles ride in the scheduler's extra block)
+                     "serving.gen_queue_wait_s", "serving.prefill_s",
+                     "serving.decode_step_s", "serving.ttft_s",
+                     "serving.per_token_s", "serving.gen_e2e_s"):
             m = reg.get(name)
             if m is not None:
                 rec[name] = m.to_json()

@@ -30,6 +30,14 @@ from typing import Dict, List, Optional
 _QUANTILES = (0.5, 0.9, 0.99)
 
 
+def nearest_rank_percentile(xs, q: float) -> float:
+    """THE nearest-rank quantile used everywhere latency percentiles
+    are reported (Histogram reservoirs, the serving scheduler's session
+    phases, serve_bench) — one formula, so p99s from different surfaces
+    stay comparable. ``xs`` must be non-empty and sorted ascending."""
+    return xs[min(len(xs) - 1, max(0, int(round(q * (len(xs) - 1)))))]
+
+
 class Counter:
     """Monotonic counter."""
 
@@ -99,8 +107,7 @@ class Histogram:
         xs = sorted(self._recent)
         if not xs:
             return 0.0
-        i = min(len(xs) - 1, max(0, int(round(q * (len(xs) - 1)))))
-        return xs[i]
+        return nearest_rank_percentile(xs, q)
 
     @property
     def mean(self) -> float:

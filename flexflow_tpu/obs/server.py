@@ -148,14 +148,28 @@ class _Handler(BaseHTTPRequestHandler):
 def _healthz() -> Dict:
     import os
 
+    from .metrics import metrics_registry
     from .watchdog import watchdog
 
     wd = watchdog().stats()
-    return {
+    doc = {
         "ok": wd["dumps"] == 0,
         "pid": os.getpid(),
         "watchdog": wd,
     }
+    # continuous-batching serving snapshot, when the process serves
+    # generation (gauges exist once a scheduler has run): throughput +
+    # paged-pool occupancy — the SLO scrape ROADMAP item 1 names
+    reg = metrics_registry()
+    serving = {}
+    for key, metric in (("tokens_per_s", "serving.tokens_per_s"),
+                        ("kv_blocks_in_use", "serving.kv_blocks_in_use")):
+        m = reg.get(metric)
+        if m is not None:
+            serving[key] = m.to_json()
+    if serving:
+        doc["serving"] = serving
+    return doc
 
 
 def _runs_tail(n: int) -> Dict:

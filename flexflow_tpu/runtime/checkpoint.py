@@ -230,6 +230,8 @@ class CheckpointManager:
         restored = self._mgr.restore(step, args=ocp.args.StandardRestore(target))
         cm.params = restored["params"]
         cm.opt_state = restored["opt_state"]
+        cm.bump_params_version()  # serving cast caches re-derive from
+        #                           the restored weights
         cm.load_resume_state({"iteration": int(restored["iteration"])})
         if getattr(ffmodel, "pipelined", None) is not None:
             # pipelined training holds per-stage copies; re-seed them so the

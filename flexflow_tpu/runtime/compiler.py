@@ -89,6 +89,13 @@ class CompiledModel:
     # compiled executables when config.exec_telemetry="on" (filled by
     # FFModel.compile; None when the knob is off)
     exec_telemetry: Optional[Dict] = None
+    # params generation counter: bumped whenever the params tree is
+    # replaced or mutated in place (checkpoint restore, guard rollback,
+    # manual weight surgery via bump_params_version()). Derived caches —
+    # the serving decode path's bf16 cast copy — key on this instead of
+    # ``id(params)`` (ids are reusable after GC) or pinning the old tree
+    # alive.
+    params_version: int = 0
 
     # ---- public resume-state surface ---------------------------------- #
     # Checkpoint, recompile, playoff and ledger paths all need the step
@@ -102,6 +109,11 @@ class CompiledModel:
     @iteration.setter
     def iteration(self, value: int) -> None:
         self._iteration = int(value)
+
+    def bump_params_version(self) -> None:
+        """Call after replacing or in-place mutating ``params`` so
+        derived caches (the serving exec-params cast) re-derive."""
+        self.params_version += 1
 
     def resume_state(self) -> Dict:
         """The JSON-scalar resume view (checkpoint extra + ledger

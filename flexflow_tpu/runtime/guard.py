@@ -168,6 +168,8 @@ class TrainingGuard:
         cm = ff.compiled
         cm.params = self._to_device(self._snap[0])
         cm.opt_state = self._to_device(self._snap[1])
+        cm.bump_params_version()  # derived caches must not serve the
+        #                           diverged weights they were cast from
         self.restores_used += 1
         opt = cm.optimizer
         self._restores_total += 1

@@ -14,12 +14,24 @@ simplification), and the pieces that remain are the serving-specific ones:
 * :class:`InferenceEngine` — a multi-model registry with per-model dynamic
   micro-batching (native C++ queue discipline, native/src/batcher.cc) and
   worker threads;
+* the **generation engine**: :class:`GenerationInstance` /
+  :class:`ContinuousBatchingScheduler` — continuous (in-flight) batching
+  for autoregressive decoding over a :class:`PagedKVPool` (block/paged KV
+  cache with admission control), split bucketed-prefill / fixed-width
+  decode executables (:class:`PagedDecoder`), SLO-aware pickup and load
+  shedding;
 * ONNX / FFModel loading through the existing frontends.
 """
 
-from .engine import (DeadlineExceeded, InferenceEngine, InferenceRequest,
-                     ModelInstance, ShedError)
-from .generation import Generator
+from .engine import (DeadlineExceeded, GenerationInstance, InferenceEngine,
+                     InferenceRequest, ModelInstance, ShedError)
+from .errors import KVPoolExhausted
+from .generation import Generator, PagedDecoder, sample_next_token
+from .kv_cache import PagedKVPool
+from .scheduler import ContinuousBatchingScheduler, GenerationRequest
 
-__all__ = ["DeadlineExceeded", "InferenceEngine", "InferenceRequest",
-           "ModelInstance", "Generator", "ShedError"]
+__all__ = ["ContinuousBatchingScheduler", "DeadlineExceeded",
+           "GenerationInstance", "GenerationRequest", "Generator",
+           "InferenceEngine", "InferenceRequest", "KVPoolExhausted",
+           "ModelInstance", "PagedDecoder", "PagedKVPool", "ShedError",
+           "sample_next_token"]

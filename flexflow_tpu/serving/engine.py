@@ -64,15 +64,9 @@ _DISPATCH_RETRY = RetryPolicy(max_attempts=3, base_delay_s=0.002,
                               retry_on=(TransientFault,),
                               label="serving_dispatch", seed=0)
 
-
-class ShedError(RuntimeError):
-    """Request rejected at admission: the queue is past its bound or
-    the failure breaker is open. Callers should back off/re-route —
-    this is load shedding, not a server bug."""
-
-
-class DeadlineExceeded(RuntimeError):
-    """The request's deadline passed before a worker could serve it."""
+# degradation errors live in serving/errors.py (shared with the paged
+# KV pool + continuous scheduler); re-exported here for back-compat
+from .errors import DeadlineExceeded, ShedError  # noqa: E402
 
 
 class _PyBatcher:
@@ -240,6 +234,74 @@ class ModelInstance:
         return [np.asarray(logits)[:n]]
 
 
+class GenerationInstance:
+    """One continuous-batching autoregressive serving instance: a
+    compiled causal LM behind a
+    :class:`~flexflow_tpu.serving.scheduler.ContinuousBatchingScheduler`
+    (paged KV pool, split prefill/decode executables, in-flight
+    batching). The generation analog of :class:`ModelInstance` — same
+    lifecycle hooks (watchdog / obs server / faults arm here for a
+    serving-only process), same degradation machinery (admission bound,
+    deadlines, breaker, worker respawn), engine-registered under a name
+    like any model.
+
+    Serving knobs default from the model's config
+    (``config.serving_*``); keyword arguments override per instance.
+    """
+
+    def __init__(self, ff, name: str = "lm", **scheduler_kw):
+        if ff.compiled is None:
+            raise ValueError("compile() the FFModel before serving it")
+        from ..obs.server import configure_obs_server
+        from ..obs.watchdog import configure_watchdog
+        from ..runtime.faults import configure_faults
+        from .scheduler import ContinuousBatchingScheduler
+
+        configure_watchdog(ff.config)
+        configure_obs_server(ff.config)
+        configure_faults(ff.config)
+        cfg = ff.config
+        defaults = {
+            "decode_slots": getattr(cfg, "serving_decode_slots", 4),
+            "block_size": getattr(cfg, "serving_block_size", 16),
+            "max_prefills_per_step": getattr(
+                cfg, "serving_max_prefills_per_step", 1),
+        }
+        num_blocks = getattr(cfg, "serving_num_blocks", 0)
+        if num_blocks:
+            defaults["num_blocks"] = int(num_blocks)
+        max_length = getattr(cfg, "serving_max_length", 0)
+        if max_length:
+            defaults["max_length"] = int(max_length)
+        buckets = getattr(cfg, "serving_prefill_buckets", None)
+        if buckets:
+            defaults["prefill_buckets"] = [
+                int(x) for x in str(buckets).split(",") if x.strip()]
+        defaults.update(scheduler_kw)
+        self.name = name
+        self._ff = ff
+        self.scheduler = ContinuousBatchingScheduler(ff, name=name,
+                                                     **defaults)
+
+    @property
+    def decoder(self):
+        return self.scheduler.decoder
+
+    def generate_async(self, prompt, max_new_tokens: int, **kw) -> Future:
+        return self.scheduler.submit(prompt, max_new_tokens, **kw)
+
+    def generate(self, prompt, max_new_tokens: int,
+                 timeout: Optional[float] = 120.0, **kw) -> np.ndarray:
+        return self.scheduler.generate(prompt, max_new_tokens,
+                                       timeout=timeout, **kw)
+
+    def stats(self) -> Dict:
+        return self.scheduler.stats()
+
+    def stop(self) -> None:
+        self.scheduler.stop()
+
+
 class InferenceRequest:
     """A queued request: per-input rows + a Future for the result.
     ``t_enqueue`` anchors the request's span tree (obs/trace.py) and the
@@ -290,6 +352,9 @@ class InferenceEngine:
         self._batchers: Dict[str, object] = {}
         self._requests: Dict[str, Dict[int, InferenceRequest]] = {}
         self._workers: Dict[Tuple[str, int], threading.Thread] = {}
+        # continuous-batching generation instances, by name (the
+        # GenerationInstance path; each owns its scheduler thread)
+        self._generators: Dict[str, GenerationInstance] = {}
         # breaker state, per model (guarded by _mu like the registry):
         # consecutive failed batches + the monotonic instant the open
         # breaker closes again (inf = dead model, sheds until stop())
@@ -325,6 +390,11 @@ class InferenceEngine:
             self._register_locked(instance)
 
     def _register_locked(self, instance: ModelInstance) -> None:
+        if instance.name in self._generators:
+            raise ValueError(
+                f"{instance.name!r} already names a generation instance "
+                f"— one name, one model (classic and generation paths "
+                f"must never split an identity)")
         group = self._models.get(instance.name)
         if group:
             # full spec check: a different-topology instance silently
@@ -438,9 +508,56 @@ class InferenceEngine:
         return load_repository(self, path, builders=builders,
                                devices=devices)
 
+    def register_generator(self, ff, name: str = "lm",
+                           **kw) -> GenerationInstance:
+        """Register a continuous-batching generation instance under
+        ``name``. The engine's degradation knobs (admission bound,
+        default deadline, breaker, respawn budget) are the scheduler's
+        defaults — the GenerationInstance path rides the same
+        admission/breaker/respawn machinery as the classic path —
+        overridable per call (plus the serving_* geometry knobs)."""
+        defaults = dict(admission_limit=self.admission_limit,
+                        default_deadline_s=self.default_deadline_s,
+                        breaker_threshold=self.breaker_threshold,
+                        breaker_cooldown_s=self.breaker_cooldown_s,
+                        worker_retry_budget=self.worker_retry_budget)
+        defaults.update(kw)
+        inst = GenerationInstance(ff, name=name, **defaults)
+        with self._mu:
+            if name in self._models or name in self._generators:
+                raise ValueError(
+                    f"{name!r} already registered (generation instances "
+                    f"do not form groups — one scheduler owns the pool)")
+            self._generators[name] = inst
+        return inst
+
+    def generate_async(self, model: str, prompt,
+                       max_new_tokens: int, **kw) -> Future:
+        """Submit one generation request to a registered generator.
+        Same degradation contract as the scheduler's ``submit``:
+        :class:`ShedError` at admission (queue bound, open breaker,
+        pool-impossible worst case), :class:`DeadlineExceeded` on the
+        future when the deadline expires first."""
+        with self._mu:
+            inst = self._generators[model]
+        return inst.generate_async(prompt, max_new_tokens, **kw)
+
+    def generate(self, model: str, prompt, max_new_tokens: int,
+                 timeout: Optional[float] = 120.0, **kw) -> np.ndarray:
+        return self.generate_async(model, prompt, max_new_tokens,
+                                   **kw).result(timeout)
+
     def models(self) -> List[str]:
         with self._mu:
             return list(self._models)
+
+    def generators(self) -> List[str]:
+        with self._mu:
+            return list(self._generators)
+
+    def generator(self, name: str) -> GenerationInstance:
+        with self._mu:
+            return self._generators[name]
 
     def instances(self, name: str) -> List[ModelInstance]:
         with self._mu:
@@ -476,12 +593,19 @@ class InferenceEngine:
         with self._mu:
             workers = dict(self._workers)
             batchers = dict(self._batchers)
+            generators = dict(self._generators)
+            self._generators = {}
             # the first registered model's config gates the session's
             # ledger record (ledger="off" must disable ALL appends)
             _groups = next(iter(self._models.values()), None)
             ledger_cfg = _groups[0]._ff.config if _groups else None
             self._started = False
             self._stopping = True
+        # generation schedulers drain + stop first (joins OUTSIDE _mu;
+        # each writes its own continuous-engine serving record). They
+        # are one-shot: re-register to serve generation again.
+        for g in generators.values():
+            g.stop()
         for b in batchers.values():
             b.close()
         still_alive = set()
@@ -532,12 +656,15 @@ class InferenceEngine:
             self._breaker_open_until.clear()
             self._consec_failures.clear()
             self._stopping = False
-        # durable telemetry: one ledger record per serving session —
-        # request/batch/error counters + latency percentile snapshots
-        # (never raises; ledger.errors counts failures)
-        from ..obs.ledger import record_serving
+        # durable telemetry: one ledger record per CLASSIC serving
+        # session (generation sessions recorded their own continuous-
+        # engine records above) — request/batch/error counters + latency
+        # percentile snapshots (never raises; ledger.errors counts)
+        if batchers:
+            from ..obs.ledger import record_serving
 
-        record_serving({"models": sorted(batchers)}, config=ledger_cfg)
+            record_serving({"models": sorted(batchers)},
+                           config=ledger_cfg)
 
     # ---- request path ------------------------------------------------------
     def infer_async(self, model: str, inputs: Sequence[np.ndarray],

@@ -1,0 +1,26 @@
+"""Serving error taxonomy, shared across the engine and the continuous
+scheduler (a separate module so serving/kv_cache.py and
+serving/scheduler.py can raise the engine's degradation errors without
+importing serving/engine.py — no import cycle)."""
+
+from __future__ import annotations
+
+
+class ShedError(RuntimeError):
+    """Request rejected at admission: the queue is past its bound, the
+    failure breaker is open, or (continuous batching) the paged KV pool
+    cannot hold the request's worst case. Callers should back
+    off/re-route — this is load shedding, not a server bug."""
+
+
+class DeadlineExceeded(RuntimeError):
+    """The request's deadline passed before it could be served."""
+
+
+class KVPoolExhausted(ShedError):
+    """The paged KV pool cannot reserve the request's worst-case block
+    count. A :class:`ShedError` subtype: admission control sheds instead
+    of letting the decode loop OOM mid-request."""
+
+
+__all__ = ["DeadlineExceeded", "KVPoolExhausted", "ShedError"]

@@ -16,6 +16,28 @@ piece on top of the serving engine. TPU-native design:
 * sampling (greedy / temperature) happens on host between steps, like
   every production TPU decode loop.
 
+Two cache layouts share the graph walk:
+
+* :class:`Generator` — the dense rectangle: ``(B, max_length, H, D)``
+  per op, one fixed batch decoded in lockstep (offline/batch use, and
+  the bit-compared reference for the paged path);
+* :class:`PagedDecoder` — the continuous-batching layout: a
+  :class:`~flexflow_tpu.serving.kv_cache.PagedKVPool` of
+  ``(num_blocks, block_size, H, D)`` arenas plus per-request block
+  tables. Decode attention gathers K/V **through the block table**; the
+  compiled decode program's shape depends only on (decode slots, pool
+  geometry), so one program serves every in-flight request mix, and
+  prompts run through a separate **bucketed prefill executable**
+  (pad-to-bucket ladder, per-bucket compile cached and counted) whose
+  K/V is scattered into the pool in the same dispatch.
+
+The two layouts are bit-identical per request (tests/test_continuous_
+batching.py asserts it per zoo causal-LM model): the paged gather
+reconstructs exactly the dense cache rows for written positions, and
+every unwritten/foreign lane is masked to -1e30 before softmax, where
+``exp`` underflows to exactly 0.0 — adding exact zeros never perturbs
+the valid lanes' accumulation.
+
 Works for any builder graph whose attention ops are causal
 self-attention (models/gpt.py; an imported HF decoder fits the same
 contract).
@@ -24,7 +46,8 @@ contract).
 from __future__ import annotations
 
 import math
-from typing import Dict, Optional, Tuple
+import weakref
+from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
@@ -33,6 +56,7 @@ import jax.numpy as jnp
 
 from ..ffconst import OpType
 from ..core.op import LowerCtx
+from .kv_cache import NULL_BLOCK, PagedKVPool
 
 
 def _attn_with_cache(op, weights, x, kcache, vcache, offset):
@@ -66,21 +90,194 @@ def _attn_with_cache(op, weights, x, kcache, vcache, offset):
     return out, kcache, vcache
 
 
-class Generator:
-    """KV-cache incremental decoding for a compiled causal LM.
+def _attn_with_paged_cache(op, weights, x, kpool, vpool, tables, seq_lens):
+    """One-token causal self-attention through a paged KV pool.
 
-    ``cm``: a CompiledModel whose graph takes (tokens, positions) int32
-    inputs and produces (B, S, vocab) logits, with causal self-attention
-    ops (models/gpt.py's contract).
+    ``x``: (n, 1, E) — one new token per decode slot. ``kpool``/``vpool``:
+    (num_blocks, block_size, H, D) arenas. ``tables``: (n, max_blocks)
+    int32 per-slot block tables. ``seq_lens``: (n,) int32 — tokens
+    already cached per slot, i.e. the new token's absolute position.
+
+    Writes the new K/V at each slot's position (inactive slots, whose
+    tables are all :data:`~flexflow_tpu.serving.kv_cache.NULL_BLOCK`,
+    write into the null block — harmless by construction), then gathers
+    each slot's logical ``(max_blocks*block_size)`` cache view through
+    its table and masks by position exactly like the dense path — so a
+    slot's output is bit-identical to the dense cache decode at the same
+    position.
+    """
+    qh = jnp.einsum("bse,ehd->bshd", x, weights["wq"])
+    kh = jnp.einsum("bse,ehd->bshd", x, weights["wk"])
+    vh = jnp.einsum("bse,ehd->bshd", x, weights["wv"])
+    if op.use_bias:
+        qh = qh + weights["bq"]
+        kh = kh + weights["bk"]
+        vh = vh + weights["bv"]
+    nb, bs, heads, hdim = kpool.shape
+    n = x.shape[0]
+    blk = tables[jnp.arange(n), seq_lens // bs]                 # (n,)
+    flat = blk * bs + seq_lens % bs                             # (n,)
+    kflat = kpool.reshape(nb * bs, heads, hdim).at[flat].set(kh[:, 0])
+    vflat = vpool.reshape(nb * bs, heads, hdim).at[flat].set(vh[:, 0])
+    # gather each slot's logical view: (n, MB, BS, H, D) -> (n, L, H, D)
+    k = kflat.reshape(nb, bs, heads, hdim)[tables].reshape(
+        n, -1, heads, hdim)
+    v = vflat.reshape(nb, bs, heads, hdim)[tables].reshape(
+        n, -1, heads, hdim)
+    scale = 1.0 / math.sqrt(op.head_dim)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", qh, k) * scale       # (n,H,1,L)
+    kpos = jax.lax.iota(jnp.int32, k.shape[1])                  # (L,)
+    mask = kpos[None, :] <= seq_lens[:, None]                   # (n, L)
+    scores = jnp.where(mask[:, None, None, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    ctxv = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    out = jnp.einsum("bqhd,hde->bqe", ctxv, weights["wo"])
+    if op.use_bias:
+        out = out + weights["bo"]
+    return (out, kflat.reshape(nb, bs, heads, hdim),
+            vflat.reshape(nb, bs, heads, hdim))
+
+
+def sample_next_token(row_logits: np.ndarray, temperature: float,
+                      rng: Optional[np.random.Generator]) -> int:
+    """One host-side sampling decision for one request — THE sampling
+    function, shared by the dense generator and the continuous
+    scheduler so batching strategy can never change tokens: greedy
+    (temperature=0) argmax, else a softmax draw from ``rng``."""
+    if temperature > 0:
+        p = np.exp((row_logits - row_logits.max()) / temperature)
+        p /= p.sum()
+        return int(rng.choice(row_logits.shape[-1], p=p))
+    return int(row_logits.argmax(-1))
+
+
+class _ExecParamsCache:
+    """Cast-once cache for the decode compute dtype (bf16: cast per
+    params VERSION, not per token inside the jitted step).
+
+    Keyed on ``(cm.params_version, per-leaf identity via weakrefs)`` —
+    deliberately NOT on ``id(params)`` with the reference dropped
+    (``id`` values are reusable after GC: a freed-and-reallocated params
+    tree could silently reuse a stale cast copy) and NOT by pinning the
+    previous tree alive (a swapped-out params tree must stay
+    collectable). The weakref leg compares EVERY leaf, so whole-tree
+    replacement AND partial weight surgery (swapping one layer's arrays
+    in place) both re-derive without a bump; the version leg
+    (``bump_params_version()``, bumped by checkpoint restore and guard
+    rollback) is the explicit invalidation for anything identity cannot
+    see.
     """
 
-    def __init__(self, ff, max_length: int, batch_size: Optional[int] = None):
+    __slots__ = ("_version", "_leaf_refs", "_cast")
+
+    def __init__(self):
+        self.invalidate()
+
+    def invalidate(self) -> None:
+        self._version = None
+        self._leaf_refs = None
+        self._cast = None
+
+    def get(self, cm, compute_dtype):
+        params = cm.params
+        if compute_dtype is None:
+            return params
+        version = getattr(cm, "params_version", 0)
+        leaves = jax.tree_util.tree_leaves(params)
+        if (self._cast is not None and self._version == version
+                and self._leaf_refs is not None
+                and len(self._leaf_refs) == len(leaves)
+                and all(r() is leaf for r, leaf
+                        in zip(self._leaf_refs, leaves))):
+            return self._cast
+        cast = jax.tree_util.tree_map(
+            lambda v: v.astype(compute_dtype)
+            if jnp.issubdtype(v.dtype, jnp.floating) else v, params)
+        self._version = version
+        self._leaf_refs = tuple(weakref.ref(leaf) for leaf in leaves)
+        self._cast = cast
+        return cast
+
+
+def _audit_serving_program(program_name: str, jitted, sds_args, cfg):
+    """Shared program-audit + exec-telemetry gate for a serving
+    executable (the dense decode step, the paged decode step): returns
+    ``(audit_report, exec_telemetry)`` per the config's
+    ``audit_programs`` / ``exec_telemetry`` modes, or (None, None) when
+    both are off. Never masks the decode path: a trace failure is
+    recorded as an AUD000 finding + an explicit telemetry
+    ``unavailable`` reason instead of raising here."""
+    mode = getattr(cfg, "audit_programs", "off") or "off"
+    from ..obs.exec_telemetry import telemetry_mode
+
+    tmode = telemetry_mode(cfg)
+    if mode == "off" and tmode == "off":
+        return None, None
+    from ..analysis.program_audit import audit_traced
+
+    audit_report = exec_telemetry = None
+    try:
+        traced = jitted.trace(*sds_args)
+    except Exception as e:  # noqa: BLE001 — audit must not mask decode
+        # AUD000 contract: record the trace failure instead of leaving
+        # audit_report empty-but-clean-looking; the first real decode
+        # surfaces the true error with full context
+        from ..analysis.findings import ValidationReport
+
+        report = ValidationReport(source="serving", tag="audit")
+        report.programs = {program_name: {"trace_failed": True}}
+        report.add(
+            "AUD000",
+            f"program {program_name!r} could not be traced for "
+            f"audit: {type(e).__name__}: {e}",
+            severity="warning")
+        if tmode == "on":
+            # the telemetry contract: every failure mode is an explicit
+            # unavailable reason, never a bare None
+            exec_telemetry = {"programs": {program_name: {
+                "unavailable":
+                    f"trace failed: {type(e).__name__}: {e}"}}}
+        if mode != "off":
+            audit_report = report
+            report.handle(mode)
+        return audit_report, exec_telemetry
+    report = audit_traced(program_name, traced, config=cfg,
+                          source="serving")
+    from ..obs.metrics import metrics_registry
+
+    if mode != "off":
+        audit_report = report
+        reg = metrics_registry()
+        reg.counter("audit.programs").inc()
+        reg.counter("audit.errors").inc(len(report.errors))
+        reg.counter("audit.warnings").inc(len(report.warnings))
+    if tmode == "on":
+        # telemetry reconciled against the static peak-live estimate
+        # the audit walk just produced
+        from ..obs.exec_telemetry import collect_one
+
+        static_peak = (report.programs.get(program_name)
+                       or {}).get("peak_live_bytes")
+        exec_telemetry = collect_one(
+            program_name, traced, config=cfg, static_peak=static_peak,
+            allow=getattr(cfg, "exec_mem_allow", None))
+    if mode != "off":
+        audit_report.handle(mode)
+    return audit_report, exec_telemetry
+
+
+class _DecodeGraph:
+    """The shared compiled-graph contract both cache layouts walk:
+    validated causal self-attention ops, the (tokens, positions) input
+    binding, the position-embedding capacity bound, and the exec-params
+    cast cache."""
+
+    def __init__(self, ff, max_length: int):
         cm = ff.compiled
         if cm is None:
             raise ValueError("compile() the model before generating")
         self._cm = cm
         self.max_length = int(max_length)
-        self.batch_size = batch_size or cm.input_tensors[0].dims[0]
         self._attn_ops = [op for op in cm.ops
                           if op.op_type is OpType.MULTIHEAD_ATTENTION]
         for op in self._attn_ops:
@@ -101,8 +298,56 @@ class Generator:
                     raise ValueError(
                         f"max_length {self.max_length} exceeds the position "
                         f"embedding capacity {cap} ({op.name})")
+        self._params_cache = _ExecParamsCache()
+
+    def _compute_dtype(self):
+        from ..runtime.compiler import _resolve_compute_dtype
+
+        return _resolve_compute_dtype(self._cm.config.compute_dtype)
+
+    def _exec_params(self):
+        """Params in the decode compute dtype (cast once per params
+        version — see :class:`_ExecParamsCache`)."""
+        return self._params_cache.get(self._cm, self._compute_dtype())
+
+    def invalidate_params_cache(self) -> None:
+        """Drop the cast copy after mutating ``cm.params`` leaves in
+        place (replacing the tree, or bumping ``cm.params_version``,
+        invalidates automatically)."""
+        self._params_cache.invalidate()
+
+    def _forward_block(self, params, acts, attn):
+        """Walk the op graph over the activations in ``acts``; ``attn``
+        handles each causal self-attention op (cache layout specific).
+        Returns the (B, S, vocab) float32 logits."""
+        ctx = LowerCtx(mesh=None, training=False, aux_losses=[],
+                       compute_dtype=None)
+        for op in self._cm.ops:
+            ins = [acts[t.tensor_id] for t in op.layer.inputs]
+            p = params.get(op.name, {})
+            if op.op_type is OpType.MULTIHEAD_ATTENTION:
+                outs = [attn(op, p, ins[0])]
+            else:
+                outs = op.forward(ctx, ins, p)
+            for out, t in zip(outs, op.layer.outputs):
+                acts[t.tensor_id] = out
+        logits = acts[self._cm.logits_tensor.tensor_id]
+        return logits.astype(jnp.float32)
+
+
+class Generator(_DecodeGraph):
+    """KV-cache incremental decoding for a compiled causal LM.
+
+    ``cm``: a CompiledModel whose graph takes (tokens, positions) int32
+    inputs and produces (B, S, vocab) logits, with causal self-attention
+    ops (models/gpt.py's contract).
+    """
+
+    def __init__(self, ff, max_length: int, batch_size: Optional[int] = None):
+        super().__init__(ff, max_length)
+        cm = self._cm
+        self.batch_size = batch_size or cm.input_tensors[0].dims[0]
         self._step = jax.jit(self._block_step, donate_argnums=(2,))
-        self._exec_params_cache = None  # (id(params), cast copy)
         # program-audit gate (analysis/program_audit.py) over the decode
         # step at its steady-state (B, 1) shape. The KV cache is donated
         # (exact aval alias with the new cache); `params` has no
@@ -116,14 +361,6 @@ class Generator:
 
     def _maybe_audit(self) -> None:
         cfg = self._cm.config
-        mode = getattr(cfg, "audit_programs", "off") or "off"
-        from ..obs.exec_telemetry import telemetry_mode
-
-        tmode = telemetry_mode(cfg)
-        if mode == "off" and tmode == "off":
-            return
-        from ..analysis.program_audit import audit_traced
-
         cdt = self._compute_dtype()
         cache_dt = cdt or jnp.float32
 
@@ -140,79 +377,11 @@ class Generator:
                  op.head_dim), cache_dt) for _ in range(2))
             for op in self._attn_ops}
         offset_sds = jax.ShapeDtypeStruct((), jnp.int32)
-        try:
-            traced = self._step.trace(params_sds, tokens_sds, cache_sds,
-                                      offset_sds)
-        except Exception as e:  # noqa: BLE001 — audit must not mask decode
-            # AUD000 contract: record the trace failure instead of
-            # leaving audit_report empty-but-clean-looking; the first
-            # real decode surfaces the true error with full context
-            from ..analysis.findings import ValidationReport
-
-            report = ValidationReport(source="serving", tag="audit")
-            report.programs = {"serving.decode_step":
-                               {"trace_failed": True}}
-            report.add(
-                "AUD000",
-                f"program 'serving.decode_step' could not be traced for "
-                f"audit: {type(e).__name__}: {e}",
-                severity="warning")
-            if tmode == "on":
-                # the telemetry contract: every failure mode is an
-                # explicit unavailable reason, never a bare None
-                self.exec_telemetry = {"programs": {
-                    "serving.decode_step": {"unavailable":
-                        f"trace failed: {type(e).__name__}: {e}"}}}
-            if mode != "off":
-                self.audit_report = report
-                report.handle(mode)
-            return
-        report = audit_traced(
-            "serving.decode_step", traced, config=cfg, source="serving")
-        from ..obs.metrics import metrics_registry
-
-        if mode != "off":
-            self.audit_report = report
-            reg = metrics_registry()
-            reg.counter("audit.programs").inc()
-            reg.counter("audit.errors").inc(len(report.errors))
-            reg.counter("audit.warnings").inc(len(report.warnings))
-        if tmode == "on":
-            # decode-step telemetry, reconciled against the static
-            # peak-live estimate the audit walk just produced
-            from ..obs.exec_telemetry import collect_one
-
-            static_peak = (report.programs.get("serving.decode_step")
-                           or {}).get("peak_live_bytes")
-            self.exec_telemetry = collect_one(
-                "serving.decode_step", traced, config=cfg,
-                static_peak=static_peak,
-                allow=getattr(cfg, "exec_mem_allow", None))
-        if mode != "off":
-            self.audit_report.handle(mode)
-
-    def _exec_params(self):
-        """Params in the decode compute dtype. bf16: cast ONCE per params
-        version (not per token inside the jitted step)."""
-        params = self._cm.params
-        cdt = self._compute_dtype()
-        if cdt is None:
-            return params
-        cached = self._exec_params_cache
-        if cached is not None and cached[0] is params:
-            return cached[1]
-        cast = jax.tree_util.tree_map(
-            lambda v: v.astype(cdt)
-            if jnp.issubdtype(v.dtype, jnp.floating) else v, params)
-        self._exec_params_cache = (params, cast)
-        return cast
+        self.audit_report, self.exec_telemetry = _audit_serving_program(
+            "serving.decode_step", self._step,
+            (params_sds, tokens_sds, cache_sds, offset_sds), cfg)
 
     # ---- cache ------------------------------------------------------------
-    def _compute_dtype(self):
-        from ..runtime.compiler import _resolve_compute_dtype
-
-        return _resolve_compute_dtype(self._cm.config.compute_dtype)
-
     def init_cache(self) -> Dict[str, Tuple[jnp.ndarray, jnp.ndarray]]:
         cache = {}
         dt = self._compute_dtype() or jnp.float32
@@ -227,31 +396,37 @@ class Generator:
         b, s_blk = tokens.shape
         positions = offset + jax.lax.iota(jnp.int32, s_blk)[None, :]
         positions = jnp.broadcast_to(positions, (b, s_blk))
-        ctx = LowerCtx(mesh=None, training=False, aux_losses=[],
-                       compute_dtype=None)
         acts = {self._token_id.tensor_id: tokens,
                 self._pos_id.tensor_id: positions}
         new_cache = dict(cache)
-        for op in self._cm.ops:
-            ins = [acts[t.tensor_id] for t in op.layer.inputs]
-            p = params.get(op.name, {})
-            if op.op_type is OpType.MULTIHEAD_ATTENTION:
-                k, v = new_cache[op.name]
-                out, k, v = _attn_with_cache(op, p, ins[0], k, v, offset)
-                new_cache[op.name] = (k, v)
-                outs = [out]
-            else:
-                outs = op.forward(ctx, ins, p)
-            for out, t in zip(outs, op.layer.outputs):
-                acts[t.tensor_id] = out
-        logits = acts[self._cm.logits_tensor.tensor_id]
-        return logits.astype(jnp.float32), new_cache
+
+        def attn(op, p, x):
+            k, v = new_cache[op.name]
+            out, k, v = _attn_with_cache(op, p, x, k, v, offset)
+            new_cache[op.name] = (k, v)
+            return out
+
+        logits = self._forward_block(params, acts, attn)
+        return logits, new_cache
 
     # ---- public API --------------------------------------------------------
     def prefill(self, prompt_ids: np.ndarray, cache=None, offset: int = 0):
         """Run a prompt block starting at absolute position ``offset``
         (pass the previous round's end position + its cache to continue a
-        conversation). Returns (last-token logits, cache, end position)."""
+        conversation). Accepts partial batches (rows < the compiled
+        width are padded and stripped of meaning — their logits are
+        junk, callers mask them). Returns (last-token logits, cache,
+        end position)."""
+        prompt_ids = np.asarray(prompt_ids, np.int32)
+        b = prompt_ids.shape[0]
+        if b > self.batch_size:
+            raise ValueError(
+                f"{b} prompts > compiled batch width {self.batch_size}")
+        if b < self.batch_size:
+            prompt_ids = np.concatenate([
+                prompt_ids,
+                np.zeros((self.batch_size - b,) + prompt_ids.shape[1:],
+                         np.int32)], axis=0)
         prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
         end = offset + prompt_ids.shape[1]
         if end > self.max_length:
@@ -275,39 +450,276 @@ class Generator:
         return logits[:, -1, :], cache, end
 
     def generate(self, prompt_ids: np.ndarray, max_new_tokens: int,
-                 temperature: float = 0.0, seed: int = 0,
+                 temperature: float = 0.0,
+                 seed: Union[int, Sequence[int]] = 0,
                  eos_id: Optional[int] = None) -> np.ndarray:
         """Greedy (temperature=0) or sampled decoding. ``prompt_ids``:
-        (B, S_prompt) int32. Returns (B, S_prompt + new) token ids."""
+        (b, S_prompt) int32 with b ≤ the compiled batch width — partial
+        batches are first-class: rows beyond b are inactive padding,
+        never sampled (mask-aware), so a ragged arrival never needs
+        filler requests. ``seed``: one int (one shared stream, drawn in
+        row order — the historical semantics) or a length-b sequence of
+        per-row seeds (each row draws from its own stream, so results
+        are independent of co-batched rows). Returns
+        (b, S_prompt + new) token ids."""
         prompt_ids = np.asarray(prompt_ids, np.int32)
         b, s0 = prompt_ids.shape
+        if b > self.batch_size:
+            raise ValueError(
+                f"{b} prompts > compiled batch width {self.batch_size}")
         if s0 + max_new_tokens > self.max_length:
             raise ValueError(
                 f"{s0} prompt + {max_new_tokens} new > max_length "
                 f"{self.max_length}")
+        if isinstance(seed, (int, np.integer)):
+            shared = np.random.default_rng(int(seed))
+            rngs = [shared] * b
+        else:
+            if len(seed) != b:
+                raise ValueError(
+                    f"per-row seeds: got {len(seed)} for {b} rows")
+            rngs = [np.random.default_rng(int(s)) for s in seed]
         logits, cache, pos = self.prefill(prompt_ids)
         exec_params = self._exec_params()
-        rng = np.random.default_rng(seed)
         out = [prompt_ids]
         done = np.zeros(b, bool)
         for i in range(max_new_tokens):
-            lg = np.asarray(logits)
-            if temperature > 0:
-                p = np.exp((lg - lg.max(-1, keepdims=True)) / temperature)
-                p /= p.sum(-1, keepdims=True)
-                nxt = np.array([rng.choice(lg.shape[-1], p=p[j])
-                                for j in range(b)], np.int32)
-            else:
-                nxt = lg.argmax(-1).astype(np.int32)
+            lg = np.asarray(logits)[:b]  # inactive padding rows never sampled
+            nxt = np.array([sample_next_token(lg[j], temperature, rngs[j])
+                            for j in range(b)], np.int32)
             if eos_id is not None:
                 nxt = np.where(done, eos_id, nxt)
                 done |= nxt == eos_id
             out.append(nxt[:, None])
             if i == max_new_tokens - 1 or (eos_id is not None and done.all()):
                 break  # last token already sampled: skip the unused step
+            step_tokens = np.zeros((self.batch_size, 1), np.int32)
+            step_tokens[:b, 0] = nxt
             step_logits, cache = self._step(
-                exec_params, jnp.asarray(nxt[:, None]), cache,
+                exec_params, jnp.asarray(step_tokens), cache,
                 jnp.int32(pos))
             logits = step_logits[:, -1, :]
             pos += 1
         return np.concatenate(out, axis=1)
+
+
+def default_prefill_buckets(max_length: int,
+                            smallest: int = 8) -> List[int]:
+    """The pad-to-bucket ladder: powers of two from ``smallest``,
+    capped by a final bucket of exactly ``max_length``."""
+    out: List[int] = []
+    b = smallest
+    while b < max_length:
+        out.append(b)
+        b *= 2
+    out.append(max_length)
+    return out
+
+
+class PagedDecoder(_DecodeGraph):
+    """Split prefill/decode executables over a paged KV pool — the
+    continuous-batching compute core (the scheduling loop lives in
+    serving/scheduler.py).
+
+    * ``decode_slots``: the fixed decode batch width — ONE jitted decode
+      program batches every active request (inactive slots ride along
+      masked); the program's shape never depends on the live mix, so the
+      decode loop issues one dispatch per step regardless of
+      active-request count.
+    * the pool (``num_blocks`` × ``block_size`` per attention op) is
+      donated through both executables; admission reserves each
+      request's worst case so the decode can never outgrow it.
+    * prompts run through per-bucket prefill executables (pad-to-bucket
+      ladder; compiles cached and counted on
+      ``serving.prefill_bucket_compiles``) that compute the prompt's
+      K/V, scatter it into the pool through the block table, and return
+      the full-prompt logits — one dispatch per prefill.
+    """
+
+    def __init__(self, ff, max_length: int, *, decode_slots: int = 4,
+                 block_size: int = 16, num_blocks: Optional[int] = None,
+                 prefill_buckets: Optional[Sequence[int]] = None):
+        super().__init__(ff, max_length)
+        if decode_slots < 1:
+            raise ValueError(f"decode_slots {decode_slots} < 1")
+        self.decode_slots = int(decode_slots)
+        self.block_size = int(block_size)
+        self.max_blocks_per_request = max(
+            1, math.ceil(self.max_length / self.block_size))
+        if num_blocks is None:
+            # auto: every decode slot can hold one worst-case request,
+            # plus the reserved null block
+            num_blocks = (self.decode_slots * self.max_blocks_per_request
+                          + 1)
+        dt = self._compute_dtype() or jnp.float32
+        self.pool = PagedKVPool(
+            {op.name: (op.num_heads, op.head_dim)
+             for op in self._attn_ops},
+            num_blocks=int(num_blocks), block_size=self.block_size,
+            max_blocks_per_request=self.max_blocks_per_request, dtype=dt)
+        if prefill_buckets is None:
+            prefill_buckets = default_prefill_buckets(self.max_length)
+        self.prefill_buckets = sorted(
+            {min(int(bkt), self.max_length) for bkt in prefill_buckets})
+        if self.prefill_buckets[-1] < self.max_length:
+            self.prefill_buckets.append(self.max_length)
+        self._decode = jax.jit(self._decode_step, donate_argnums=(2,))
+        self._prefill_fns: Dict[int, object] = {}
+        self.decode_dispatches = 0
+        self.decode_steps = 0
+        self.audit_report = None
+        self.exec_telemetry = None
+        self._maybe_audit()
+
+    # ---- compiled programs -------------------------------------------------
+    def _decode_step(self, params, tokens, pool, tables, seq_lens):
+        """One decode step for all slots: tokens (slots, 1) int32, pool
+        {op: (k, v)} donated, tables (slots, MB) int32, seq_lens (slots,)
+        int32. Returns ((slots, vocab) float32 logits, new pool)."""
+        positions = seq_lens[:, None]                           # (slots, 1)
+        acts = {self._token_id.tensor_id: tokens,
+                self._pos_id.tensor_id: positions}
+        new_pool = dict(pool)
+
+        def attn(op, p, x):
+            k, v = new_pool[op.name]
+            out, k, v = _attn_with_paged_cache(op, p, x, k, v, tables,
+                                               seq_lens)
+            new_pool[op.name] = (k, v)
+            return out
+
+        logits = self._forward_block(params, acts, attn)
+        return logits[:, -1, :], new_pool
+
+    def _prefill_step(self, params, tokens, pool, table, length):
+        """Bucketed prefill for ONE request: tokens (1, Sb) int32 (the
+        prompt padded to the bucket), pool donated, table (MB,) int32,
+        length scalar int32 (the true prompt length). Computes the
+        prompt's K/V with ordinary dense causal attention over the
+        bucket (padding keys are causally masked for every valid query
+        row), scatters positions [0, length) into the pool through the
+        block table (padding rows write into the null block), and
+        returns ((1, Sb, vocab) float32 logits, new pool)."""
+        b, s_blk = tokens.shape
+        positions = jnp.broadcast_to(
+            jax.lax.iota(jnp.int32, s_blk)[None, :], (b, s_blk))
+        acts = {self._token_id.tensor_id: tokens,
+                self._pos_id.tensor_id: positions}
+        new_pool = dict(pool)
+        bs = self.block_size
+
+        def attn(op, p, x):
+            qh = jnp.einsum("bse,ehd->bshd", x, p["wq"])
+            kh = jnp.einsum("bse,ehd->bshd", x, p["wk"])
+            vh = jnp.einsum("bse,ehd->bshd", x, p["wv"])
+            if op.use_bias:
+                qh = qh + p["bq"]
+                kh = kh + p["bk"]
+                vh = vh + p["bv"]
+            scale = 1.0 / math.sqrt(op.head_dim)
+            scores = jnp.einsum("bqhd,bkhd->bhqk", qh, kh) * scale
+            pos = jax.lax.iota(jnp.int32, s_blk)
+            mask = pos[None, :] <= pos[:, None]                 # causal
+            scores = jnp.where(mask[None, None, :, :], scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1)
+            ctxv = jnp.einsum("bhqk,bkhd->bqhd", probs, vh)
+            out = jnp.einsum("bqhd,hde->bqe", ctxv, p["wo"])
+            if op.use_bias:
+                out = out + p["bo"]
+            # scatter the prompt K/V into the pool: position p lands in
+            # block table[p // bs] at offset p % bs; padding rows
+            # (p >= length) are redirected into the null block
+            kpool, vpool = new_pool[op.name]
+            nb = kpool.shape[0]
+            blk = table[pos // bs]                              # (Sb,)
+            flat = jnp.where(pos < length, blk * bs + pos % bs,
+                             NULL_BLOCK * bs)
+            heads, hdim = kh.shape[2], kh.shape[3]
+            kflat = kpool.reshape(nb * bs, heads, hdim).at[flat].set(
+                kh[0])
+            vflat = vpool.reshape(nb * bs, heads, hdim).at[flat].set(
+                vh[0])
+            new_pool[op.name] = (kflat.reshape(kpool.shape),
+                                 vflat.reshape(vpool.shape))
+            return out
+
+        logits = self._forward_block(params, acts, attn)
+        return logits, new_pool
+
+    def _prefill_fn(self, bucket: int):
+        fn = self._prefill_fns.get(bucket)
+        if fn is None:
+            fn = jax.jit(self._prefill_step, donate_argnums=(2,))
+            self._prefill_fns[bucket] = fn
+            from ..obs.metrics import metrics_registry
+
+            metrics_registry().counter(
+                "serving.prefill_bucket_compiles").inc()
+        return fn
+
+    def bucket_for(self, prompt_len: int) -> int:
+        for b in self.prefill_buckets:
+            if b >= prompt_len:
+                return b
+        raise ValueError(
+            f"prompt length {prompt_len} exceeds the largest prefill "
+            f"bucket {self.prefill_buckets[-1]}")
+
+    # ---- audit -------------------------------------------------------------
+    def _maybe_audit(self) -> None:
+        cfg = self._cm.config
+        cdt = self._compute_dtype()
+        cache_dt = cdt or jnp.float32
+
+        def _sds(a):
+            dt = (cache_dt if cdt is not None
+                  and jnp.issubdtype(a.dtype, jnp.floating) else a.dtype)
+            return jax.ShapeDtypeStruct(a.shape, dt)
+
+        params_sds = jax.tree_util.tree_map(_sds, self._cm.params)
+        tokens_sds = jax.ShapeDtypeStruct((self.decode_slots, 1), jnp.int32)
+        pool_sds = {name: tuple(jax.ShapeDtypeStruct(k.shape, k.dtype)
+                                for k in kv)
+                    for name, kv in self.pool.kv.items()}
+        tables_sds = jax.ShapeDtypeStruct(
+            (self.decode_slots, self.max_blocks_per_request), jnp.int32)
+        lens_sds = jax.ShapeDtypeStruct((self.decode_slots,), jnp.int32)
+        self.audit_report, self.exec_telemetry = _audit_serving_program(
+            "serving.paged_decode_step", self._decode,
+            (params_sds, tokens_sds, pool_sds, tables_sds, lens_sds), cfg)
+
+    # ---- host API (the scheduler's surface) --------------------------------
+    def prefill(self, prompt: np.ndarray, table: np.ndarray) -> np.ndarray:
+        """Prefill one request through its bucket executable, scattering
+        its K/V into the pool. ``prompt``: (S,) int32; ``table``: the
+        request's block table. Returns the last-prompt-position logits
+        (vocab,) float32."""
+        prompt = np.asarray(prompt, np.int32).ravel()
+        n = prompt.shape[0]
+        if n < 1:
+            raise ValueError("empty prompt")
+        if n > self.max_length:
+            raise ValueError(
+                f"prompt {n} tokens > max_length {self.max_length}")
+        bucket = self.bucket_for(n)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :n] = prompt
+        fn = self._prefill_fn(bucket)
+        logits, self.pool.kv = fn(
+            self._exec_params(), jnp.asarray(padded), self.pool.kv,
+            jnp.asarray(table, jnp.int32), jnp.int32(n))
+        return np.asarray(logits)[0, n - 1]
+
+    def decode(self, tokens: np.ndarray, tables: np.ndarray,
+               seq_lens: np.ndarray) -> np.ndarray:
+        """One decode step for all slots (ONE dispatch regardless of how
+        many are active). Returns (slots, vocab) float32 logits."""
+        self.decode_steps += 1
+        self.decode_dispatches += 1
+        logits, self.pool.kv = self._decode(
+            self._exec_params(),
+            jnp.asarray(np.asarray(tokens, np.int32)[:, None]),
+            self.pool.kv,
+            jnp.asarray(np.asarray(tables, np.int32)),
+            jnp.asarray(np.asarray(seq_lens, np.int32)))
+        return np.asarray(logits)

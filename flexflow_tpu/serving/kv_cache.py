@@ -1,0 +1,186 @@
+"""Paged (block) KV cache pool for continuous-batching generation.
+
+The dense generator (:class:`serving.generation.Generator`) reserves a
+``(B, max_length, H, D)`` rectangle per attention op — every request
+pays the worst-case sequence length for its whole lifetime, so the
+number of co-resident requests is fixed at compile time. The paged pool
+is the vLLM-style alternative: one ``(num_blocks, block_size, H, D)``
+arena per attention op, carved into fixed-size blocks, with a
+per-request **block table** mapping logical token positions to physical
+blocks. Requests allocate their worst case (prompt + ``max_new_tokens``,
+rounded up to blocks) at admission and free it at retirement, so
+
+* pool memory is bounded by construction — admission **sheds**
+  (:class:`KVPoolExhausted`, a :class:`ShedError`) instead of OOMing
+  mid-decode;
+* the decode executable's shape depends only on (decode slots, pool
+  geometry), never on the live request mix — one compiled program
+  serves every in-flight combination;
+* occupancy is observable: the ``serving.kv_blocks_in_use`` gauge and
+  the session high-water mark.
+
+Block 0 is the **null block**: never allocated, the scatter target for
+inactive decode slots and prompt padding, and the gather source for
+unreserved block-table entries. Its contents are arbitrary-but-finite;
+every read through it is masked out by position before softmax.
+
+Memory math (per attention op): ``2 * num_blocks * block_size * heads *
+head_dim * dtype_bytes`` — e.g. 256 blocks x 16 tokens x 8 heads x 64
+dims in bf16 = 2 * 256*16*8*64 * 2B = 8 MiB per layer, serving up to
+``(num_blocks-1) // blocks_per_request`` concurrent worst-case requests.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..obs.metrics import metrics_registry
+from .errors import KVPoolExhausted
+
+NULL_BLOCK = 0  # reserved scatter/gather sink; never allocated
+
+
+class PagedKVPool:
+    """Block pool + allocator for one model's attention ops.
+
+    ``specs``: ``{attention op name: (num_heads, head_dim)}`` — one
+    (k, v) arena pair per op, all sharing the same block geometry and
+    allocator (a token occupies one slot in EVERY layer's arena, so one
+    block id spans all layers — the allocator hands out block ids, not
+    per-layer storage).
+
+    The jnp arenas live in :attr:`kv` and are updated functionally by
+    the decode/prefill executables (donated through, swapped back in by
+    the scheduler); the allocator state (free list, high-water) is host
+    state guarded by one lock — allocation happens on the scheduler
+    thread, capacity introspection on callers' threads.
+    """
+
+    def __init__(self, specs: Dict[str, Tuple[int, int]], *,
+                 num_blocks: int, block_size: int,
+                 max_blocks_per_request: int, dtype=jnp.float32):
+        if num_blocks < 2:
+            raise ValueError(f"num_blocks {num_blocks} < 2: block 0 is the "
+                             f"reserved null block, so a usable pool needs "
+                             f"at least one more")
+        if block_size < 1:
+            raise ValueError(f"block_size {block_size} < 1")
+        if max_blocks_per_request < 1:
+            raise ValueError(
+                f"max_blocks_per_request {max_blocks_per_request} < 1")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self.max_blocks_per_request = int(max_blocks_per_request)
+        self.dtype = dtype
+        self.specs = dict(specs)
+        self.kv: Dict[str, Tuple[jnp.ndarray, jnp.ndarray]] = {}
+        for name, (heads, head_dim) in self.specs.items():
+            shape = (self.num_blocks, self.block_size, heads, head_dim)
+            self.kv[name] = (jnp.zeros(shape, dtype),
+                            jnp.zeros(shape, dtype))
+        # LIFO free list: freshly freed blocks are reused first (their
+        # stale contents are masked by position either way)
+        self._free: List[int] = list(range(self.num_blocks - 1, 0, -1))
+        self._mu = threading.Lock()
+        self._high_water = 0
+        self._gauge()
+
+    # ---- geometry ----------------------------------------------------------
+    @property
+    def capacity_blocks(self) -> int:
+        """Allocatable blocks (block 0 is the reserved null block)."""
+        return self.num_blocks - 1
+
+    def blocks_for(self, tokens: int) -> int:
+        """Blocks needed to hold ``tokens`` cache entries."""
+        return max(1, math.ceil(int(tokens) / self.block_size))
+
+    def memory_bytes(self) -> int:
+        """Total arena bytes across all ops (k and v)."""
+        per_tok = sum(2 * h * d for h, d in self.specs.values())
+        return (self.num_blocks * self.block_size * per_tok
+                * jnp.dtype(self.dtype).itemsize)
+
+    # ---- allocator ---------------------------------------------------------
+    def in_use(self) -> int:
+        with self._mu:
+            return self.capacity_blocks - len(self._free)
+
+    @property
+    def high_water(self) -> int:
+        with self._mu:
+            return self._high_water
+
+    def try_admit(self, total_tokens: int) -> Optional[np.ndarray]:
+        """Reserve the worst case for a request of ``total_tokens``
+        (prompt + max_new_tokens). Returns a padded block table
+        ``(max_blocks_per_request,)`` int32 (unused tail entries =
+        :data:`NULL_BLOCK`), or None when the pool is currently too
+        full — the caller waits for retirements and retries.
+
+        Raises :class:`KVPoolExhausted` when the request can NEVER fit
+        (worst case exceeds total pool capacity) — that is a shed, not
+        a wait."""
+        need = self.blocks_for(total_tokens)
+        if need > self.max_blocks_per_request:
+            raise KVPoolExhausted(
+                f"request needs {need} blocks > max_blocks_per_request "
+                f"{self.max_blocks_per_request} "
+                f"({total_tokens} tokens, block_size {self.block_size})")
+        if need > self.capacity_blocks:
+            raise KVPoolExhausted(
+                f"request worst case ({need} blocks for {total_tokens} "
+                f"tokens) exceeds the whole pool "
+                f"({self.capacity_blocks} allocatable blocks)")
+        with self._mu:
+            if need > len(self._free):
+                return None
+            blocks = [self._free.pop() for _ in range(need)]
+            used = self.capacity_blocks - len(self._free)
+            if used > self._high_water:
+                self._high_water = used
+        self._gauge()
+        table = np.full(self.max_blocks_per_request, NULL_BLOCK, np.int32)
+        table[:need] = blocks
+        return table
+
+    def free(self, table: np.ndarray) -> None:
+        """Return a request's reserved blocks (every non-null table
+        entry) to the pool."""
+        blocks = [int(b) for b in np.asarray(table).ravel()
+                  if int(b) != NULL_BLOCK]
+        with self._mu:
+            self._free.extend(blocks)
+            if len(self._free) > self.capacity_blocks:
+                raise RuntimeError(
+                    f"double free: {len(self._free)} free blocks > "
+                    f"capacity {self.capacity_blocks}")
+        self._gauge()
+
+    def _gauge(self) -> None:
+        metrics_registry().gauge("serving.kv_blocks_in_use").set(
+            self.in_use())
+
+    def stats(self) -> Dict:
+        """Session-level occupancy snapshot (ledger / bench / healthz)."""
+        with self._mu:
+            used = self.capacity_blocks - len(self._free)
+            hw = self._high_water
+        return {
+            "num_blocks": self.num_blocks,
+            "block_size": self.block_size,
+            "capacity_blocks": self.capacity_blocks,
+            "max_blocks_per_request": self.max_blocks_per_request,
+            "in_use": used,
+            "high_water": hw,
+            "memory_bytes": int(self.memory_bytes()),
+        }
+
+
+__all__ = ["NULL_BLOCK", "PagedKVPool", "KVPoolExhausted"]

@@ -14,11 +14,19 @@ per-model strategy files) is JSON::
         "clf":  {"instances": 2, "mesh_shape": {"data": 2},
                  "batch_size": 8, "strategies": {"dense_1": {"out": "model"}}},
         "gen":  {"instances": 1, "mesh_shape": {"data": 2, "model": 2},
-                 "onnx": "/path/model.onnx"}
+                 "onnx": "/path/model.onnx"},
+        "lm":   {"generator": true, "decode_slots": 4, "block_size": 16,
+                 "num_blocks": 64, "max_length": 128}
     }}
 
 Models with an ``onnx`` key load through the ONNX frontend; others look up
-a builder callable by model name.
+a builder callable by model name. An entry with ``"generator": true``
+registers a continuous-batching :class:`GenerationInstance` instead of a
+classic instance group (one scheduler owns the paged KV pool, so
+``instances`` must be 1): its builder must produce a causal LM
+(models/gpt.py's contract), and the entry's ``decode_slots`` /
+``block_size`` / ``num_blocks`` / ``max_length`` / ``prefill_buckets`` /
+``max_prefills_per_step`` keys override the config's ``serving_*`` knobs.
 """
 
 from __future__ import annotations
@@ -73,6 +81,23 @@ def load_repository(engine, path: str,
         n = int(m.get("instances", 1))
         mesh_shape = {k: int(v) for k, v in
                       (m.get("mesh_shape") or {"data": 1}).items()}
+        if m.get("generator"):
+            if n != 1:
+                raise ValueError(
+                    f"generator {name!r}: instances must be 1 (one "
+                    f"scheduler owns the paged KV pool), got {n}")
+            if name not in builders:
+                raise ValueError(
+                    f"generator {name!r} needs a builder (a causal-LM "
+                    f"graph; ONNX generators are not supported yet)")
+            meshes = instance_meshes(1, mesh_shape, devices, offset)
+            per = 1
+            for s in mesh_shape.values():
+                per *= s
+            offset += per
+            _register_generator(engine, name, builders[name], meshes[0], m)
+            placed[name] = 1
+            continue
         meshes = instance_meshes(n, mesh_shape, devices, offset)
         per = 1
         for s in mesh_shape.values():
@@ -93,3 +118,24 @@ def load_repository(engine, path: str,
                 strategies=m.get("strategies"))
         placed[name] = n
     return placed
+
+
+_GEN_KNOBS = ("decode_slots", "block_size", "num_blocks", "max_length",
+              "prefill_buckets", "max_prefills_per_step")
+
+
+def _register_generator(engine, name: str, build: Callable, mesh,
+                        entry: Dict) -> None:
+    """Compile a builder-defined causal LM for inference on ``mesh`` and
+    register it as a continuous-batching generation instance."""
+    from ..config import FFConfig
+    from ..ffconst import CompMode
+    from ..runtime.model import FFModel
+
+    ff = FFModel(FFConfig(batch_size=int(entry.get("batch_size", 1)),
+                          computation_mode=CompMode.INFERENCE))
+    build(ff, ff.config.batch_size)
+    ff.compile(optimizer=None, loss_type=None, metrics=[], mesh=mesh,
+               strategies=entry.get("strategies"))
+    kw = {k: entry[k] for k in _GEN_KNOBS if k in entry}
+    engine.register_generator(ff, name=name, **kw)

@@ -1,0 +1,675 @@
+"""Continuous (in-flight) batching over the paged KV cache.
+
+The classic engine (engine.py) assembles a batch, runs it, replies, and
+only then looks at the queue again — fine for one-shot inference, fatal
+for autoregressive generation where requests finish at different steps:
+a static batch holds every slot hostage to its longest member. This
+scheduler admits and retires requests **between individual decode
+steps**:
+
+* ONE jitted decode program at a fixed ``decode_slots`` width batches
+  all active requests (paged pool + block tables donated through it —
+  :class:`~flexflow_tpu.serving.generation.PagedDecoder`); the decode
+  loop issues one dispatch per step regardless of how many slots are
+  live;
+* prompts run through the separate bucketed prefill executable, their
+  K/V scattered straight into the pool; at most
+  ``max_prefills_per_step`` prefills are interleaved between decode
+  steps while requests are active, so a long prompt burst cannot stall
+  in-flight decodes unboundedly;
+* admission control degrades gracefully (PR 11 semantics): a queue past
+  ``admission_limit`` sheds (:class:`ShedError`), a request whose worst
+  case (prompt + ``max_new_tokens``) can never fit the pool sheds
+  immediately (:class:`KVPoolExhausted`), a deadline that expires —
+  in queue OR mid-flight — rejects fast (:class:`DeadlineExceeded`)
+  before the next decode step, ``breaker_threshold`` consecutive decode
+  failures open a cooldown breaker, and a crashed decode worker
+  respawns under ``worker_retry_budget`` with every accepted future
+  still resolving (the scheduler owns the request state, not the dead
+  thread).
+
+Determinism contract: sampling is per-request — each request draws from
+``np.random.default_rng(seed)`` in its own token order through the
+shared :func:`~flexflow_tpu.serving.generation.sample_next_token` — and
+the paged decode is bit-identical to the dense cache, so the engine
+produces exactly the tokens sequential static-batch serving would.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import threading
+import time
+from concurrent.futures import Future
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..obs.metrics import metrics_registry
+from ..obs.trace import VIRTUAL_TID_BASE, tracer
+from ..obs.watchdog import watch as _wd_watch
+from ..runtime.faults import InjectedFault, TransientFault
+from ..runtime.faults import fire as _fault_fire
+from ..runtime.retry import RetryPolicy
+from .errors import DeadlineExceeded, ShedError
+from .generation import PagedDecoder, sample_next_token
+
+# generation request tracks live above the classic engine's range so the
+# two engines' per-request trace tracks can never collide
+_GEN_TID_BASE = VIRTUAL_TID_BASE + (1 << 19)
+
+# transient decode/prefill dispatch failures back off briefly before the
+# step is failed (mirrors the classic engine's dispatch retry)
+_DECODE_RETRY = RetryPolicy(max_attempts=3, base_delay_s=0.002,
+                            max_delay_s=0.02, retry_on=(TransientFault,),
+                            label="serving_decode", seed=0)
+
+# per-session latency windows kept for the ledger record's percentiles
+# (bounded: a long session keeps the most recent window, like the
+# metrics registry's reservoirs)
+_PHASE_WINDOW = 4096
+
+
+def _percentiles(xs) -> Optional[Dict]:
+    from ..obs.metrics import nearest_rank_percentile
+
+    xs = sorted(xs)
+    if not xs:
+        return None
+    return {"count": len(xs), "mean": sum(xs) / len(xs),
+            "p50": nearest_rank_percentile(xs, 0.5),
+            "p99": nearest_rank_percentile(xs, 0.99)}
+
+
+class GenerationRequest:
+    """One queued/in-flight generation request. The ``future`` resolves
+    to the full (prompt + generated) int32 token array — exactly
+    ``Generator.generate``'s row contract."""
+
+    __slots__ = ("request_id", "prompt", "max_new_tokens", "temperature",
+                 "seed", "eos_id", "deadline_s", "t_enqueue", "future",
+                 # scheduler-thread-only runtime state
+                 "table", "seq_len", "tokens", "rng", "t_admit",
+                 "t_prefill_done", "t_first_token", "decode_t0",
+                 "decode_steps")
+
+    def __init__(self, request_id: int, prompt: np.ndarray,
+                 max_new_tokens: int, temperature: float, seed: int,
+                 eos_id: Optional[int], deadline_s: Optional[float]):
+        self.request_id = request_id
+        self.prompt = np.asarray(prompt, np.int32).ravel()
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature)
+        self.seed = int(seed)
+        self.eos_id = eos_id
+        self.deadline_s = deadline_s
+        self.t_enqueue = time.perf_counter()
+        self.future: Future = Future()
+        self.table = None
+        self.seq_len = 0
+        self.tokens: List[int] = []
+        self.rng = None
+        self.t_admit = None
+        self.t_prefill_done = None
+        self.t_first_token = None
+        self.decode_t0 = None
+        self.decode_steps = 0
+
+    def expired(self, now: float) -> bool:
+        return (self.deadline_s is not None
+                and now - self.t_enqueue > self.deadline_s)
+
+
+class ContinuousBatchingScheduler:
+    """The continuous-batching loop for ONE compiled causal LM.
+
+    Locking discipline (mirrors engine.py, checked by the concurrency
+    auditor): one Condition ``_mu`` guards the queue, the slot array,
+    lifecycle flags, breaker state, and the session stats; every
+    blocking operation — prefill/decode dispatches, thread join — runs
+    OUTSIDE it (CCY003). Slot/request runtime state is only MUTATED by
+    the scheduler thread; other threads read it under ``_mu`` for
+    stats."""
+
+    def __init__(self, ff, name: str = "lm", *,
+                 max_length: Optional[int] = None,
+                 decode_slots: int = 4, block_size: int = 16,
+                 num_blocks: Optional[int] = None,
+                 prefill_buckets: Optional[Sequence[int]] = None,
+                 max_prefills_per_step: int = 1,
+                 admission_limit: Optional[int] = None,
+                 default_deadline_s: Optional[float] = None,
+                 breaker_threshold: int = 0,
+                 breaker_cooldown_s: float = 1.0,
+                 worker_retry_budget: int = 2):
+        if max_length is None:
+            max_length = _position_capacity(ff)
+        self.name = name
+        self._ff = ff
+        self.decoder = PagedDecoder(
+            ff, max_length, decode_slots=decode_slots,
+            block_size=block_size, num_blocks=num_blocks,
+            prefill_buckets=prefill_buckets)
+        self.max_prefills_per_step = max(1, int(max_prefills_per_step))
+        self.admission_limit = (int(admission_limit)
+                                if admission_limit else None)
+        self.default_deadline_s = (float(default_deadline_s)
+                                   if default_deadline_s else None)
+        self.breaker_threshold = max(0, int(breaker_threshold))
+        self.breaker_cooldown_s = float(breaker_cooldown_s)
+        self.worker_retry_budget = max(0, int(worker_retry_budget))
+        self._mu = threading.Condition()
+        self._queue: collections.deque = collections.deque()
+        self._slots: List[Optional[GenerationRequest]] = \
+            [None] * self.decoder.decode_slots
+        self._ids = itertools.count()
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+        self._session_recorded = False
+        self._abandoned = False
+        self._consec_failures = 0
+        self._breaker_open_until = 0.0
+        self._tokens_total = 0
+        self._t_first_activity: Optional[float] = None
+        # per-phase latency windows for the session ledger record
+        self._lat: Dict[str, collections.deque] = {
+            k: collections.deque(maxlen=_PHASE_WINDOW)
+            for k in ("queue_wait", "prefill", "decode", "ttft",
+                      "per_token", "e2e")}
+        self._shed = 0
+        self._deadline_rejects = 0
+        self._completed = 0
+
+    # ---- admission ---------------------------------------------------------
+    def submit(self, prompt: np.ndarray, max_new_tokens: int,
+               temperature: float = 0.0, seed: int = 0,
+               eos_id: Optional[int] = None,
+               deadline_s: Optional[float] = None) -> Future:
+        """Submit one request. Raises :class:`ShedError` at admission
+        when the queue is past its bound, the breaker is open, or the
+        request's worst case can never fit the pool."""
+        prompt = np.asarray(prompt, np.int32).ravel()
+        if prompt.size < 1:
+            raise ValueError("empty prompt")
+        total = prompt.size + int(max_new_tokens)
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens {max_new_tokens} < 1")
+        if total > self.decoder.max_length:
+            raise ValueError(
+                f"{prompt.size} prompt + {max_new_tokens} new > "
+                f"max_length {self.decoder.max_length}")
+        reg = metrics_registry()
+        # pool-capacity shed: a request that can NEVER fit must not
+        # poison the queue head forever (raises KVPoolExhausted=ShedError)
+        need = self.decoder.pool.blocks_for(total)
+        if need > self.decoder.pool.capacity_blocks:
+            with self._mu:
+                self._shed += 1
+            reg.counter("serving.shed").inc()
+            self.decoder.pool.try_admit(total)  # raises with the details
+        req = GenerationRequest(
+            next(self._ids), prompt, max_new_tokens, temperature, seed,
+            eos_id,
+            float(deadline_s) if deadline_s is not None
+            else self.default_deadline_s)
+        with self._mu:
+            if self._closed:
+                raise RuntimeError(
+                    f"{self.name!r}: generation scheduler is stopped")
+            now = time.monotonic()
+            if self._breaker_open_until and now < self._breaker_open_until:
+                self._shed += 1
+                reg.counter("serving.breaker_shed").inc()
+                reg.counter("serving.shed").inc()
+                raise ShedError(
+                    f"{self.name!r}: decode failure breaker is open "
+                    f"({self.breaker_threshold} consecutive step "
+                    f"failures); shedding until the cooldown elapses")
+            if self._breaker_open_until and now >= self._breaker_open_until:
+                # cooldown elapsed: close the breaker, let traffic probe
+                self._breaker_open_until = 0.0
+                self._consec_failures = 0
+            if (self.admission_limit is not None
+                    and len(self._queue) >= self.admission_limit):
+                self._shed += 1
+                reg.counter("serving.shed").inc()
+                raise ShedError(
+                    f"{self.name!r}: admission queue at its bound "
+                    f"({self.admission_limit}); shedding")
+            self._queue.append(req)
+            depth = len(self._queue)
+            if self._t_first_activity is None:
+                self._t_first_activity = time.perf_counter()
+            self._start_locked()
+            self._mu.notify_all()
+        reg.counter("serving.requests").inc()
+        reg.counter("serving.gen_requests").inc()
+        reg.histogram("serving.queue_depth").observe(depth)
+        return req.future
+
+    def generate(self, prompt: np.ndarray, max_new_tokens: int,
+                 timeout: Optional[float] = 120.0, **kw) -> np.ndarray:
+        return self.submit(prompt, max_new_tokens, **kw).result(timeout)
+
+    # ---- lifecycle ---------------------------------------------------------
+    def _start_locked(self) -> None:
+        if self._thread is not None or self._closed:
+            return
+        t = threading.Thread(target=self._worker_main, daemon=True,
+                             name=f"ffserve-gen-{self.name}")
+        self._thread = t
+        t.start()
+
+    def stop(self) -> None:
+        """Drain and stop: QUEUED requests fail fast with a clean
+        RuntimeError (the classic engine's parked-request semantics);
+        ACTIVE requests decode to completion (their worst case is
+        bounded by construction). Writes the session's serving ledger
+        record. A stopped scheduler does not restart."""
+        with self._mu:
+            self._closed = True
+            self._mu.notify_all()
+            t = self._thread
+            # idempotent: a GenerationInstance stopped directly and then
+            # again through engine.stop() must not append a duplicate
+            # session record
+            already = self._session_recorded
+            self._session_recorded = True
+        if t is not None:
+            t.join(timeout=120)  # outside _mu (CCY003)
+        if not already:
+            self._record_session()
+
+    # ---- worker ------------------------------------------------------------
+    def _worker_main(self) -> None:
+        """Respawn supervisor (the classic engine's _worker_main
+        analog): the decode loop's state lives on the scheduler object,
+        so a respawned worker resumes every in-flight request."""
+        reg = metrics_registry()
+        for crashes in range(self.worker_retry_budget + 1):
+            try:
+                self._loop()
+                return  # clean shutdown
+            except Exception as e:  # noqa: BLE001 — the decode loop died
+                reg.counter("serving.worker_crashes").inc()
+                if crashes >= self.worker_retry_budget:
+                    reg.counter("serving.worker_abandoned").inc()
+                    print(f"[serving] generation worker {self.name} "
+                          f"crashed {crashes + 1}x ({type(e).__name__}: "
+                          f"{e}); respawn budget exhausted — abandoning",
+                          file=__import__("sys").stderr, flush=True)
+                    self._abandon(e)
+                    return
+                reg.counter("serving.worker_respawns").inc()
+                print(f"[serving] generation worker {self.name} crashed "
+                      f"({type(e).__name__}: {e}); respawning "
+                      f"({crashes + 1}/{self.worker_retry_budget})",
+                      file=__import__("sys").stderr, flush=True)
+
+    def _abandon(self, err: Exception) -> None:
+        """Respawn budget exhausted: every accepted future must still
+        resolve — fail queued AND active requests loudly, free their
+        blocks, and open the breaker forever (admission sheds)."""
+        with self._mu:
+            self._abandoned = True
+            self._breaker_open_until = float("inf")
+            pending = list(self._queue)
+            self._queue.clear()
+            active = [r for r in self._slots if r is not None]
+            self._slots = [None] * len(self._slots)
+        metrics_registry().counter("serving.abandoned_failed").inc(
+            len(pending) + len(active))
+        wrapped = RuntimeError(
+            f"{self.name!r}: generation worker exhausted its respawn "
+            f"budget ({type(err).__name__}: {err}); request failed")
+        for r in active:
+            self.decoder.pool.free(r.table)
+        for r in pending + active:
+            if not r.future.done():
+                r.future.set_exception(wrapped)
+
+    def _loop(self) -> None:
+        import contextlib
+
+        first_step = True
+        while True:
+            with self._mu:
+                while (not self._closed and not self._queue
+                       and not any(r is not None for r in self._slots)):
+                    self._mu.wait()
+                if (self._closed and not self._queue
+                        and not any(r is not None for r in self._slots)):
+                    return
+                closed = self._closed
+            # fault site: decode-worker crash — state stays on the
+            # scheduler, so the respawned worker resumes every request
+            rule = _fault_fire("serving.worker")
+            if rule is not None:
+                raise InjectedFault(
+                    f"injected fault at site 'serving.worker' ({rule})")
+            self._admit(closed)
+            with self._mu:
+                active = any(r is not None for r in self._slots)
+            if not active:
+                continue
+            # watchdog: only ACTIVE decode work is watched; the first
+            # step runs unwatched through the cold XLA compile
+            ctx = (contextlib.nullcontext() if first_step
+                   else _wd_watch(f"serving.gen.{self.name}"))
+            first_step = False
+            with ctx:
+                self._decode_once()
+
+    # ---- admission between decode steps ------------------------------------
+    def _admit(self, closed: bool) -> None:
+        """Move queued requests into free decode slots: deadline-expired
+        requests reject fast, pool-full requests wait (FIFO head keeps
+        its place), admitted requests prefill immediately. While decodes
+        are active at most ``max_prefills_per_step`` prompts are
+        prefilled per call, bounding the decode stall a prompt burst can
+        cause."""
+        reg = metrics_registry()
+        with self._mu:
+            active = any(r is not None for r in self._slots)
+            n_slots = len(self._slots)
+        budget = self.max_prefills_per_step if active else n_slots
+        admitted = 0
+        while admitted < budget:
+            with self._mu:
+                if not self._queue:
+                    return
+                req = self._queue.popleft()
+            if closed:
+                if not req.future.done():
+                    req.future.set_exception(
+                        RuntimeError("engine stopped"))
+                continue
+            now = time.perf_counter()
+            if req.expired(now):
+                with self._mu:
+                    self._deadline_rejects += 1
+                reg.counter("serving.deadline_rejects").inc()
+                if not req.future.done():
+                    req.future.set_exception(DeadlineExceeded(
+                        f"request {req.request_id} waited "
+                        f"{now - req.t_enqueue:.3f}s > deadline "
+                        f"{req.deadline_s:.3f}s"))
+                continue
+            slot = None
+            with self._mu:
+                for i, r in enumerate(self._slots):
+                    if r is None:
+                        slot = i
+                        break
+            if slot is None:
+                with self._mu:
+                    self._queue.appendleft(req)
+                return
+            table = self.decoder.pool.try_admit(
+                req.prompt.size + req.max_new_tokens)
+            if table is None:
+                # pool momentarily full: head of line waits for a
+                # retirement (bounded — actives free their worst case)
+                with self._mu:
+                    self._queue.appendleft(req)
+                return
+            with self._mu:
+                req.table = table
+                req.t_admit = now
+                self._lat["queue_wait"].append(now - req.t_enqueue)
+            reg.histogram("serving.gen_queue_wait_s").observe(
+                now - req.t_enqueue)
+            try:
+                self._prefill(req)
+            except Exception as e:  # noqa: BLE001 — fail THIS request only
+                reg.counter("serving.errors").inc()
+                self.decoder.pool.free(req.table)
+                if not req.future.done():
+                    req.future.set_exception(e)
+                continue
+            admitted += 1
+            if req.future.done():  # single-token request retired at prefill
+                continue
+            with self._mu:
+                self._slots[slot] = req
+
+    def _prefill(self, req: GenerationRequest) -> None:
+        t0 = time.perf_counter()
+        logits = _DECODE_RETRY.call(self.decoder.prefill, req.prompt,
+                                    req.table)
+        t_done = time.perf_counter()
+        with self._mu:
+            req.t_prefill_done = t_done
+            req.seq_len = req.prompt.size
+            req.rng = np.random.default_rng(req.seed)
+            self._lat["prefill"].append(t_done - t0)
+        metrics_registry().histogram("serving.prefill_s").observe(
+            t_done - t0)
+        self._append_token(req, logits)
+
+    # ---- decode ------------------------------------------------------------
+    def _decode_once(self) -> None:
+        reg = metrics_registry()
+        now = time.perf_counter()
+        with self._mu:
+            slots = list(self._slots)
+        # deadline gate: expired in-flight requests are rejected BEFORE
+        # their next decode step (their remaining tokens would be served
+        # to nobody); their blocks free immediately
+        expired = set()
+        for i, req in enumerate(slots):
+            if req is not None and req.expired(now):
+                expired.add(i)
+                with self._mu:
+                    self._slots[i] = None
+                    self._deadline_rejects += 1
+                reg.counter("serving.deadline_rejects").inc()
+                self.decoder.pool.free(req.table)
+                if not req.future.done():
+                    req.future.set_exception(DeadlineExceeded(
+                        f"request {req.request_id} exceeded its deadline "
+                        f"{req.deadline_s:.3f}s mid-decode "
+                        f"({len(req.tokens)}/{req.max_new_tokens} tokens)"))
+        active = [(i, r) for i, r in enumerate(slots)
+                  if r is not None and i not in expired]
+        if not active:
+            return
+        n_slots = len(slots)
+        tokens = np.zeros(n_slots, np.int32)
+        tables = np.zeros(
+            (n_slots, self.decoder.max_blocks_per_request), np.int32)
+        seq_lens = np.zeros(n_slots, np.int32)
+        with self._mu:
+            for i, req in active:
+                tokens[i] = req.tokens[-1]
+                tables[i] = req.table
+                seq_lens[i] = req.seq_len
+                if req.decode_t0 is None:
+                    req.decode_t0 = time.perf_counter()
+        t0 = time.perf_counter()
+        try:
+            logits = _DECODE_RETRY.call(self.decoder.decode, tokens,
+                                        tables, seq_lens)
+        except Exception as e:  # noqa: BLE001 — fail the step's requests
+            reg.counter("serving.errors").inc()
+            for i, req in active:
+                with self._mu:
+                    self._slots[i] = None
+                self.decoder.pool.free(req.table)
+                if not req.future.done():
+                    req.future.set_exception(e)
+            if self.breaker_threshold:
+                with self._mu:
+                    self._consec_failures += 1
+                    # transition-only (==): repeated failures behind an
+                    # open breaker must not re-extend the cooldown
+                    opened = (self._consec_failures
+                              == self.breaker_threshold)
+                    if opened:
+                        self._breaker_open_until = (
+                            time.monotonic() + self.breaker_cooldown_s)
+                if opened:
+                    reg.counter("serving.breaker_opens").inc()
+            return
+        dt = time.perf_counter() - t0
+        reg.histogram("serving.decode_step_s").observe(dt)
+        for i, req in active:
+            with self._mu:
+                req.seq_len += 1
+                req.decode_steps += 1
+            self._append_token(req, logits[i])
+        if self.breaker_threshold:
+            with self._mu:  # a served step closes the failure streak
+                self._consec_failures = 0
+
+    def _append_token(self, req: GenerationRequest, row_logits) -> None:
+        """Sample the next token for one request (mask-aware: only
+        called for live requests) and retire it when finished."""
+        tok = sample_next_token(np.asarray(row_logits), req.temperature,
+                                req.rng)
+        now = time.perf_counter()
+        ttft = None
+        with self._mu:
+            req.tokens.append(int(tok))
+            if req.t_first_token is None:
+                req.t_first_token = now
+                ttft = now - req.t_enqueue
+                self._lat["ttft"].append(ttft)
+            self._tokens_total += 1
+            total = self._tokens_total
+            t_start = self._t_first_activity
+        if ttft is not None:
+            metrics_registry().histogram("serving.ttft_s").observe(ttft)
+        metrics_registry().counter("serving.gen_tokens").inc()
+        if t_start is not None and now > t_start:
+            metrics_registry().gauge("serving.tokens_per_s").set(
+                total / (now - t_start))
+        done = (len(req.tokens) >= req.max_new_tokens
+                or (req.eos_id is not None and tok == req.eos_id))
+        if done:
+            self._retire(req, now)
+
+    def _retire(self, req: GenerationRequest, now: float) -> None:
+        reg = metrics_registry()
+        self.decoder.pool.free(req.table)
+        with self._mu:
+            for i, r in enumerate(self._slots):
+                if r is req:
+                    self._slots[i] = None
+            self._completed += 1
+        out = np.concatenate(
+            [req.prompt, np.asarray(req.tokens, np.int32)])
+        n = len(req.tokens)
+        e2e = now - req.t_enqueue
+        with self._mu:  # stats() snapshots these under the same lock
+            self._lat["e2e"].append(e2e)
+            self._lat["per_token"].append(e2e / n)
+            if req.decode_t0 is not None:
+                self._lat["decode"].append(now - req.decode_t0)
+        reg.histogram("serving.gen_e2e_s").observe(e2e)
+        reg.histogram("serving.per_token_s").observe(e2e / n)
+        reg.counter("serving.batches").inc()
+        self._record_request_spans(req, now)
+        req.future.set_result(out)
+
+    # ---- observability -----------------------------------------------------
+    def _record_request_spans(self, req: GenerationRequest,
+                              t_end: float) -> None:
+        """request ⊃ queue_wait → prefill → decode×n → reply, each
+        request on its own virtual track (the classic engine's span-tree
+        contract, with the decode phase annotated by its step count)."""
+        tr = tracer()
+        if not tr.enabled:
+            return
+        tid = _GEN_TID_BASE + req.request_id
+        args = {"model": self.name, "request_id": req.request_id,
+                "tokens": len(req.tokens)}
+        tr.complete("serving.request", req.t_enqueue,
+                    t_end - req.t_enqueue, cat="serving", tid=tid,
+                    args=args)
+        tr.complete("serving.queue_wait", req.t_enqueue,
+                    req.t_admit - req.t_enqueue, cat="serving", tid=tid)
+        if req.t_prefill_done is not None:
+            tr.complete("serving.prefill", req.t_admit,
+                        req.t_prefill_done - req.t_admit, cat="serving",
+                        tid=tid)
+        if req.decode_t0 is not None:
+            tr.complete("serving.decode", req.decode_t0,
+                        t_end - req.decode_t0, cat="serving", tid=tid,
+                        args={"steps": req.decode_steps})
+        tr.complete("serving.reply", t_end, 0.0, cat="serving", tid=tid)
+
+    def stats(self) -> Dict:
+        """Live session snapshot: phases, pool occupancy, throughput —
+        the ledger record's body and /healthz's serving block."""
+        with self._mu:
+            queued = len(self._queue)
+            active = sum(1 for r in self._slots if r is not None)
+            tokens = self._tokens_total
+            t_start = self._t_first_activity
+            shed = self._shed
+            deadline = self._deadline_rejects
+            completed = self._completed
+            phases = {k: _percentiles(v) for k, v in self._lat.items()}
+        now = time.perf_counter()
+        tps = (tokens / (now - t_start)
+               if t_start is not None and now > t_start else 0.0)
+        return {
+            "serving_engine": "continuous",
+            "model": self.name,
+            "queued": queued,
+            "active": active,
+            "completed": completed,
+            "tokens": tokens,
+            "tokens_per_s": round(tps, 3),
+            "shed": shed,
+            "deadline_rejects": deadline,
+            "phases": phases,
+            "kv": self.decoder.pool.stats(),
+            "decode_steps": self.decoder.decode_steps,
+            "decode_dispatches": self.decoder.decode_dispatches,
+            "prefill_buckets": list(self.decoder.prefill_buckets),
+            "knobs": {
+                "decode_slots": self.decoder.decode_slots,
+                "block_size": self.decoder.block_size,
+                "num_blocks": self.decoder.pool.num_blocks,
+                "max_length": self.decoder.max_length,
+                "max_prefills_per_step": self.max_prefills_per_step,
+            },
+        }
+
+    def _record_session(self) -> None:
+        """One serving ledger record per scheduler session (stop())."""
+        from ..obs.ledger import model_context, record_serving
+
+        extra = self.stats()
+        try:
+            ctx = model_context(self._ff)
+            if ctx.get("model_sig"):
+                extra["model_sig"] = ctx["model_sig"]
+        except Exception:  # noqa: BLE001 — telemetry never kills stop
+            pass
+        record_serving(extra, config=self._ff.config)
+
+
+def _position_capacity(ff) -> int:
+    """Default ``max_length``: the position-embedding table's capacity
+    (the model's own hard decoding bound)."""
+    from ..ffconst import OpType
+
+    cm = ff.compiled
+    if cm is None:
+        raise ValueError("compile() the model before serving it")
+    if len(cm.input_tensors) >= 2:
+        pos_tid = cm.input_tensors[1].tensor_id
+        for op in cm.ops:
+            if (op.op_type is OpType.EMBEDDING
+                    and op.layer.inputs[0].tensor_id == pos_tid):
+                return int(op.attrs["num_entries"])
+    raise ValueError(
+        "cannot infer max_length: no position-embedding op found — pass "
+        "max_length explicitly")
+
+
+__all__ = ["ContinuousBatchingScheduler", "GenerationRequest"]

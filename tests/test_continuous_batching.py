@@ -1,0 +1,550 @@
+"""Continuous batching + paged KV cache (serving/scheduler.py,
+serving/kv_cache.py, PagedDecoder in serving/generation.py).
+
+The invariants that matter:
+
+* the paged decode path is BIT-IDENTICAL to the dense cache decode path
+  for the same request set, per zoo causal-LM model;
+* the continuous-batching engine produces exactly the tokens sequential
+  static-batch serving produces under a seeded sampler, regardless of
+  arrival order / in-flight mix;
+* one decode dispatch per step, auditor-clean with the pool donated;
+* PR 11 degradation semantics survive the new engine: bounded shed with
+  the kv pool as the binding constraint, deadline rejects before the
+  next decode step, crashed decode workers respawn with every accepted
+  future resolving.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from flexflow_tpu import FFConfig, FFModel
+from flexflow_tpu.ffconst import CompMode, OpType
+from flexflow_tpu.models import GPTConfig, build_gpt, zoo_smoke_builders
+from flexflow_tpu.obs.metrics import metrics_registry
+from flexflow_tpu.runtime import faults
+from flexflow_tpu.serving import (ContinuousBatchingScheduler,
+                                  DeadlineExceeded, Generator,
+                                  InferenceEngine, PagedDecoder, ShedError)
+
+V = 50
+GCFG = GPTConfig(vocab_size=V, max_positions=32, hidden_size=32,
+                 num_heads=4, num_layers=2)
+
+
+@pytest.fixture(autouse=True)
+def _clear_plan():
+    yield
+    faults.configure_faults(FFConfig(fault_plan=None))
+
+
+def _gpt(**cfg_kw):
+    cfg_kw.setdefault("ledger", "off")
+    ff = FFModel(FFConfig(batch_size=4, seed=0,
+                          computation_mode=CompMode.INFERENCE, **cfg_kw))
+    build_gpt(ff, 4, 6, GCFG)
+    ff.compile(optimizer=None, loss_type=None, metrics=[])
+    return ff
+
+
+@pytest.fixture(scope="module")
+def gpt():
+    return _gpt()
+
+
+# ------------------------------------------------ paged == dense (bitwise)
+def test_paged_decode_bit_identical_per_zoo_causal_lm():
+    """For EVERY zoo model that is a causal LM, prefill and decode
+    logits through the paged pool must equal the dense cache path bit
+    for bit (np.array_equal, no tolerance)."""
+    covered = []
+    for name, build in zoo_smoke_builders().items():
+        probe = FFModel(FFConfig(batch_size=4,
+                                 computation_mode=CompMode.INFERENCE,
+                                 ledger="off"))
+        build(probe, 4)
+        if not any(layer.op_type is OpType.MULTIHEAD_ATTENTION
+                   and layer.attrs.get("causal")
+                   and len({t.tensor_id for t in layer.inputs}) == 1
+                   for layer in probe.layers):
+            continue  # not a causal LM — the generator would reject it
+        probe.compile(optimizer=None, loss_type=None, metrics=[])
+        vocab = probe.compiled.logits_tensor.dims[-1]
+        max_len = 32
+        gen = Generator(probe, max_length=max_len, batch_size=4)
+        dec = PagedDecoder(probe, max_length=max_len, decode_slots=4,
+                           block_size=8)
+        rng = np.random.default_rng(1)
+        prompts = [rng.integers(0, vocab, (n,)).astype(np.int32)
+                   for n in (3, 6, 2, 5)]
+        for slot, prompt in enumerate(prompts):
+            dense_last, cache, pos = gen.prefill(prompt[None, :])
+            table = dec.pool.try_admit(prompt.size + 4)
+            paged_last = dec.prefill(prompt, table)
+            assert np.array_equal(np.asarray(dense_last)[0], paged_last), \
+                f"{name}: prefill logits diverge (slot {slot})"
+            # two decode steps, teacher-forced on the dense argmax
+            nxt = int(np.asarray(dense_last)[0].argmax())
+            tables = np.zeros((4, dec.max_blocks_per_request), np.int32)
+            tables[0] = table
+            seq_lens = np.zeros(4, np.int32)
+            for step in range(2):
+                seq_lens[0] = prompt.size + step
+                toks = np.zeros(4, np.int32)
+                toks[0] = nxt
+                paged = dec.decode(toks, tables, seq_lens)[0]
+                step_tokens = np.zeros((4, 1), np.int32)
+                step_tokens[0, 0] = nxt
+                dense, cache = gen._step(
+                    gen._exec_params(), jnp.asarray(step_tokens), cache,
+                    jnp.int32(prompt.size + step))
+                dense = np.asarray(dense)[0, -1]
+                assert np.array_equal(dense, paged), \
+                    f"{name}: decode step {step} logits diverge"
+                nxt = int(dense.argmax())
+            dec.pool.free(table)
+        covered.append(name)
+    assert "gpt" in covered, f"zoo causal-LM sweep covered {covered}"
+
+
+def test_paged_decoder_audit_clean_with_donated_pool(gpt):
+    """The paged decode executable passes the program auditor (default
+    audit_programs='error' raised nothing at construction) with the
+    pool donated."""
+    dec = PagedDecoder(gpt, max_length=32, decode_slots=4, block_size=8)
+    assert dec.audit_report is not None
+    assert dec.audit_report.errors == []
+    assert "serving.paged_decode_step" in dec.audit_report.programs
+
+
+# ------------------------------------- engine == sequential (seeded sampler)
+def _reference_rows(ff, reqs, temperature):
+    """Sequential static-batch reference: each request decoded alone
+    through the DENSE generator with its own seed."""
+    gen = Generator(ff, max_length=32)
+    out = []
+    for i, (prompt, m) in enumerate(reqs):
+        out.append(gen.generate(prompt[None, :], m,
+                                temperature=temperature,
+                                seed=[1000 + i])[0])
+    return out
+
+
+@pytest.mark.parametrize("temperature", [0.0, 0.8])
+def test_engine_tokens_equal_sequential_static_batch(gpt, temperature):
+    """Ragged arrivals, heterogeneous prompt/generation lengths, an
+    in-flight mix that churns slots — the engine must produce exactly
+    the tokens sequential serving produces, because batching strategy
+    must never change results."""
+    rng = np.random.default_rng(7)
+    reqs = [(rng.integers(0, V, (n,)).astype(np.int32), m)
+            for n, m in [(3, 6), (6, 2), (2, 9), (5, 1), (4, 7), (2, 3),
+                         (3, 5), (6, 4)]]
+    eng = InferenceEngine()
+    eng.register_generator(gpt, name="lm", decode_slots=3, block_size=8,
+                           max_length=32)
+    futs = []
+    for i, (prompt, m) in enumerate(reqs):
+        futs.append(eng.generate_async("lm", prompt, m,
+                                       temperature=temperature,
+                                       seed=1000 + i))
+        if i % 3 == 2:
+            time.sleep(0.002)  # ragged arrival
+    outs = [f.result(timeout=120) for f in futs]
+    eng.stop()
+    for out, ref in zip(outs, _reference_rows(gpt, reqs, temperature)):
+        np.testing.assert_array_equal(out, ref)
+
+
+def test_eos_retires_early(gpt):
+    """An eos sample retires the request exactly like the dense
+    generator's forced-eos early stop."""
+    rng = np.random.default_rng(3)
+    prompt = rng.integers(0, V, (4,)).astype(np.int32)
+    gen = Generator(gpt, max_length=32)
+    # pick the greedy token at step 0 as the eos id: the engine must
+    # stop right after emitting it
+    ref = gen.generate(prompt[None, :], 6)[0]
+    eos = int(ref[prompt.size])
+    sched = ContinuousBatchingScheduler(gpt, max_length=32,
+                                        decode_slots=2, block_size=8)
+    out = sched.generate(prompt, 6, eos_id=eos)
+    sched.stop()
+    assert out.tolist() == list(prompt) + [eos]
+
+
+def test_one_dispatch_per_step_regardless_of_mix(gpt):
+    sched = ContinuousBatchingScheduler(gpt, max_length=32,
+                                        decode_slots=4, block_size=8,
+                                        max_prefills_per_step=4)
+    rng = np.random.default_rng(5)
+    futs = [sched.submit(rng.integers(0, V, (n,)).astype(np.int32), m)
+            for n, m in [(2, 8), (5, 2), (3, 6), (6, 3), (4, 4)]]
+    for f in futs:
+        f.result(timeout=120)
+    stats = sched.stats()
+    sched.stop()
+    assert stats["decode_steps"] == stats["decode_dispatches"]
+    assert stats["decode_steps"] >= 7  # longest request decodes 7 steps
+    # in-flight batching: strictly fewer decode steps than sequential
+    assert stats["decode_steps"] < sum(m - 1 for m in (8, 2, 6, 3, 4))
+
+
+# ------------------------------------------------- degradation semantics
+def test_burst_sheds_with_kv_pool_as_binding_constraint(gpt):
+    """A burst past admission_limit sheds; the pool (2 worst-case
+    requests) is what makes the queue back up."""
+    sched = ContinuousBatchingScheduler(
+        gpt, max_length=32, decode_slots=4, block_size=8,
+        num_blocks=9,  # capacity 8 = two 4-block worst cases
+        admission_limit=2)
+    rng = np.random.default_rng(11)
+    accepted, shed = [], 0
+    for i in range(10):
+        try:
+            accepted.append(sched.submit(
+                rng.integers(0, V, (4,)).astype(np.int32), 20))
+        except ShedError:
+            shed += 1
+    assert shed > 0, "burst past the bound must shed"
+    outs = [f.result(timeout=120) for f in accepted]
+    assert all(o.shape == (24,) for o in outs)
+    stats = sched.stats()
+    sched.stop()
+    assert stats["shed"] == shed
+    assert stats["kv"]["high_water"] <= stats["kv"]["capacity_blocks"]
+    # a request that can NEVER fit sheds immediately, even on an idle pool
+    sched2 = ContinuousBatchingScheduler(gpt, max_length=32,
+                                         decode_slots=2, block_size=8,
+                                         num_blocks=3)
+    with pytest.raises(ShedError, match="exceeds the whole pool"):
+        sched2.submit(np.zeros(8, np.int32), 20)
+    sched2.stop()
+
+
+def test_deadline_expired_rejected_before_pickup(gpt):
+    """Queue-expired requests reject fast at pickup (PR 11 semantics):
+    a long-running request holds the only pool slot, so the deadlined
+    request expires while queued."""
+    sched = ContinuousBatchingScheduler(
+        gpt, max_length=32, decode_slots=1, block_size=8,
+        num_blocks=5)  # one worst-case request at a time
+    rng = np.random.default_rng(13)
+    long_f = sched.submit(rng.integers(0, V, (4,)).astype(np.int32), 24)
+    dead_f = sched.submit(rng.integers(0, V, (4,)).astype(np.int32), 2,
+                          deadline_s=0.0005)
+    with pytest.raises(DeadlineExceeded):
+        dead_f.result(timeout=120)
+    assert long_f.result(timeout=120).shape == (28,)
+    stats = sched.stats()
+    sched.stop()
+    assert stats["deadline_rejects"] == 1
+    assert stats["kv"]["in_use"] == 0  # everything freed
+
+
+def test_deadline_expired_mid_flight_rejected_before_next_step(gpt):
+    """An ACTIVE request whose deadline passes is rejected before its
+    next decode step, its blocks freed (white-box: drive _decode_once
+    directly so the expiry is deterministic)."""
+    sched = ContinuousBatchingScheduler(gpt, max_length=32,
+                                        decode_slots=2, block_size=8)
+    from flexflow_tpu.serving.scheduler import GenerationRequest
+
+    req = GenerationRequest(0, np.zeros(3, np.int32), 8, 0.0, 0, None,
+                            deadline_s=0.01)
+    req.table = sched.decoder.pool.try_admit(3 + 8)
+    sched._prefill(req)
+    with sched._mu:
+        sched._slots[0] = req
+    time.sleep(0.02)  # deadline passes mid-flight
+    sched._decode_once()
+    with pytest.raises(DeadlineExceeded, match="mid-decode"):
+        req.future.result(timeout=5)
+    assert sched.decoder.pool.in_use() == 0
+    with sched._mu:
+        assert sched._slots[0] is None
+    sched.stop()
+
+
+def test_crashed_decode_worker_respawns_futures_resolve(gpt):
+    """serving.worker fault mid-session: the decode worker crashes,
+    respawns under the budget, and every accepted future still
+    resolves to the exact sequential-reference tokens."""
+    plan = {"schema": 1, "sites": {"serving.worker":
+                                   {"at_step": 3, "max_fires": 1}}}
+    faults.configure_faults(FFConfig(fault_plan=plan))
+    before = metrics_registry().counter("serving.worker_respawns").value
+    sched = ContinuousBatchingScheduler(gpt, max_length=32,
+                                        decode_slots=2, block_size=8,
+                                        worker_retry_budget=2)
+    rng = np.random.default_rng(17)
+    reqs = [(rng.integers(0, V, (n,)).astype(np.int32), m)
+            for n, m in [(3, 6), (4, 4), (2, 5)]]
+    futs = [sched.submit(p, m, seed=1000 + i)
+            for i, (p, m) in enumerate(reqs)]
+    outs = [f.result(timeout=120) for f in futs]
+    sched.stop()
+    faults.configure_faults(FFConfig(fault_plan=None))
+    assert metrics_registry().counter(
+        "serving.worker_respawns").value > before
+    for out, ref in zip(outs, _reference_rows(gpt, reqs, 0.0)):
+        np.testing.assert_array_equal(out, ref)
+
+
+def test_respawn_budget_exhausted_fails_loudly(gpt):
+    """Past the budget every accepted future resolves with the abandon
+    error and the breaker sheds new admissions."""
+    plan = {"schema": 1, "sites": {"serving.worker": {"p": 1.0}}}
+    faults.configure_faults(FFConfig(fault_plan=plan))
+    sched = ContinuousBatchingScheduler(gpt, max_length=32,
+                                        decode_slots=2, block_size=8,
+                                        worker_retry_budget=1)
+    fut = sched.submit(np.zeros(3, np.int32), 4)
+    with pytest.raises(RuntimeError, match="respawn budget"):
+        fut.result(timeout=120)
+    faults.configure_faults(FFConfig(fault_plan=None))
+    with pytest.raises(ShedError):
+        sched.submit(np.zeros(3, np.int32), 4)
+    assert sched.decoder.pool.in_use() == 0
+    sched.stop()
+
+
+def test_breaker_opens_on_consecutive_decode_failures(gpt, monkeypatch):
+    sched = ContinuousBatchingScheduler(gpt, max_length=32,
+                                        decode_slots=2, block_size=8,
+                                        breaker_threshold=2,
+                                        breaker_cooldown_s=30.0,
+                                        worker_retry_budget=0)
+    monkeypatch.setattr(sched.decoder, "decode",
+                        lambda *a, **k: (_ for _ in ()).throw(
+                            RuntimeError("wedged device")))
+    futs = [sched.submit(np.zeros(3, np.int32), 4) for _ in range(2)]
+    for f in futs:
+        with pytest.raises(RuntimeError, match="wedged"):
+            f.result(timeout=120)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        try:
+            sched.submit(np.zeros(3, np.int32), 4)
+        except ShedError:
+            break
+        time.sleep(0.02)
+    else:
+        pytest.fail("breaker never opened")
+    sched.stop()
+
+
+# ------------------------------------------------- generator registration
+def test_engine_registration_and_restart(gpt):
+    eng = InferenceEngine()
+    eng.register_generator(gpt, name="lm", decode_slots=2, block_size=8,
+                           max_length=32)
+    assert eng.generators() == ["lm"]
+    with pytest.raises(ValueError, match="already registered"):
+        eng.register_generator(gpt, name="lm")
+    # the collision check is bidirectional: a classic instance cannot
+    # silently take a generator's name either
+    with pytest.raises(ValueError, match="generation instance"):
+        eng.register_ffmodel(gpt, name="lm")
+    out = eng.generate("lm", np.zeros(3, np.int32), 3)
+    assert out.shape == (6,)
+    eng.stop()
+    assert eng.generators() == []  # one-shot schedulers drop at stop
+    eng.register_generator(gpt, name="lm", decode_slots=2, block_size=8,
+                           max_length=32)
+    out2 = eng.generate("lm", np.zeros(3, np.int32), 3)
+    np.testing.assert_array_equal(out, out2)
+    eng.stop()
+
+
+def test_config_knobs_flow_into_instance():
+    ff = _gpt(serving_decode_slots=3, serving_block_size=4,
+              serving_num_blocks=13, serving_max_length=24,
+              serving_prefill_buckets="8,24",
+              serving_max_prefills_per_step=2)
+    eng = InferenceEngine()
+    inst = eng.register_generator(ff, name="lm")
+    dec = inst.scheduler.decoder
+    assert dec.decode_slots == 3
+    assert dec.block_size == 4
+    assert dec.pool.num_blocks == 13
+    assert dec.max_length == 24
+    assert dec.prefill_buckets == [8, 24]
+    assert inst.scheduler.max_prefills_per_step == 2
+    eng.stop()
+
+
+def test_repository_generator_entry(tmp_path):
+    """A repository entry with "generator": true places a continuous-
+    batching instance (serving/placement.py)."""
+    import json
+
+    cfgfile = tmp_path / "repo.json"
+    cfgfile.write_text(json.dumps({"models": {
+        "lm": {"generator": True, "mesh_shape": {"data": 1},
+               "decode_slots": 2, "block_size": 8, "max_length": 24},
+    }}))
+
+    def build_lm(ff, bs):
+        build_gpt(ff, bs, 6, GCFG)
+
+    eng = InferenceEngine()
+    placed = eng.load_repository(str(cfgfile),
+                                 builders={"lm": build_lm})
+    assert placed == {"lm": 1}
+    assert eng.generators() == ["lm"]
+    dec = eng.generator("lm").scheduler.decoder
+    assert dec.decode_slots == 2 and dec.max_length == 24
+    out = eng.generate("lm", np.zeros(3, np.int32), 3)
+    assert out.shape == (6,)
+    eng.stop()
+    # multiple generator instances are rejected (one scheduler, one pool)
+    cfgfile.write_text(json.dumps({"models": {
+        "lm": {"generator": True, "instances": 2}}}))
+    with pytest.raises(ValueError, match="instances must be 1"):
+        InferenceEngine().load_repository(str(cfgfile),
+                                          builders={"lm": build_lm})
+
+
+def test_healthz_reports_serving_gauges(gpt):
+    """/healthz grows the serving block once a scheduler has run:
+    tokens/s + kv occupancy, the live SLO scrape."""
+    from flexflow_tpu.obs.server import _healthz
+
+    sched = ContinuousBatchingScheduler(gpt, max_length=32,
+                                        decode_slots=2, block_size=8)
+    sched.generate(np.zeros(3, np.int32), 3)
+    sched.stop()
+    doc = _healthz()
+    assert doc["serving"]["tokens_per_s"] > 0
+    assert doc["serving"]["kv_blocks_in_use"] == 0  # all freed
+
+
+def test_prefill_bucket_compiles_cached_and_counted(gpt):
+    c = metrics_registry().counter("serving.prefill_bucket_compiles")
+    before = c.value
+    dec = PagedDecoder(gpt, max_length=32, decode_slots=2, block_size=8,
+                       prefill_buckets=[8, 16, 32])
+    for n in (3, 5, 7):  # all map to bucket 8 — ONE compile
+        t = dec.pool.try_admit(n + 2)
+        dec.prefill(np.zeros(n, np.int32), t)
+        dec.pool.free(t)
+    assert c.value == before + 1
+    t = dec.pool.try_admit(12 + 2)  # bucket 16 — second compile
+    dec.prefill(np.zeros(12, np.int32), t)
+    dec.pool.free(t)
+    assert c.value == before + 2
+
+
+# ------------------------------------------------- observability surface
+def test_serving_ledger_record_and_explain(gpt, tmp_path):
+    import dataclasses
+
+    ff = _gpt(ledger="on", ledger_dir=str(tmp_path))
+    eng = InferenceEngine()
+    eng.register_generator(ff, name="lm", decode_slots=2, block_size=8,
+                           max_length=32)
+    rng = np.random.default_rng(23)
+    futs = [eng.generate_async("lm", rng.integers(0, V, (3,))
+                               .astype(np.int32), m) for m in (4, 2, 6)]
+    for f in futs:
+        f.result(timeout=120)
+    eng.stop()
+    from flexflow_tpu.obs.ledger import load_runs
+
+    recs = load_runs(str(tmp_path), kind="serving")
+    assert len(recs) == 1
+    rec = recs[0]
+    assert rec["serving_engine"] == "continuous"
+    assert rec["completed"] == 3
+    assert rec["tokens"] == 12
+    for phase in ("queue_wait", "prefill", "decode"):
+        assert {"p50", "p99"} <= set(rec["phases"][phase]), phase
+    assert rec["kv"]["high_water"] >= 1
+    assert rec["knobs"]["decode_slots"] == 2
+    assert rec["model_sig"]
+    # explain_run narrates it: dominant phase + degradation + kv
+    from tools.explain_run import explain
+
+    doc = explain(run_id=rec["run_id"], ledger_dir=str(tmp_path))
+    assert doc["exit"] == 0
+    sv = doc["serving"]
+    assert sv["engine"] == "continuous"
+    assert sv["dominant_phase"] in ("queue_wait", "prefill", "decode")
+    assert sv["missing_phase_percentiles"] == []
+    # a continuous record MISSING its phase percentiles exits 1
+    from flexflow_tpu.obs import ledger as _ledger
+
+    broken = {k: v for k, v in rec.items()}
+    broken.pop("run_id")
+    broken["phases"] = {"queue_wait": rec["phases"]["queue_wait"]}
+    _ledger.record_run("serving", broken,
+                       config=dataclasses.replace(
+                           ff.config, ledger_dir=str(tmp_path)))
+    newest = _ledger.load_runs(str(tmp_path), kind="serving")[-1]
+    doc2 = explain(run_id=newest["run_id"], ledger_dir=str(tmp_path))
+    assert doc2["exit"] == 1
+    assert set(doc2["serving"]["missing_phase_percentiles"]) == \
+        {"prefill", "decode"}
+
+
+def test_sentinel_cohorts_serving_tokens_per_s(tmp_path):
+    """serve_bench's ledger records gate like fit records: same
+    (model_sig, decode_slots, block_size) cohort compares, a different
+    geometry is a different cohort, and a slowdown past the margin
+    regresses."""
+    from tools.perf_sentinel import run_sentinel
+
+    from flexflow_tpu.obs.ledger import record_bench
+
+    def rec(value, slots=4, block=8):
+        record_bench(
+            "serve_bench", {"ok": True},
+            perf={"metric": "serving.tokens_per_s", "value": value,
+                  "higher_is_better": True},
+            label="serve:sig0",
+            knobs={"model_sig": "sig0", "decode_slots": slots,
+                   "block_size": block},
+            config=FFConfig(ledger_dir=str(tmp_path)))
+
+    for v in (1000.0, 1040.0, 980.0):
+        rec(v)
+        time.sleep(0.002)  # ts_unix_s is ms-rounded: keep append order
+    rec(400.0)  # a real regression in the same cohort
+    time.sleep(0.002)
+    rec(5000.0, slots=8)  # different geometry: its own (new) cohort
+    out = run_sentinel(ledger_dir=str(tmp_path), margin=0.3)
+    serving_rows = [r for r in out["cohorts"]
+                    if r["metric"] == "serving.tokens_per_s"]
+    assert len(serving_rows) == 2  # geometry split the cohorts
+    verdicts = {r["verdict"] for r in serving_rows}
+    assert "regression" in verdicts  # the 400 tok/s drop trips
+    assert "no_baseline" in verdicts  # the new geometry has no priors
+    assert out["exit"] == 1
+
+
+def test_request_span_tree(gpt):
+    """request ⊃ queue_wait → prefill → decode → reply on the request's
+    own virtual track."""
+    from flexflow_tpu.obs.trace import configure_tracer, tracer
+
+    configure_tracer(enabled=True)
+    try:
+        sched = ContinuousBatchingScheduler(gpt, max_length=32,
+                                            decode_slots=2, block_size=8)
+        sched.generate(np.zeros(3, np.int32), 4)
+        sched.stop()
+        events = [e for e in tracer().events()
+                  if e.get("cat") == "serving"]
+        names = {e["name"] for e in events}
+        assert {"serving.request", "serving.queue_wait",
+                "serving.prefill", "serving.decode",
+                "serving.reply"} <= names
+        decode = [e for e in events if e["name"] == "serving.decode"]
+        assert decode[-1]["args"]["steps"] == 3
+    finally:
+        configure_tracer(enabled=False)

@@ -86,6 +86,107 @@ def test_generate_greedy_deterministic():
         gen.generate(prompt, max_new_tokens=100)
 
 
+# ------------------------------------------- partial batches (ragged arrival)
+def test_partial_batch_matches_narrow_compiled():
+    """A partial batch through a wide generator produces exactly what a
+    generator compiled at the narrow width produces (greedy) — the
+    scheduler never needs filler requests."""
+    ff = _build()
+    rng = np.random.default_rng(5)
+    prompt = rng.integers(0, V, (2, 4)).astype(np.int32)
+    wide = Generator(ff, max_length=16, batch_size=4)
+    narrow = Generator(ff, max_length=16, batch_size=2)
+    out_w = wide.generate(prompt, max_new_tokens=5)
+    out_n = narrow.generate(prompt, max_new_tokens=5)
+    assert out_w.shape == (2, 9)  # only the real rows come back
+    np.testing.assert_array_equal(out_w, out_n)
+    with pytest.raises(ValueError, match="compiled batch width"):
+        wide.generate(rng.integers(0, V, (5, 4)).astype(np.int32), 2)
+
+
+def test_partial_batch_mask_aware_sampling_per_row_seeds():
+    """Per-row seeds: each row draws from its own stream, so sampling is
+    independent of co-batched rows — swapping rows swaps outputs."""
+    ff = _build()
+    rng = np.random.default_rng(6)
+    prompts = rng.integers(0, V, (2, 4)).astype(np.int32)
+    gen = Generator(ff, max_length=16, batch_size=4)
+    a = gen.generate(prompts, 5, temperature=0.8, seed=[11, 22])
+    b = gen.generate(prompts[::-1].copy(), 5, temperature=0.8,
+                     seed=[22, 11])
+    np.testing.assert_array_equal(a, b[::-1])
+    # repeatable, and a wrong-length seed vector is rejected
+    np.testing.assert_array_equal(
+        a, gen.generate(prompts, 5, temperature=0.8, seed=[11, 22]))
+    with pytest.raises(ValueError, match="per-row seeds"):
+        gen.generate(prompts, 5, seed=[1, 2, 3])
+
+
+def test_partial_batch_eos_masking():
+    """done/eos bookkeeping covers only the real rows — inactive
+    padding slots never contribute tokens or draws."""
+    ff = _build()
+    rng = np.random.default_rng(8)
+    prompt = rng.integers(0, V, (1, 4)).astype(np.int32)
+    gen = Generator(ff, max_length=16, batch_size=4)
+    greedy = gen.generate(prompt, 4)
+    eos = int(greedy[0, 4])  # first generated token
+    out = gen.generate(prompt, 4, eos_id=eos)
+    assert out.shape == (1, 5)  # stopped right after eos
+    assert out[0, -1] == eos
+
+
+# ----------------------------------- exec-params cache (params versioning)
+def test_exec_params_cache_tracks_version_and_replacement():
+    """The bf16 cast cache re-derives on params replacement AND on
+    in-place mutation + bump_params_version() — and never pins the old
+    tree alive (the id()-reuse/staleness regression)."""
+    import gc
+    import weakref
+
+    import jax
+
+    ff = FFModel(FFConfig(batch_size=B, seed=0,
+                          compute_dtype="bfloat16"))
+    build_gpt(ff, B, S, CFG)
+    ff.compile(optimizer=SGDOptimizer(lr=0.01),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=[])
+    cm = ff.compiled
+    gen = Generator(ff, max_length=16)
+    rng = np.random.default_rng(9)
+    prompt = rng.integers(0, V, (B, 4)).astype(np.int32)
+    base = gen.generate(prompt, 5)
+    # cached: a second call reuses the same cast object
+    cast1 = gen._exec_params()
+    assert gen._exec_params() is cast1
+    # IN-PLACE weight surgery (one leaf swapped, tree object kept):
+    # the per-leaf identity check re-derives WITHOUT a bump
+    cm.params["lm_head"]["kernel"] = -np.asarray(
+        cm.params["lm_head"]["kernel"])
+    cast2 = gen._exec_params()
+    assert cast2 is not cast1
+    flipped = gen.generate(prompt, 5)
+    assert not np.array_equal(base, flipped)
+    # the explicit version bump invalidates too (checkpoint restore /
+    # guard rollback call it even though identity usually also changes)
+    cm.bump_params_version()
+    assert gen._exec_params() is not cast2
+    # REPLACEMENT without a bump: the weakref identity leg catches it
+    old_leaf_ref = weakref.ref(jax.tree_util.tree_leaves(cm.params)[0])
+    cm.params = jax.tree_util.tree_map(np.asarray, cm.params)
+    cast3 = gen._exec_params()
+    assert cast3 is not cast2
+    # and the cache does NOT pin the swapped-out tree alive
+    del cast1, cast2
+    gc.collect()
+    assert old_leaf_ref() is None, "old params tree leaked via the cache"
+    # guard rollback / checkpoint restore bump automatically
+    v = cm.params_version
+    cm.bump_params_version()
+    assert cm.params_version == v + 1
+
+
 def test_gpt_trains_on_copy_task():
     ff = FFModel(FFConfig(batch_size=16, epochs=12, seed=0))
     build_gpt(ff, 16, 8, GPTConfig(vocab_size=30, max_positions=16,

@@ -116,6 +116,7 @@ def explain(run_id: Optional[str] = None,
     rcn = attr.get("reconciliation") or {}
     div = rec.get("divergence") or {}
     pipe = rec.get("pipeline") or {}
+    serving = _serving_block(rec) if rec.get("kind") == "serving" else None
     # envelope verdict: which engine ran, and WHY a compiled-eligible
     # mesh fell back (a fallback with no recorded reason is a bug in
     # the engine-selection path, not an explanation to prettify)
@@ -163,6 +164,7 @@ def explain(run_id: Optional[str] = None,
             "per_op_truncated": div.get("per_op_truncated"),
             "findings": div.get("findings"),
         } if div else None),
+        "serving": serving,
         "watchdog": rec.get("watchdog"),
         # fault-tolerance narrative: the TrainingGuard recovery block
         # (divergence restores + lr backoffs) and the fault-injection
@@ -179,10 +181,64 @@ def explain(run_id: Optional[str] = None,
     # Likewise a compiled-eligible mesh that SILENTLY fell back to the
     # host engine (no recorded reason): the engine-selection path lost
     # its honesty guarantee.
+    # A CONTINUOUS-engine serving record that served requests but lost
+    # its per-phase percentiles (queue_wait/prefill/decode) broke the
+    # engine's observability contract — same severity as a
+    # non-reconciling phase table.
     bad_attr = bool(attr and rcn and not rcn.get("reconciles"))
+    bad_serving = bool(serving
+                       and serving.get("missing_phase_percentiles"))
     doc["exit"] = 1 if (bad_attr
-                        or (envelope or {}).get("silent_fallback")) else 0
+                        or (envelope or {}).get("silent_fallback")
+                        or bad_serving) else 0
     return doc
+
+
+_SERVING_PHASES = ("queue_wait", "prefill", "decode")
+
+
+def _serving_block(rec: Dict) -> Dict:
+    """The serving narrative: which engine, where the latency went
+    (queue_wait vs prefill vs decode), shed/deadline counts, and the
+    kv-pool high-water mark. Classic-engine records (no phases/kv
+    surface) narrate only their identity — never a None-filled block."""
+    engine = rec.get("serving_engine") or "classic"
+    if engine != "continuous":
+        return {"engine": engine, "models": rec.get("models"),
+                "missing_phase_percentiles": []}
+    phases = rec.get("phases") or {}
+    means = {k: (phases.get(k) or {}).get("mean")
+             for k in _SERVING_PHASES}
+    present = {k: v for k, v in means.items()
+               if isinstance(v, (int, float))}
+    missing = []
+    if (rec.get("completed") or 0) > 0:
+        need = list(_SERVING_PHASES)
+        if not rec.get("decode_steps"):
+            need.remove("decode")  # a prefill-only session has no
+            #                        decode phase to report
+        for k in need:
+            block = phases.get(k) or {}
+            if not isinstance(block.get("p50"), (int, float)) \
+                    or not isinstance(block.get("p99"), (int, float)):
+                missing.append(k)
+    kv = rec.get("kv") or {}
+    return {
+        "engine": engine,
+        "model": rec.get("model"),
+        "completed": rec.get("completed"),
+        "tokens": rec.get("tokens"),
+        "tokens_per_s": rec.get("tokens_per_s"),
+        "phases": {k: phases.get(k) for k in _SERVING_PHASES
+                   if phases.get(k)},
+        "dominant_phase": (max(present, key=present.get)
+                           if present else None),
+        "shed": rec.get("shed"),
+        "deadline_rejects": rec.get("deadline_rejects"),
+        "kv_high_water": kv.get("high_water"),
+        "kv_capacity_blocks": kv.get("capacity_blocks"),
+        "missing_phase_percentiles": missing,
+    }
 
 
 # ------------------------------------------------------------ rendering
@@ -224,6 +280,36 @@ def _render_text(doc: Dict) -> str:
                 f"{env.get('requested_engine') or 'auto'}, mesh "
                 f"{'eligible' if env.get('compiled_mesh_eligible') else 'not eligible'} "
                 f"for compiled)")
+    sv = doc.get("serving")
+    if sv and sv["engine"] != "continuous":
+        lines.append(
+            f"serving: {sv['engine']} engine "
+            f"(models {sv.get('models')}; per-phase narration is the "
+            f"continuous engine's surface)")
+    elif sv:
+        lines.append(
+            f"serving: {sv['engine']} engine — {sv.get('completed')} "
+            f"request(s), {sv.get('tokens')} token(s), "
+            f"{sv.get('tokens_per_s')} tokens/s")
+        if sv.get("dominant_phase"):
+            lines.append(
+                f"dominant latency phase: {sv['dominant_phase']} "
+                + " ".join(
+                    f"{k}(p50={p['p50']:.4f}s p99={p['p99']:.4f}s)"
+                    for k, p in (sv.get("phases") or {}).items()
+                    if isinstance(p, dict) and "p50" in p))
+        lines.append(
+            f"degradation: {sv.get('shed') or 0} shed, "
+            f"{sv.get('deadline_rejects') or 0} deadline reject(s); "
+            f"kv pool high water {sv.get('kv_high_water')}"
+            f"/{sv.get('kv_capacity_blocks')} blocks")
+        if sv.get("missing_phase_percentiles"):
+            lines.append(
+                f"serving record MISSING phase percentiles "
+                f"{sv['missing_phase_percentiles']} — the continuous "
+                f"engine's observability contract broke (exit 1)")
+    # (classic records end after the identity line: their None-free
+    # surface is counters/percentiles on the record itself)
     if doc.get("phases"):
         from flexflow_tpu.obs.attribution import format_phase_table
 

@@ -26,6 +26,13 @@ Each cohort row carries the newest run's attributed ``dominant_phase``
 ``input_wait`` points at the feed, ``collective_transfer`` at comm,
 ``pipeline_bubble`` at the schedule — instead of just a ratio.
 
+Serving throughput gates like fit throughput: ``tools/serve_bench.py``
+appends a bench record whose perf handle is ``serving.tokens_per_s``
+with ``model_sig`` + ``decode_slots`` + ``block_size`` in the cohort
+knobs, so a continuous-batching regression trips the same wire (and a
+different decode-slot width or pool geometry is a different cohort,
+never a false comparison).
+
 The ``exec`` and ``watchdog`` blocks surface the newest ledger
 record's executable telemetry (flops/bytes/peak memory per program, or
 its explicit ``unavailable`` reason) and watchdog state plus the
@@ -88,6 +95,7 @@ def _judge_cohort(key: str, runs: List[Dict], margin: float,
     prior = [float(r["perf"]["value"]) for r in runs[:-1]]
     perf = newest["perf"]
     row: Dict = {
+        "kind": newest.get("kind"),
         "metric": perf.get("metric"),
         "label": newest.get("label") or newest.get("model_sig"),
         "mesh": newest.get("mesh"),
