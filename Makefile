@@ -67,23 +67,37 @@
 #   make explain  — explain the newest ledger run: attribution phase
 #                   breakdown (must reconcile with the measured step
 #                   time), top ops measured-vs-predicted, divergence
-#                   outliers, sentinel cohort trend; one JSON line
+#                   outliers, sentinel cohort trend + knob diff vs the
+#                   cohort family's best prior run; one JSON line
 #                   (tools/explain_run.py --latest --json)
+#   make advise   — perf advisor (tools/perf_advisor.py): maps the
+#                   newest fit/serving records' dominant phases (and
+#                   every sentinel regression cohort) to ranked,
+#                   schema-validated knob deltas with predicted phase
+#                   deltas; one JSON line; exit 1 on a malformed report
+#                   or a regression verdict with zero applicable
+#                   suggestions. `--apply-top N` (manual) A/B-benchmarks
+#                   the top suggestions in child processes (interleaved
+#                   median-of-pair-ratios) and appends cohort-excluded
+#                   advisor_experiment ledger records
 
 PY ?= python
 CPU_MESH = JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
 .PHONY: ci native native-check lint concurrency-lint pcg-lint audit \
         test dryrun bench bench-fit bench-pipe bench-pipe-smoke \
-        serve-bench serve-bench-smoke obs-report sentinel chaos explain
+        serve-bench serve-bench-smoke obs-report sentinel chaos explain \
+        advise
 
 # sentinel runs AFTER obs-report so a fresh checkout's first ci already
 # has ledger records to judge (first run: no baseline -> clean exit);
 # chaos runs after sentinel (its fault matrix uses its own tmp ledger,
-# never the corpus the sentinel just judged); explain runs last and
-# narrates the newest of those records
+# never the corpus the sentinel just judged); explain narrates the
+# newest of those records and advise closes the loop — the dominant
+# phase mapped to ranked knob deltas over the same ledger
 ci: native native-check lint concurrency-lint test dryrun obs-report \
-    bench-pipe-smoke serve-bench-smoke sentinel chaos explain audit
+    bench-pipe-smoke serve-bench-smoke sentinel chaos explain advise \
+    audit
 
 lint:
 	$(PY) -c "from flexflow_tpu.analysis.hotpath_lint import main; \
@@ -147,3 +161,6 @@ chaos:
 
 explain:
 	$(CPU_MESH) $(PY) tools/explain_run.py --latest --json
+
+advise:
+	$(CPU_MESH) $(PY) tools/perf_advisor.py

@@ -204,6 +204,17 @@ class FFConfig:
     # rows in the attribution report's top-ops and divergence-outlier
     # rankings
     attribution_top_k: int = 8
+    # perf advisor (obs/advisor.py): "on" (default) maps each fit's
+    # attribution verdict (and each continuous-batching serving
+    # session's phase table) to ranked, concrete knob deltas — the
+    # dominant-phase rule table — attaches the report to
+    # fit_profile["advice"], and publishes it on the obs server's
+    # /advice endpoint. Pure-python walk over records the run already
+    # produced; "off" skips it. tools/perf_advisor.py is the
+    # ledger-wide tool (and the --apply-top auto-benchmark harness).
+    advisor: str = "on"
+    # ranked suggestions kept per advisor report
+    advisor_max_suggestions: int = 5
     # per-op cost corpus (obs/costcorpus.py): "on" times every compiled
     # op forward AND backward under its real mesh sharding after each
     # fit and appends featurized, dedup-keyed rows to
@@ -429,6 +440,10 @@ class FFConfig:
                 cfg.attribution = _next()
             elif a == "--attribution-top-k":
                 cfg.attribution_top_k = int(_next())
+            elif a == "--advisor":
+                cfg.advisor = _next()
+            elif a == "--advisor-max-suggestions":
+                cfg.advisor_max_suggestions = int(_next())
             elif a == "--cost-corpus":
                 cfg.cost_corpus = "on"
             elif a == "--cost-corpus-dir":

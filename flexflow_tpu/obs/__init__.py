@@ -110,6 +110,15 @@ from .attribution import (  # noqa: F401
     attribution_report,
     format_phase_table,
     maybe_attribute,
+    serving_attribution,
+)
+from .advisor import (  # noqa: F401
+    RULE_FAMILIES,
+    advise_record,
+    judge_experiment,
+    maybe_advise,
+    top_suggestion,
+    validate_report,
 )
 from .costcorpus import (  # noqa: F401
     append_rows,
@@ -121,7 +130,10 @@ from .costcorpus import (  # noqa: F401
 from .server import (  # noqa: F401
     ObsServer,
     configure_obs_server,
+    latest_advice,
+    latest_attribution,
     obs_server,
+    publish_advice,
     publish_attribution,
     stop_obs_server,
 )

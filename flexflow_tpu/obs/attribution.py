@@ -314,6 +314,43 @@ def maybe_attribute(ffmodel) -> None:
     publish_attribution(rec)
 
 
+SERVING_PHASES = ("queue_wait", "prefill", "decode")
+
+
+def serving_attribution(stats: Dict) -> Optional[Dict]:
+    """The serving analog of the fit phase table: a uniform
+    queue_wait/prefill/decode record built from a continuous-batching
+    scheduler's :meth:`stats` snapshot, so serving-only processes have
+    the same ``/attribution`` surface (and the perf advisor a uniform
+    input) fit processes do. Phase rows keep the session percentile
+    blocks (count/mean/p50/p99); ``dominant_phase`` is the largest
+    mean. None when the session has not measured any phase yet."""
+    phases: Dict[str, Dict] = {}
+    for name in SERVING_PHASES:
+        block = (stats.get("phases") or {}).get(name)
+        if isinstance(block, dict) and isinstance(
+                block.get("mean"), (int, float)):
+            phases[name] = dict(block)
+    if not phases:
+        return None
+    means = {n: float(p["mean"]) for n, p in phases.items()}
+    rec = {
+        "schema": ATTRIBUTION_SCHEMA,
+        "kind": "serving",
+        "engine": stats.get("serving_engine"),
+        "model": stats.get("model"),
+        "phases": phases,
+        "phase_order": [n for n in SERVING_PHASES if n in phases],
+        "dominant_phase": max(means, key=lambda n: means[n]),
+        "tokens_per_s": stats.get("tokens_per_s"),
+        "completed": stats.get("completed"),
+        "knobs": stats.get("knobs"),
+        "kv": stats.get("kv"),
+    }
+    metrics_registry().counter("attribution.serving_reports").inc()
+    return rec
+
+
 def attribution_report(ffmodel) -> Optional[Dict]:
     """The last fit's attribution record, or None."""
     fp = getattr(ffmodel, "fit_profile", None) or {}
@@ -343,6 +380,7 @@ def format_phase_table(rec: Dict) -> str:
 
 __all__ = [
     "ATTRIBUTION_SCHEMA", "DEFAULT_TOLERANCE", "PHASES",
-    "attribute_fit", "attribution_mode", "attribution_report",
-    "format_phase_table", "maybe_attribute",
+    "SERVING_PHASES", "attribute_fit", "attribution_mode",
+    "attribution_report", "format_phase_table", "maybe_attribute",
+    "serving_attribution",
 ]

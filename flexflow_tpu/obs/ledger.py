@@ -402,6 +402,11 @@ def record_fit(ff, kind: str = "fit") -> Optional[Dict]:
                 prof["divergence"], ff.config)
         if prof.get("attribution"):
             rec["attribution"] = prof["attribution"]
+        if prof.get("advice"):
+            # the advisor's ranked knob deltas ride the record so
+            # explain_run/sentinel can narrate WHAT to change, not just
+            # how much slower the run got
+            rec["advice"] = prof["advice"]
         if prof.get("cost_corpus"):
             rec["cost_corpus"] = prof["cost_corpus"]
         if prof.get("pipeline"):

@@ -14,8 +14,13 @@ the stdlib alone (``http.server``; the repo's zero-dep contract):
 ``/runs``          run-ledger tail as JSON (``?n=`` bounds it, def. 20)
 ``/trace``         the tracer ring as a Chrome trace-event JSON
                    download (open in chrome://tracing / Perfetto)
-``/attribution``   the latest AttributionReport (404 until a fit with
-                   ``config.attribution`` on has run)
+``/attribution``   the latest attribution report — the fit phase table,
+                   or (``?kind=serving`` / fit-less processes) the
+                   serving queue_wait/prefill/decode table; 404 until
+                   either exists
+``/advice``        the latest perf-advisor report (ranked knob deltas
+                   for the dominant phase; 404 until a fit/serving
+                   session or ``tools/perf_advisor.py`` published one)
 =================  ====================================================
 
 Threading discipline (checked by analysis/concurrency_check.py): ONE
@@ -44,27 +49,52 @@ from .metrics import metrics_registry
 
 DEFAULT_RUNS_TAIL = 20
 
-# latest AttributionReport published by the fit hook + the ledger dir
+# latest reports published by the fit/serving hooks + the ledger dir
 # the configuring model resolved (a --ledger-dir override must be the
 # directory /runs scrapes, not the env/default fallback); one lock
-# guards both slots (written by whichever thread runs fit/compile,
-# read by handler threads)
+# guards every slot (written by whichever thread runs fit/compile or
+# the serving scheduler, read by handler threads). Attribution keeps
+# one slot PER KIND ("fit" and "serving") so a process doing both
+# never loses one surface to the other.
 _attr_mu = threading.Lock()
-_LATEST_ATTRIBUTION: Optional[Dict] = None
+_LATEST_ATTRIBUTION: Dict[str, Dict] = {}
+_LATEST_ADVICE: Optional[Dict] = None
 _LEDGER_DIR: Optional[str] = None
 
 
-def publish_attribution(report: Dict) -> None:
-    """Make a fit's AttributionReport visible on ``/attribution``."""
-    global _LATEST_ATTRIBUTION
+def publish_attribution(report: Dict, kind: Optional[str] = None) -> None:
+    """Make an attribution report visible on ``/attribution``. ``kind``
+    defaults to the report's own ``kind`` field ("fit" when absent —
+    the historical fit-report contract); continuous-batching serving
+    sessions publish under ``"serving"``."""
+    k = kind or report.get("kind") or "fit"
     with _attr_mu:
-        _LATEST_ATTRIBUTION = dict(report)
+        _LATEST_ATTRIBUTION[k] = dict(report)
 
 
-def latest_attribution() -> Optional[Dict]:
+def latest_attribution(kind: Optional[str] = None) -> Optional[Dict]:
+    """The latest attribution report: an explicit ``kind``'s slot, or —
+    unqualified — the fit report when one exists, else the serving
+    report (so serving-only processes stop 404ing)."""
     with _attr_mu:
-        return (dict(_LATEST_ATTRIBUTION)
-                if _LATEST_ATTRIBUTION is not None else None)
+        if kind is not None:
+            rec = _LATEST_ATTRIBUTION.get(kind)
+        else:
+            rec = (_LATEST_ATTRIBUTION.get("fit")
+                   or _LATEST_ATTRIBUTION.get("serving"))
+        return dict(rec) if rec is not None else None
+
+
+def publish_advice(report: Dict) -> None:
+    """Make the newest advisor report visible on ``/advice``."""
+    global _LATEST_ADVICE
+    with _attr_mu:
+        _LATEST_ADVICE = dict(report)
+
+
+def latest_advice() -> Optional[Dict]:
+    with _attr_mu:
+        return dict(_LATEST_ADVICE) if _LATEST_ADVICE is not None else None
 
 
 def _publish_ledger_dir(dirpath: Optional[str]) -> None:
@@ -122,11 +152,24 @@ class _Handler(BaseHTTPRequestHandler):
                                  "displayTimeUnit": "ms",
                                  "metadata": tr.export_metadata()})
             elif url.path == "/attribution":
-                rec = latest_attribution()
+                q = parse_qs(url.query)
+                kind = (q.get("kind") or [None])[0]
+                rec = latest_attribution(kind)
                 if rec is None:
                     self._send_json(
                         {"unavailable": "no attribution report yet — "
-                         "run a fit with config.attribution='on'"},
+                         "run a fit with config.attribution='on' or a "
+                         "continuous-batching serving session"},
+                        status=404)
+                else:
+                    self._send_json(rec)
+            elif url.path == "/advice":
+                rec = latest_advice()
+                if rec is None:
+                    self._send_json(
+                        {"unavailable": "no advisor report yet — run a "
+                         "fit with config.advisor='on', a serving "
+                         "session, or tools/perf_advisor.py"},
                         status=404)
                 else:
                     self._send_json(rec)
@@ -134,7 +177,7 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(
                     {"error": f"unknown path {url.path!r}",
                      "endpoints": ["/metrics", "/healthz", "/runs",
-                                   "/trace", "/attribution"]},
+                                   "/trace", "/attribution", "/advice"]},
                     status=404)
         except Exception as e:  # noqa: BLE001 — a bad scrape must not
             reg.counter("obs_server.errors").inc()  # kill the server
@@ -345,6 +388,7 @@ def stop_obs_server() -> None:
 
 __all__ = [
     "DEFAULT_RUNS_TAIL", "ObsServer", "configure_obs_server",
-    "latest_attribution", "obs_server", "publish_attribution",
-    "server_port_knob", "stop_obs_server",
+    "latest_advice", "latest_attribution", "obs_server",
+    "publish_advice", "publish_attribution", "server_port_knob",
+    "stop_obs_server",
 ]

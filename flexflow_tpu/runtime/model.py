@@ -2161,6 +2161,19 @@ class FFModel:
 
             print(format_phase_table(self.fit_profile["attribution"]),
                   flush=True)
+        # perf advisor (config.advisor; obs/advisor.py): the dominant
+        # phase mapped to ranked knob deltas — fit_profile["advice"] +
+        # the obs server's /advice endpoint
+        from ..obs.advisor import maybe_advise
+
+        maybe_advise(self)
+        if self.config.profiling and (self.fit_profile or {}).get(
+                "advice"):
+            top = self.fit_profile["advice"]["suggestions"][0]
+            print(f"[advise] {top['phase']} -> {top['knob']}="
+                  f"{top['proposed']} (expected "
+                  f"-{top['expected']['step_delta_frac'] * 100:.1f}% "
+                  f"step time, {top['expected']['basis']})", flush=True)
         # per-op cost corpus (config.cost_corpus; obs/costcorpus.py):
         # measured fwd+bwd rows for the learned cost model's flywheel
         from ..obs.costcorpus import maybe_collect_corpus

@@ -88,6 +88,11 @@ from ..obs.attribution import (  # noqa: F401
     attribute_fit,
     attribution_report,
     format_phase_table,
+    serving_attribution,
+)
+from ..obs.advisor import (  # noqa: F401
+    advise_record,
+    top_suggestion,
 )
 from ..obs.costcorpus import (  # noqa: F401
     corpus_dir,
@@ -97,6 +102,8 @@ from ..obs.costcorpus import (  # noqa: F401
 from ..obs.server import (  # noqa: F401
     ObsServer,
     configure_obs_server,
+    latest_advice,
+    latest_attribution,
     obs_server,
 )
 from ..utils.dot import DotFile

@@ -70,6 +70,11 @@ _DECODE_RETRY = RetryPolicy(max_attempts=3, base_delay_s=0.002,
 # metrics registry's reservoirs)
 _PHASE_WINDOW = 4096
 
+# serving-attribution publication cadence (retirements between
+# refreshes of the obs server's /attribution surface; the first
+# retirement and stop() always publish)
+_PUBLISH_EVERY = 16
+
 
 def _percentiles(xs) -> Optional[Dict]:
     from ..obs.metrics import nearest_rank_percentile
@@ -572,6 +577,15 @@ class ContinuousBatchingScheduler:
         reg.counter("serving.batches").inc()
         self._record_request_spans(req, now)
         req.future.set_result(out)
+        # publish AFTER the future resolves (telemetry must not ride the
+        # client-visible latency) and throttled: the first retirement
+        # arms the /attribution surface immediately, then every
+        # _PUBLISH_EVERY-th refreshes it; stop() publishes the final
+        # table either way — eventual freshness, not per-request sorts
+        with self._mu:
+            completed = self._completed
+        if completed % _PUBLISH_EVERY == 1:
+            self._publish_attribution()
 
     # ---- observability -----------------------------------------------------
     def _record_request_spans(self, req: GenerationRequest,
@@ -639,6 +653,23 @@ class ContinuousBatchingScheduler:
             },
         }
 
+    def _publish_attribution(self) -> None:
+        """Serving attribution parity: keep the obs server's
+        ``/attribution`` surface current for this session (fit runs
+        publish their phase table from the fit tail; continuous
+        sessions publish queue_wait/prefill/decode here — on the first
+        retirement, every ``_PUBLISH_EVERY`` after, and at session
+        end — so a serving-only process never 404s)."""
+        try:
+            from ..obs.attribution import serving_attribution
+            from ..obs.server import publish_attribution
+
+            rec = serving_attribution(self.stats())
+            if rec is not None:
+                publish_attribution(rec, kind="serving")
+        except Exception:  # noqa: BLE001 — telemetry never fails serving
+            metrics_registry().counter("serving.obs_errors").inc()
+
     def _record_session(self) -> None:
         """One serving ledger record per scheduler session (stop())."""
         from ..obs.ledger import model_context, record_serving
@@ -650,6 +681,19 @@ class ContinuousBatchingScheduler:
                 extra["model_sig"] = ctx["model_sig"]
         except Exception:  # noqa: BLE001 — telemetry never kills stop
             pass
+        self._publish_attribution()
+        # close the advisor loop for serving-only processes: the
+        # session's phase table is an advisable record — publish the
+        # ranked knob deltas on /advice next to the phase table
+        try:
+            from ..obs.advisor import advise_record
+            from ..obs.server import publish_advice
+
+            report = advise_record(dict(extra))
+            if report is not None:
+                publish_advice(report)
+        except Exception:  # noqa: BLE001 — advice never kills stop
+            metrics_registry().counter("advisor.errors").inc()
         record_serving(extra, config=self._ff.config)
 
 

@@ -27,6 +27,7 @@ Memory accounting mirrors the reference's memory-aware search inputs
 from __future__ import annotations
 
 import dataclasses
+import json
 from typing import Dict, List, Optional, Tuple
 
 from ..ffconst import OpType
@@ -510,6 +511,94 @@ def pipeline_schedule_candidates(requested: str, interleave: int,
     if requested == "interleaved":
         return [("interleaved", ilv)]
     return [(requested, 1)]
+
+
+def schedule_bubble_candidates(cur_schedule: Optional[str],
+                               cur_interleave: int, num_stages: int,
+                               num_microbatches: int, n_ops: int,
+                               bwd_ratio: float = 2.0) -> List[Dict]:
+    """Candidate schedule/microbatch moves and their predicted bubble
+    fractions — the perf advisor's ``pipeline_bubble`` pricing. Reuses
+    the schedule ranker's candidate construction
+    (:func:`pipeline_schedule_candidates`) and the tick-table bubble
+    model, plus one microbatch-doubling move on the CURRENT schedule
+    (``grad_accum_steps`` folds into the microbatch count, so the move
+    is a knob, not a semantic change). Rows sort by bubble ascending
+    then (schedule, interleave) — deterministic for suggestion ranking."""
+    from ..parallel.schedule import ScheduleError, build_schedule
+
+    rows: List[Dict] = []
+    cands = pipeline_schedule_candidates(
+        "auto", max(2, int(cur_interleave or 1)), num_stages, n_ops)
+    for kind, V in cands:
+        if kind == cur_schedule and V == max(1, int(cur_interleave or 1)):
+            continue
+        try:
+            sched = build_schedule(kind, num_stages, num_microbatches, V)
+        except ScheduleError:
+            continue
+        rows.append({"schedule": kind, "interleave": V,
+                     "num_microbatches": num_microbatches,
+                     "bubble_fraction": round(
+                         sched.bubble_fraction(bwd_ratio), 6)})
+    if cur_schedule:
+        try:
+            sched = build_schedule(cur_schedule, num_stages,
+                                   2 * num_microbatches,
+                                   max(1, int(cur_interleave or 1)))
+            rows.append({"schedule": cur_schedule,
+                         "interleave": max(1, int(cur_interleave or 1)),
+                         "num_microbatches": 2 * num_microbatches,
+                         "bubble_fraction": round(
+                             sched.bubble_fraction(bwd_ratio), 6)})
+        except ScheduleError:
+            pass
+    rows.sort(key=lambda r: (r["bubble_fraction"], r["schedule"],
+                             r["interleave"], r["num_microbatches"]))
+    return rows
+
+
+def ring_allreduce_factor(degree: int) -> float:
+    """The ring all-reduce's bytes-on-the-wire factor over a degree-d
+    axis: each shard moves ``2 (d-1)/d`` of the payload across its ICI
+    link (reduce-scatter + all-gather). 0 for a trivial axis."""
+    d = int(degree)
+    return 0.0 if d <= 1 else 2.0 * (d - 1) / d
+
+
+def mesh_reshape_candidates(axes: Dict[str, int]) -> List[Dict]:
+    """Same-device-count mesh reshapes that shrink the data-axis
+    gradient all-reduce, ranked by the ring-factor ratio vs the current
+    mesh — the perf advisor's ``collective_transfer`` pricing. Moves
+    factors of the data degree onto a pipe or model axis; the NEW axis's
+    own traffic (stage boundaries, activation collectives) is not priced
+    here — the advisor says so and the A/B bench is the verdict. Keeps
+    at least data degree 2 (eliminating data parallelism entirely trades
+    compute shape, not just comm, and is out of a knob-advisor's
+    scope)."""
+    axes = {a: int(s) for a, s in (axes or {}).items() if int(s) > 1}
+    d = int(axes.get("data", 1))
+    if d < 4:  # nothing to split while keeping data >= 2
+        return []
+    cur = ring_allreduce_factor(d)
+    rows: List[Dict] = []
+    f = 2
+    while d % f == 0 and d // f >= 2:
+        for family in ("pipe", "model"):
+            new = dict(axes)
+            new["data"] = d // f
+            new[family] = int(axes.get(family, 1)) * f
+            rows.append({
+                "mesh": new,
+                "family": family,
+                "data_degree": d // f,
+                "allreduce_factor_ratio": round(
+                    ring_allreduce_factor(d // f) / cur, 6),
+            })
+        f *= 2
+    rows.sort(key=lambda r: (r["allreduce_factor_ratio"],
+                             json.dumps(sorted(r["mesh"].items()))))
+    return rows
 
 
 def compiled_envelope_ok(axis_sizes: Dict[str, int],

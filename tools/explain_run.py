@@ -12,8 +12,18 @@ or ONE JSON line (``--json``)::
      "reconciliation": {"reconciles": true, ...},
      "dominant_phase": ..., "top_ops": [...],
      "divergence_outliers": [...], "divergence": {...},
-     "cohort": {"runs": N, "baseline": ..., "ratio": ..., "verdict": ...},
+     "cohort": {"runs": N, "baseline": ..., "ratio": ..., "verdict": ...,
+                "best_prior": {"run_id": ..., "value": ...,
+                               "knob_diff": {knob: {"this","best"}}}},
+     "advice": {"dominant_phase": ..., "suggestions": [...]},
+     "advisor_experiments": [{"verdict": "accepted"|"rejected", ...}],
      "exit": 0}
+
+The cohort block's ``best_prior`` diffs this run's knobs against the
+best run of the same (kind, metric, model, backend) FAMILY — what
+changed, not just how much slower — and ``advice`` carries the perf
+advisor's ranked knob deltas with any recorded A/B experiment verdicts
+(predicted vs measured) alongside.
 
 Exit status 1 when no record matches, or the selected record's phase
 table fails its reconciliation check (a table that does not telescope
@@ -89,12 +99,62 @@ def _cohort_trend(rec: Dict, runs: List[Dict]) -> Dict:
     }
     if not prior:
         out["verdict"] = "no_baseline"
+        out.update(_best_prior_knob_diff(rec, runs))
         return out
     baseline = _median(prior)
     out["baseline"] = round(baseline, 6)
     out["ratio"] = (round(out["value"] / baseline, 4)
                     if baseline > 0 else None)
     out["verdict"] = "ok"
+    out.update(_best_prior_knob_diff(rec, runs))
+    return out
+
+
+def _best_prior_knob_diff(rec: Dict, runs: List[Dict]) -> Dict:
+    """WHAT changed, not just how much slower: the newest run diffed
+    against the best prior run of its knob FAMILY — same (kind, metric,
+    model, backend) with knobs and mesh free, the space the advisor
+    tunes over (the strict sentinel cohort pins the knobs, so a knob
+    regression is invisible to the within-cohort ratio). Reuses the
+    ledger's ``model_context`` knob fields: the diff walks the union of
+    both records' knob keys."""
+    perf = rec.get("perf") or {}
+    rec_ts = rec.get("ts_unix_s")
+    fam = [r for r in runs
+           if r.get("run_id") != rec.get("run_id")
+           and not r.get("faults")
+           # "prior" means prior: when explaining an older record, a
+           # run appended after it must not pose as its baseline
+           and (rec_ts is None
+                or (r.get("ts_unix_s") or 0) <= rec_ts)
+           and r.get("kind") == rec.get("kind")
+           and r.get("kind") != "advisor_experiment"
+           and (r.get("perf") or {}).get("metric") == perf.get("metric")
+           and (r.get("label") or r.get("model_sig"))
+           == (rec.get("label") or rec.get("model_sig"))
+           and (r.get("machine") or {}).get("backend")
+           == (rec.get("machine") or {}).get("backend")
+           and isinstance((r.get("perf") or {}).get("value"),
+                          (int, float))]
+    if not fam:
+        return {}
+    higher = bool(perf.get("higher_is_better", True))
+    best = (max if higher else min)(
+        fam, key=lambda r: (float(r["perf"]["value"]),
+                            r.get("ts_unix_s") or 0))
+    ours = rec.get("knobs") or {}
+    theirs = best.get("knobs") or {}
+    diff = {k: {"this": ours.get(k), "best": theirs.get(k)}
+            for k in sorted(set(ours) | set(theirs))
+            if ours.get(k) != theirs.get(k)}
+    out: Dict = {"best_prior": {
+        "run_id": best.get("run_id"),
+        "value": round(float(best["perf"]["value"]), 6),
+        "knob_diff": diff,
+    }}
+    if (rec.get("mesh") or {}) != (best.get("mesh") or {}):
+        out["best_prior"]["mesh_diff"] = {
+            "this": rec.get("mesh"), "best": best.get("mesh")}
     return out
 
 
@@ -172,6 +232,8 @@ def explain(run_id: Optional[str] = None,
         "guard": rec.get("guard"),
         "faults": rec.get("faults"),
         "cohort": _cohort_trend(rec, runs),
+        "advice": _advice_block(rec),
+        "advisor_experiments": _experiments_for(rec, runs),
         "ledger": {"dir": ledger_dir or _ledger_dir(),
                    "runs": len(runs),
                    "corrupt_lines": scan["corrupt_lines"]},
@@ -192,6 +254,62 @@ def explain(run_id: Optional[str] = None,
                         or (envelope or {}).get("silent_fallback")
                         or bad_serving) else 0
     return doc
+
+
+def _advice_block(rec: Dict) -> Optional[Dict]:
+    """The perf advisor's ranked knob deltas for this record: the
+    record's own ``advice`` block when the fit carried one, else a
+    fresh rule-table pass (serving records, older corpora)."""
+    adv = rec.get("advice")
+    if not adv:
+        try:
+            from flexflow_tpu.obs.advisor import advise_record
+
+            adv = advise_record(rec, max_suggestions=3)
+        except Exception:  # noqa: BLE001 — advice never breaks explain
+            return None
+    if not adv:
+        return None
+    return {
+        "dominant_phase": adv.get("dominant_phase"),
+        "suggestions": [
+            {k: s.get(k) for k in ("rank", "phase", "family", "knob",
+                                   "current", "proposed", "expected",
+                                   "applicable")}
+            for s in (adv.get("suggestions") or [])[:3]],
+    }
+
+
+def _experiments_for(rec: Dict, runs: List[Dict]) -> List[Dict]:
+    """Advisor A/B experiment outcomes targeting this record's label —
+    the measured half of the advice loop (predicted vs measured delta,
+    accepted/rejected)."""
+    label = rec.get("label") or rec.get("model_sig")
+    out = []
+    for r in runs:
+        if r.get("kind") != "advisor_experiment":
+            continue
+        # match by label when the record has one, else ONLY by target
+        # run id — a label-less record must not adopt every experiment
+        # in the ledger
+        if label is not None:
+            if r.get("label") != label \
+                    and r.get("target_run_id") != rec.get("run_id"):
+                continue
+        elif r.get("target_run_id") != rec.get("run_id"):
+            continue
+        exp = r.get("experiment") or {}
+        out.append({
+            "run_id": r.get("run_id"),
+            "suggestion_id": exp.get("suggestion_id"),
+            "phase": exp.get("phase"),
+            "verdict": r.get("verdict") or exp.get("verdict"),
+            "phase_ratio": exp.get("phase_ratio"),
+            "metric_ratio": exp.get("metric_ratio"),
+            "predicted": exp.get("predicted"),
+            "measured": exp.get("measured"),
+        })
+    return out[-5:]
 
 
 _SERVING_PHASES = ("queue_wait", "prefill", "decode")
@@ -377,6 +495,40 @@ def _render_text(doc: Dict) -> str:
             f"(ratio {c['ratio']}); recent {c['trend']}")
     else:
         lines.append(f"cohort trend: {c.get('verdict')}")
+    bp = c.get("best_prior")
+    if bp:
+        if bp.get("knob_diff"):
+            changed = ", ".join(
+                f"{k}: {v['best']} -> {v['this']}"
+                for k, v in bp["knob_diff"].items())
+            lines.append(
+                f"vs best prior ({bp['run_id']}, value {bp['value']}): "
+                f"knobs changed — {changed}")
+        elif bp.get("mesh_diff"):
+            lines.append(
+                f"vs best prior ({bp['run_id']}, value {bp['value']}): "
+                f"mesh changed {bp['mesh_diff']['best']} -> "
+                f"{bp['mesh_diff']['this']}")
+        else:
+            lines.append(
+                f"vs best prior ({bp['run_id']}, value {bp['value']}): "
+                f"same knobs — the delta is code or machine state")
+    adv = doc.get("advice")
+    if adv and adv.get("suggestions"):
+        lines.append(f"advice (dominant phase {adv.get('dominant_phase')}):")
+        for s in adv["suggestions"]:
+            exp = s.get("expected") or {}
+            lines.append(
+                f"  #{s.get('rank')} {s['phase']} -> {s['knob']}="
+                f"{s['proposed']} (expected "
+                f"-{(exp.get('step_delta_frac') or 0) * 100:.1f}%, "
+                f"{exp.get('basis')})")
+    for e in doc.get("advisor_experiments") or []:
+        lines.append(
+            f"experiment {e.get('suggestion_id')}: {e.get('verdict')} "
+            f"— targeted {e.get('phase')} ratio {e.get('phase_ratio')} "
+            f"(predicted -{(e.get('predicted') or {}).get('step_delta_frac')}"
+            f", measured -{(e.get('measured') or {}).get('phase_delta_frac')})")
     return "\n".join(lines)
 
 

@@ -24,6 +24,7 @@ Exercises the full observability surface end to end — the CI smoke for
                      "phases": {...}, "top_ops": [...]},
      "pipeline": {"schedule": ..., "engine": ..., "dispatches_per_step": ...},
      "ledger": {"dir": ..., "runs": N, "kinds": [...]},
+     "sentinel": {"judged": N, "no_baseline": N, "regressions": N},
      "exec": {"programs": {name: {"flops": ..., "bytes_accessed": ...,
               "peak_bytes": ...} or {"unavailable": reason}}, ...},
      "watchdog": {"enabled": true, "sources_seen": [...], "dumps": 0},
@@ -166,6 +167,10 @@ def run_report(samples: int = 64, epochs: int = 2, requests: int = 4,
     # tier-1 smoke) does not keep a monitor thread — and its 60s default
     # threshold — running under the rest of the suite
     watchdog().disarm()
+    # sentinel visibility: thin-baseline cohorts are NOT vacuously
+    # green — count them here (and on stderr) so an empty trend line
+    # (e.g. a fresh BENCH trajectory) is visible in make ci output
+    sentinel_block = _sentinel_counts()
     exec_ok = bool(exec_block.get("programs")) and all(
         any(k in b for k in ("flops", "bytes_accessed", "peak_bytes",
                              "unavailable"))
@@ -203,12 +208,41 @@ def run_report(samples: int = 64, epochs: int = 2, requests: int = 4,
                      ("schedule", "engine", "dispatches_per_step",
                       "bubble_fraction")} if pipeline else {},
         "ledger": ledger_block,
+        "sentinel": sentinel_block,
         "exec": exec_block,
         "watchdog": wd_block,
         "steps_per_s": report.get("steps_per_s"),
         "missing_metrics": missing,
         "exit": 0 if ok else 1,
     }
+
+
+def _sentinel_counts() -> dict:
+    """One-line cohort visibility: how many ledger cohorts the sentinel
+    can actually judge vs how many are silently baseline-less."""
+    import importlib.util
+
+    try:
+        spec = importlib.util.spec_from_file_location(
+            "perf_sentinel_for_report",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "perf_sentinel.py"))
+        sent = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(sent)
+        s = sent.run_sentinel()
+        block = {"verdict": s.get("verdict"),
+                 "judged": s.get("judged", 0),
+                 "no_baseline": s.get("no_baseline", 0),
+                 "regressions": len(s.get("regressions") or [])}
+    except Exception as e:  # noqa: BLE001 — visibility, not a gate
+        block = {"error": f"{type(e).__name__}: {e}"}
+    nb = block.get("no_baseline")
+    if nb:
+        print(f"[obs-report] sentinel: {nb} cohort(s) without a "
+              f"baseline (judged {block.get('judged', 0)}) — thin "
+              f"trend lines are NOT vacuously green", file=sys.stderr,
+              flush=True)
+    return block
 
 
 def main(argv=None) -> int:

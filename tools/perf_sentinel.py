@@ -24,7 +24,14 @@ all-new cohorts exit 0 with ``"verdict": "no_baseline"``.
 Each cohort row carries the newest run's attributed ``dominant_phase``
 (obs/attribution.py) so a regression verdict names its suspect —
 ``input_wait`` points at the feed, ``collective_transfer`` at comm,
-``pipeline_bubble`` at the schedule — instead of just a ratio.
+``pipeline_bubble`` at the schedule — instead of just a ratio. A
+REGRESSION row additionally carries ``advice``: the perf advisor's
+top-ranked knob delta for the newest run (obs/advisor.py), so the
+verdict names its remedy too; ``tools/perf_advisor.py --apply-top``
+can then benchmark it. Advisor A/B probes (``advisor_experiment``
+records) are cohort-excluded like chaos runs, and the top-level
+``no_baseline`` count makes thin-baseline cohorts visible instead of
+vacuously green.
 
 Serving throughput gates like fit throughput: ``tools/serve_bench.py``
 appends a bench record whose perf handle is ``serving.tokens_per_s``
@@ -78,6 +85,11 @@ def _cohorts(runs: List[Dict]) -> Dict[str, List[Dict]]:
             # injected failures, not the code — never a baseline, never
             # a judged newest run (counted by the caller)
             continue
+        if r.get("kind") == "advisor_experiment" or r.get("advisor"):
+            # an advisor A/B probe: its measurements compare two knob
+            # settings on a canonical workload, not this repo's code —
+            # never a baseline (counted by the caller)
+            continue
         perf = r.get("perf") or {}
         if not isinstance(perf.get("value"), (int, float)) \
                 or perf["value"] <= 0 or not perf.get("metric"):
@@ -123,6 +135,16 @@ def _judge_cohort(key: str, runs: List[Dict], margin: float,
     elif (higher and ratio < 1.0 - margin) \
             or (not higher and ratio > 1.0 + margin):
         row["verdict"] = "regression"
+        # a regression row also names its REMEDY: the perf advisor's
+        # top-ranked knob delta for the newest run (None when the
+        # record carries no advisable phase table — e.g. bare bench
+        # records; tools/perf_advisor.py exits 1 on those)
+        try:
+            from flexflow_tpu.obs.advisor import top_suggestion
+
+            row["advice"] = top_suggestion(newest)
+        except Exception:  # noqa: BLE001 — advice never breaks the gate
+            row["advice"] = None
     else:
         row["verdict"] = "ok"
     return row
@@ -156,6 +178,7 @@ def run_sentinel(ledger_dir: Optional[str] = None,
     ]
     judged = [r for r in rows if r["verdict"] != "no_baseline"]
     regressions = [r for r in rows if r["verdict"] == "regression"]
+    no_baseline = [r for r in rows if r["verdict"] == "no_baseline"]
     ratios = [r["ratio"] for r in judged if r.get("ratio")]
 
     # ---- exec-telemetry block: the newest record that carries one ----
@@ -190,6 +213,10 @@ def run_sentinel(ledger_dir: Optional[str] = None,
     return {
         "cohorts": rows,
         "judged": len(judged),
+        # thin-baseline cohorts are NOT vacuously green — the count
+        # surfaces here and in tools/obs_report.py so an empty trend
+        # line (e.g. a fresh BENCH trajectory) is visible
+        "no_baseline": len(no_baseline),
         "overall_ratio": round(_median(ratios), 4) if ratios else None,
         "regressions": regressions,
         "margin": margin,
@@ -204,6 +231,12 @@ def run_sentinel(ledger_dir: Optional[str] = None,
             # chaos runs (ledger "faults" block) excluded from every
             # cohort — injected failures must not move perf baselines
             "faulted_excluded": sum(1 for r in runs if r.get("faults")),
+            # advisor A/B probes excluded likewise: a knob experiment's
+            # throughput is a comparison artifact, not a baseline
+            "advisor_excluded": sum(
+                1 for r in runs
+                if r.get("kind") == "advisor_experiment"
+                or r.get("advisor")),
             "by_kind": _by_kind(runs),
         },
         "exec": exec_block,
