@@ -63,7 +63,22 @@
 #                   torn reads, every accepted serving future resolves,
 #                   black-box dump on stall, bounded shed, zero overhead
 #                   when the plan is off; one JSON line; exit 1 on any
-#                   violated invariant
+#                   violated invariant. Includes the multihost subset
+#                   (mid-fit peer kill -> supervisor relaunch resumes
+#                   bit-identically; shrink N -> re-search + elastic
+#                   restore) via tools/mh_launch.py
+#   make mh-smoke — elastic multi-host matrix (tools/mh_launch.py
+#                   --smoke): real 2-process jax.distributed cohorts
+#                   under the supervisor — baseline agreement + one
+#                   deduped process_count-keyed ledger cohort, mid-fit
+#                   SIGKILL of one peer -> relaunch resumes
+#                   bit-identically from the sharded checkpoints
+#                   (strategy-cache warm hit), slow-peer hang ->
+#                   black-box dump + relaunch, seeded init-timeout
+#                   retry + sentinel cohort exclusion, shrunk-world
+#                   resume -> re-search (cache miss) + counted elastic
+#                   restore; one JSON line; exit 1 on any violated
+#                   invariant
 #   make explain  — explain the newest ledger run: attribution phase
 #                   breakdown (must reconcile with the measured step
 #                   time), top ops measured-vs-predicted, divergence
@@ -86,18 +101,23 @@ CPU_MESH = JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8
 
 .PHONY: ci native native-check lint concurrency-lint pcg-lint audit \
         test dryrun bench bench-fit bench-pipe bench-pipe-smoke \
-        serve-bench serve-bench-smoke obs-report sentinel chaos explain \
-        advise
+        serve-bench serve-bench-smoke obs-report sentinel chaos \
+        mh-smoke explain advise
 
 # sentinel runs AFTER obs-report so a fresh checkout's first ci already
 # has ledger records to judge (first run: no baseline -> clean exit);
 # chaos runs after sentinel (its fault matrix uses its own tmp ledger,
-# never the corpus the sentinel just judged); explain narrates the
-# newest of those records and advise closes the loop — the dominant
-# phase mapped to ranked knob deltas over the same ledger
+# never the corpus the sentinel just judged); mh-smoke's cohorts use
+# per-run scratch dirs likewise; explain narrates the newest of those
+# records and advise closes the loop — the dominant phase mapped to
+# ranked knob deltas over the same ledger
+# ci runs chaos with --skip-multihost: mh-smoke (next in line) runs the
+# FULL multihost matrix, so repeating its kill/shrink cohorts inside
+# chaos would only double the subprocess bill; standalone `make chaos`
+# keeps the complete default matrix
 ci: native native-check lint concurrency-lint test dryrun obs-report \
-    bench-pipe-smoke serve-bench-smoke sentinel chaos explain advise \
-    audit
+    bench-pipe-smoke serve-bench-smoke sentinel chaos-ci mh-smoke \
+    explain advise audit
 
 lint:
 	$(PY) -c "from flexflow_tpu.analysis.hotpath_lint import main; \
@@ -158,6 +178,13 @@ sentinel:
 
 chaos:
 	$(CPU_MESH) $(PY) tools/chaos_bench.py
+
+.PHONY: chaos-ci
+chaos-ci:
+	$(CPU_MESH) $(PY) tools/chaos_bench.py --skip-multihost
+
+mh-smoke:
+	$(PY) tools/mh_launch.py --smoke
 
 explain:
 	$(CPU_MESH) $(PY) tools/explain_run.py --latest --json

@@ -82,6 +82,15 @@ CODE_CATALOG: Dict[str, str] = {
               "— the size formula goes negative and downstream sizes "
               "silently multiply back positive); the program cannot "
               "execute",
+    # checkpoint/resume (runtime/checkpoint.py) — runtime, not compile
+    "CKPT001": "checkpoint topology mismatch: a resume sidecar or "
+               "multi-host manifest was written under a different "
+               "topology (process count, device count, mesh axes) than "
+               "the restoring process — restoring anyway would silently "
+               "load a mismatched shard layout; recompile for the new "
+               "topology (the strategy-cache key covers it, so search "
+               "re-runs) and opt into config.elastic_resume for an "
+               "explicit, counted portable restore",
     # program audit (analysis/program_audit.py) — post-lowering jaxpr
     # checks over every compiled step executable
     "AUD000": "program could not be traced for audit — the audit was "

@@ -269,6 +269,21 @@ class FFConfig:
     # None = .ffcache/ckpt; fit(resume_from=...) overrides per call
     checkpoint_dir: Optional[str] = None
     checkpoint_max_to_keep: int = 3
+    # --- elastic multi-host (parallel/multihost.py, tools/mh_launch.py) ---
+    # topology-portable resume: a fit(resume_from=...) whose checkpoint
+    # was written under a DIFFERENT topology (process count, device
+    # count, mesh axes — the sidecar/manifest stamp) normally raises the
+    # coded CKPT001 error; True opts into the explicit portable restore
+    # (params/optimizer state re-placed onto the NEW compiled shardings,
+    # counted on checkpoint.elastic_resumes) after search re-ran for the
+    # new topology — the shrunk/grown-world relaunch path.
+    elastic_resume: bool = False
+    # multi-host checkpoint commit barrier: rank 0 publishes the
+    # topology-stamped manifest only after every rank's shard ack lands
+    # within this bound; a dead peer means no manifest for that step
+    # (counted on checkpoint.barrier_timeouts) and restore falls back to
+    # the previous manifested step.
+    checkpoint_barrier_timeout_s: float = 60.0
     # --- continuous-batching serving (serving/scheduler.py) ---------------
     # decode-slot width of the single compiled decode program: all
     # in-flight requests batch into these slots, one dispatch per decode
@@ -471,6 +486,10 @@ class FFConfig:
                 cfg.checkpoint_dir = _next()
             elif a == "--checkpoint-keep":
                 cfg.checkpoint_max_to_keep = int(_next())
+            elif a == "--elastic-resume":
+                cfg.elastic_resume = True
+            elif a == "--checkpoint-barrier-timeout":
+                cfg.checkpoint_barrier_timeout_s = float(_next())
             elif a == "--print-freq":
                 cfg.print_freq = int(_next())
             elif a == "--adoption-margin":

@@ -262,6 +262,18 @@ def model_context(ff) -> Dict:
     cm = ff.compiled
     ctx: Dict = {"knobs": {k: getattr(ff.config, k, None)
                            for k in _KNOB_FIELDS}}
+    try:
+        import jax
+
+        if jax.process_count() > 1:
+            # multi-host cohorts are their own sentinel cohort: a
+            # process_count knob keys them apart so an N-process fit is
+            # never throughput-judged against single-host baselines
+            # (single-host records stay knob-free — existing cohort
+            # keys, and their baselines, are untouched)
+            ctx["knobs"]["process_count"] = jax.process_count()
+    except Exception:  # noqa: BLE001 — context never kills a record
+        pass
     if cm is None:
         return ctx
     sig = [(op.op_type.value,

@@ -36,7 +36,7 @@ import sys
 import threading
 import time
 import traceback
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 BLACKBOX_SCHEMA = 1
 DEFAULT_DIR = os.path.join(".ffcache", "obs", "blackbox")
@@ -354,7 +354,20 @@ def beat(name: str) -> None:
         wd.beat(name)
 
 
+def list_dumps(dirpath: Optional[str] = None) -> List[str]:
+    """Sorted black-box dump paths under ``dirpath`` (default
+    :data:`DEFAULT_DIR`) — the supervisor (tools/mh_launch.py) attaches
+    these to a hung-peer diagnosis, and the sentinel counts them."""
+    dirpath = dirpath or DEFAULT_DIR
+    try:
+        names = sorted(n for n in os.listdir(dirpath)
+                       if n.startswith("blackbox-"))
+    except OSError:
+        return []
+    return [os.path.join(dirpath, n) for n in names]
+
+
 __all__ = [
     "BLACKBOX_SCHEMA", "Watchdog", "beat", "configure_watchdog",
-    "watch", "watchdog", "watchdog_mode",
+    "list_dumps", "watch", "watchdog", "watchdog_mode",
 ]

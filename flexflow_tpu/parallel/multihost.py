@@ -7,28 +7,40 @@ control plane is **jax.distributed** (one Python process per host, a
 coordinator service, all hosts executing the same SPMD program) and the
 data plane is XLA collectives: ICI within a slice, DCN across slices.
 
-Three pieces:
+The pieces:
 
 * :func:`distributed_init` — process bootstrap (the ``mpirun`` env wiring
   of multinode-test.yml, with SLURM/OpenMPI/manual env fallbacks);
+* :func:`elastic_init` — the preemption-safe bootstrap the launcher
+  (tools/mh_launch.py) uses: :func:`distributed_init` under the shared
+  jittered-backoff retry policy (runtime/retry.py) with a bounded
+  coordination timeout and the deterministic ``multihost.init_timeout``
+  fault site (runtime/faults.py);
 * :func:`make_multihost_mesh` — a global mesh over every process's
   devices, optionally hybrid ICI x DCN so the slowest (DCN) hops carry
   only the outermost axis (reference analog: inter-node bandwidth in its
-  machine models);
+  machine models); :func:`two_level_mesh_spec` plans the shape pair plus
+  the matching ``MultiSliceMachineModel`` config so the strategy search
+  prices the DCN hops (sim/machine_model.py);
+* :func:`multiprocess_compute_support` / :func:`make_local_mesh` — the
+  honest capability probe: some backends (this jaxlib's CPU runtime)
+  bootstrap jax.distributed fine but cannot EXECUTE cross-process XLA
+  programs; the launcher then falls back to a process-local replica mesh,
+  loudly and recorded, instead of dying mid-fit;
 * :func:`process_local_batch` — assemble a GLOBAL batch array from each
   process's local rows (the process-count-aware dataloader path; the
   reference's per-node zero-copy DRAM + per-device copy tasks,
   dataloader.cc:232).
 
 See MULTIHOST.md for the launch recipe; hermetically testable on one
-machine via two localhost processes with CPU devices
-(tests/test_multihost.py).
+machine via localhost processes with CPU devices
+(tests/test_multihost.py, tests/test_multihost_launch.py).
 """
 
 from __future__ import annotations
 
 import os
-from typing import Dict, Optional, Sequence
+from typing import Dict, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -42,6 +54,7 @@ def distributed_init(
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
     local_device_ids: Optional[Sequence[int]] = None,
+    initialization_timeout: Optional[float] = None,
 ) -> None:
     """Initialize the multi-process runtime (reference: the mpirun +
     GASNet/UCX bootstrap of MULTI-NODE.md).
@@ -79,13 +92,191 @@ def distributed_init(
         else env.get("FLEXFLOW_PROCESS_ID")
         or env.get("OMPI_COMM_WORLD_RANK") or env.get("SLURM_PROCID")
     )
+    kw = {}
+    if initialization_timeout is not None:
+        # bound the coordinator handshake: a preempted/missing peer makes
+        # initialize() raise instead of hanging the whole cohort forever
+        kw["initialization_timeout"] = int(initialization_timeout)
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
         process_id=process_id,
         local_device_ids=local_device_ids,
+        **kw,
     )
     distributed_init._done = True
+
+
+def elastic_init(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids: Optional[Sequence[int]] = None,
+    timeout_s: float = 60.0,
+    max_attempts: int = 3,
+    base_delay_s: float = 0.5,
+    seed: Optional[int] = None,
+    _init_fn=None,
+) -> Dict:
+    """Preemption-safe :func:`distributed_init`: the coordination
+    handshake is bounded by ``timeout_s`` and retried under the shared
+    jittered-exponential-backoff policy (runtime/retry.py, label
+    ``mh_init`` — attempts/retries/giveups land in the metrics
+    registry). The deterministic ``multihost.init_timeout`` fault site
+    fires INSIDE the retried attempt, so a seeded chaos plan proves the
+    retry path without a real network flake. ``seed`` makes the backoff
+    jitter replayable (chaos runs); ``_init_fn`` swaps the underlying
+    bootstrap for tests.
+
+    Retry classification is deliberately coarse (``RuntimeError`` /
+    ``OSError``): jax surfaces a coordination timeout and a permanent
+    misconfiguration through the same exception types, so a doomed
+    bootstrap burns the small bounded attempt budget before the
+    ORIGINAL error re-raises unchanged — a few seconds of backoff is
+    the price of surviving the transient case, which preemption makes
+    the common one. Returns the bootstrap summary
+    ``{attempts, process_id, process_count, local_devices,
+    global_devices}``."""
+    from ..runtime import faults as _fx
+    from ..runtime.faults import TransientFault
+    from ..runtime.retry import RetryPolicy
+
+    state = {"attempts": 0}
+
+    def _attempt():
+        state["attempts"] += 1
+        _fx.inject("multihost.init_timeout", TransientFault)
+        try:
+            if _init_fn is not None:
+                _init_fn()
+            else:
+                distributed_init(coordinator_address, num_processes,
+                                 process_id, local_device_ids,
+                                 initialization_timeout=timeout_s)
+        except BaseException:
+            # a failed bootstrap leaves jax.distributed's module-global
+            # client/service state set, and the NEXT initialize() call
+            # would die on its initialize-only-once guard instead of
+            # retrying the connect — reset the state before re-raising
+            # into the retry policy
+            try:
+                jax.distributed.shutdown()
+            except Exception:  # noqa: BLE001 — best-effort reset
+                pass
+            try:
+                from jax._src import distributed as _jd
+
+                if getattr(_jd.global_state, "client", None) is not None:
+                    _jd.global_state = _jd.State()
+            except Exception:  # noqa: BLE001 — internal layout changed
+                pass
+            raise
+
+    RetryPolicy(max_attempts=max_attempts, base_delay_s=base_delay_s,
+                multiplier=2.0, max_delay_s=max(base_delay_s, 10.0),
+                jitter=0.5,
+                retry_on=(TransientFault, RuntimeError, OSError),
+                label="mh_init", seed=seed).call(_attempt)
+    return {
+        "attempts": state["attempts"],
+        "process_id": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+    }
+
+
+# (supported, reason) probe result — cached: the probe pays one tiny XLA
+# compile, and the answer cannot change within a process lifetime
+_MP_SUPPORT: Optional[Tuple[bool, Optional[str]]] = None
+
+
+def multiprocess_compute_support(refresh: bool = False
+                                 ) -> Tuple[bool, Optional[str]]:
+    """Whether this backend can EXECUTE cross-process XLA programs.
+
+    jax.distributed can bootstrap (gRPC coordination) on runtimes whose
+    XLA backend still refuses multi-process computations — this jaxlib's
+    CPU backend raises ``Multiprocess computations aren't implemented``
+    at dispatch. The probe runs one global-mesh reduction and caches
+    ``(supported, reason)``; the launcher worker uses it to fall back to
+    a process-local replica mesh (:func:`make_local_mesh`) loudly
+    instead of dying on the first collective."""
+    global _MP_SUPPORT
+    if _MP_SUPPORT is not None and not refresh:
+        return _MP_SUPPORT
+    if jax.process_count() == 1:
+        _MP_SUPPORT = (True, None)
+        return _MP_SUPPORT
+    try:
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec
+
+        devs = np.asarray(jax.devices(), dtype=object)
+        mesh = Mesh(devs, ("_mh_probe",))
+        n = int(devs.size)
+        ones = np.ones((n,), np.float32)
+        g = jax.make_array_from_callback(
+            (n,), NamedSharding(mesh, PartitionSpec("_mh_probe")),
+            lambda idx: ones[idx])
+        out = jax.jit(jnp.sum, out_shardings=NamedSharding(
+            mesh, PartitionSpec()))(g)
+        jax.block_until_ready(out)
+        _MP_SUPPORT = (True, None)
+    except Exception as e:  # noqa: BLE001 — the reason IS the result
+        _MP_SUPPORT = (False, f"{type(e).__name__}: {e}")
+    return _MP_SUPPORT
+
+
+def make_local_mesh(mesh_shape: Optional[Dict[str, int]] = None) -> Mesh:
+    """Process-local mesh over THIS process's devices — the launcher's
+    compute fallback when :func:`multiprocess_compute_support` says the
+    backend cannot run cross-process programs. Every process then trains
+    a full replica (same seed, same data ⇒ bit-identical trajectories),
+    which keeps the supervisor/checkpoint/ledger machinery real while
+    the collectives stay local."""
+    return make_mesh(mesh_shape, devices=jax.local_devices())
+
+
+def two_level_mesh_spec(num_processes: int, devices_per_process: int,
+                        model_degree: int = 1,
+                        chip: str = "v5e") -> Dict:
+    """Plan the DCN-vs-ICI two-level layout for a cohort: model/tensor
+    axes stay inside a process (ICI), the data axis composes
+    ici x dcn with the DCN factor outermost (the
+    :func:`make_multihost_mesh` convention). Returns ``{"mesh_shape",
+    "dcn_mesh_shape", "machine_model"}`` where ``machine_model`` is a
+    ``load_machine_model``-schema multislice config (sim/machine_model)
+    pricing the data axis at DCN bandwidth — hand it to
+    ``config.machine_model_file`` so the strategy search sees the slow
+    hops it is placing traffic on."""
+    if devices_per_process <= 0 or num_processes <= 0:
+        raise ValueError("num_processes and devices_per_process must be "
+                         "positive")
+    if model_degree < 1 or devices_per_process % model_degree:
+        raise ValueError(
+            f"model_degree {model_degree} must divide the per-process "
+            f"device count {devices_per_process} (model/tensor axes stay "
+            f"ICI-local)")
+    ici_data = devices_per_process // model_degree
+    mesh_shape: Dict[str, int] = {"data": ici_data}
+    axis_degrees: Dict[str, int] = {"data": ici_data * num_processes}
+    if model_degree > 1:
+        mesh_shape["model"] = model_degree
+        axis_degrees["model"] = model_degree
+    return {
+        "mesh_shape": mesh_shape,
+        "dcn_mesh_shape": {"data": num_processes},
+        "machine_model": {
+            "version": "multislice",
+            "chip": chip,
+            "axis_degrees": axis_degrees,
+            # the composed data axis crosses process (DCN) boundaries:
+            # price the WHOLE axis at DCN bandwidth — conservative, and
+            # exactly the hop the layout routes gradient all-reduce over
+            "dcn_axes": ["data"] if num_processes > 1 else [],
+        },
+    }
 
 
 def make_multihost_mesh(

@@ -30,7 +30,9 @@ Crash-safety contract (the fault-tolerance layer's foundation):
 from __future__ import annotations
 
 import os
-from typing import Any, Dict, Optional
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -44,6 +46,52 @@ from .retry import RetryPolicy
 # the budget and is the caller's to surface
 _IO_RETRY = RetryPolicy(max_attempts=3, base_delay_s=0.02, max_delay_s=0.25,
                         retry_on=(OSError,), label="checkpoint")
+
+
+def topology_signature(mesh=None, process_count: Optional[int] = None) -> Dict:
+    """The launch topology a checkpoint was written under: process count,
+    device count, backend, and the compiled mesh's axis sizes. Stamped on
+    every fit resume sidecar and multi-host manifest so a resume under a
+    DIFFERENT topology fails loudly (CKPT001) instead of restoring into
+    the wrong sharding."""
+    sig: Dict = {
+        "process_count": int(process_count if process_count is not None
+                             else jax.process_count()),
+        "device_count": int(jax.device_count()),
+        "backend": jax.default_backend(),
+    }
+    if mesh is not None:
+        sig["mesh_axes"] = {str(a): int(s) for a, s in
+                            zip(mesh.axis_names, mesh.devices.shape)}
+    return sig
+
+
+def topology_matches(saved: Optional[Dict], current: Optional[Dict]) -> bool:
+    """Compare two topology signatures on the fields BOTH carry (an old
+    sidecar without a mesh_axes entry only constrains the counts)."""
+    if not saved or not current:
+        return True  # legacy sidecars carry no stamp: nothing to check
+    for k in ("process_count", "device_count", "backend", "mesh_axes"):
+        if k in saved and k in current and saved[k] != current[k]:
+            return False
+    return True
+
+
+class CheckpointTopologyError(RuntimeError):
+    """CKPT001: a resume sidecar/manifest was written under a different
+    topology (process count, device count, mesh axes) than the one
+    restoring. Restoring anyway would silently load a mismatched shard
+    layout — re-compile (the strategy cache key covers the topology, so
+    search re-runs) and opt into ``config.elastic_resume`` for an
+    explicit, counted portable restore."""
+
+    code = "CKPT001"
+
+    def __init__(self, msg: str, expected: Optional[Dict] = None,
+                 found: Optional[Dict] = None):
+        super().__init__(f"[{self.code}] {msg}")
+        self.expected = expected
+        self.found = found
 
 
 def _atomic_write_json(path: str, doc: Dict) -> None:
@@ -238,8 +286,23 @@ class CheckpointManager:
             # restored weights AND optimizer moments flow into the pipeline
             ffmodel.pipelined.sync_from(cm)
 
+    def _check_topology(self, ffmodel, extra: Optional[Dict],
+                        step: int) -> None:
+        """Raise CKPT001 when a sidecar's topology stamp disagrees with
+        the restoring process's. Legacy sidecars (no stamp) pass."""
+        saved = (extra or {}).get("topology")
+        cur = topology_signature(ffmodel.compiled.mesh)
+        if not topology_matches(saved, cur):
+            raise CheckpointTopologyError(
+                f"checkpoint step {step} under {self.directory} was "
+                f"written for topology {saved}, but this process runs "
+                f"{cur}; refusing to restore into a mismatched sharding "
+                f"(set config.elastic_resume for a portable restore)",
+                expected=cur, found=saved)
+
     def restore(self, ffmodel, step: Optional[int] = None,
-                require_extra: bool = False) -> int:
+                require_extra: bool = False,
+                check_topology: bool = True) -> int:
         """Restore into the compiled model in place. With an explicit
         ``step`` the restore is strict (corruption raises). Without one,
         candidates are tried newest-first and a step whose payload OR
@@ -250,10 +313,21 @@ class CheckpointManager:
         additionally demotes steps with NO sidecar: a payload without
         its resume metadata would silently restart the epoch/shuffle
         position from zero on mid-run params — loud fallback beats
-        silently-wrong resume. Returns the restored step."""
+        silently-wrong resume. ``check_topology`` (default) raises the
+        coded :class:`CheckpointTopologyError` when the sidecar's
+        topology stamp disagrees with this process — a mismatch is a
+        configuration change, NOT corruption, so it never falls back.
+        Returns the restored step."""
         cm = ffmodel.compiled
         assert cm is not None, "compile() before restoring"
         if step is not None:
+            if check_topology:
+                try:
+                    self._check_topology(ffmodel, self._load_extra(step),
+                                         step)
+                except (ValueError, OSError):
+                    pass  # corrupt/unreadable sidecar: the strict
+                    #       payload path decides, as before this check
             self._restore_step(ffmodel, step)
             return step
         candidates = sorted(self._mgr.all_steps(), reverse=True)
@@ -264,12 +338,17 @@ class CheckpointManager:
             try:
                 # sidecar intactness first (cheap) — a step whose resume
                 # metadata is torn is NOT intact even if its arrays are
-                if self._load_extra(s) is None and require_extra:
+                extra = self._load_extra(s)
+                if extra is None and require_extra:
                     raise ValueError(
                         f"step {s} has no resume sidecar "
                         f"({self._extra_path(s)})")
+                if check_topology:
+                    self._check_topology(ffmodel, extra, s)
                 self._restore_step(ffmodel, s)
                 return s
+            except CheckpointTopologyError:
+                raise  # a config mismatch, not corruption: never fall back
             except Exception as e:  # noqa: BLE001 — any torn read demotes
                 last_err = e
                 metrics_registry().counter(
@@ -283,8 +362,585 @@ class CheckpointManager:
             f"no intact checkpoint under {self.directory} "
             f"(tried {candidates})") from last_err
 
+    def restore_elastic(self, ffmodel) -> int:
+        """Topology-portable restore: same newest-intact walk, with the
+        topology gate off. Safe single-host because :meth:`_restore_step`
+        re-places every leaf onto the CURRENT compiled shardings; counted
+        on ``checkpoint.elastic_resumes`` so it is never silent."""
+        step = self.restore(ffmodel, require_extra=True,
+                            check_topology=False)
+        metrics_registry().counter("checkpoint.elastic_resumes").inc()
+        return step
+
     def close(self) -> None:
         self._mgr.close()
+
+
+# --------------------------------------------------------------- multihost
+MH_MANIFEST_SCHEMA = 1
+
+
+def is_multihost_dir(path: str) -> bool:
+    """True when ``path`` carries the multi-host checkpoint layout
+    (``manifest_<step>.json`` + ``shard-<rank>/``) — fit() auto-selects
+    :class:`MultiHostCheckpointManager` for such a directory even from a
+    single process, so a shrunk-to-1 relaunch still reads its cohort's
+    checkpoints instead of misparsing them as a single-host layout."""
+    try:
+        names = os.listdir(path)
+    except OSError:
+        return False
+    return any(n.startswith("manifest_") and n.endswith(".json")
+               for n in names) or any(n.startswith("shard-") for n in names)
+
+
+def _flat_state(cm) -> Dict[str, np.ndarray]:
+    """Host-side (numpy) flat view of the resumable compiled-model state.
+    The device->host copy happens HERE, synchronously — the caller's step
+    loop may donate the live buffers the moment save() returns, exactly
+    the single-host contract."""
+    flat: Dict[str, np.ndarray] = {}
+    for prefix, tree in (("params", cm.params), ("opt", cm.opt_state)):
+        leaves, _ = jax.tree_util.tree_flatten_with_path(tree)
+        for path, leaf in leaves:
+            flat[prefix + jax.tree_util.keystr(path)] = np.asarray(leaf)
+    flat["__iteration__"] = np.asarray(cm.resume_state()["iteration"],
+                                       np.int64)
+    return flat
+
+
+def _rebuild_tree(tree, prefix: str, flat: Dict[str, np.ndarray], mesh):
+    """Place a flat payload back onto the CURRENT compiled model's tree:
+    every jax leaf lands on its own sharding when that lives on the
+    compiled mesh, replicated otherwise (the single-host ``_abstract``
+    rule). A missing key means an incompatible payload — raise, so the
+    caller's newest-intact fallback engages instead of a partial load."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    leaves, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    out = []
+    for path, leaf in leaves:
+        key = prefix + jax.tree_util.keystr(path)
+        if key not in flat:
+            raise ValueError(f"shard payload is missing {key!r}")
+        val = np.asarray(flat[key])
+        if isinstance(leaf, jax.Array):
+            sh = leaf.sharding
+            if not (isinstance(sh, NamedSharding) and sh.mesh == mesh):
+                sh = NamedSharding(mesh, PartitionSpec())
+            out.append(jax.device_put(val, sh))
+        else:
+            out.append(val)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+class MultiHostCheckpointManager:
+    """Process-scoped sharded checkpoints + an atomic topology-stamped
+    manifest (the elastic multi-host runtime's durable state).
+
+    Layout under ``directory``::
+
+        shard-000/step_8.npz      # rank 0's payload (atomic tmp+rename)
+        shard-000/extra_8.json    # rank 0's resume sidecar (atomic)
+        shard-000/ack_8.json      # rank 0's commit receipt
+        shard-001/...
+        manifest_8.json           # rank 0, AFTER every rank acked:
+                                  # schema, step, process_count, topology,
+                                  # mesh axes, strategy-cache key
+
+    Contract:
+
+    * **per-process commit, async** — each rank copies device state to
+      host synchronously, then commits (payload + sidecar + ack) on a
+      background thread; ``wait=False`` returns immediately and the next
+      save/restore/close joins the pending commit (errors re-raise
+      there, never silently dropped);
+    * **the manifest is the global commit point** — rank 0 writes it
+      only after observing every rank's ack for that step (bounded by
+      ``barrier_timeout_s``; a dead peer means NO manifest, counted on
+      ``checkpoint.barrier_timeouts``, and restore falls back to the
+      previous manifested step — a torn cohort never half-commits);
+    * **topology-stamped resume** — restore() verifies the manifest's
+      topology (process count, device count, mesh axes) against the
+      restoring cohort and raises the coded
+      :class:`CheckpointTopologyError` on mismatch;
+      :meth:`restore_elastic` is the explicit, counted portable path
+      (reads the caller's own shard, or shard 0 when the world shrank/
+      grew) used by ``config.elastic_resume``;
+    * **torn-manifest fallback** — a corrupt manifest is skipped and
+      counted (``checkpoint.torn_manifests``), exactly the single-host
+      newest-intact discipline.
+
+    Payloads are plain atomic ``.npz`` (not Orbax): under
+    ``jax.distributed`` Orbax's tensorstore commit is coordinated by a
+    global primary host, which deadlocks/loses data for per-process
+    shard directories on backends without cross-process XLA (this CPU
+    CI); the npz path keeps the crash-safety contract on every backend.
+    Elastic restores require the source shard to hold full (replicated
+    or host-local) arrays — true for data-parallel and the process-local
+    compute fallback; a genuinely weight-sharded cohort must resume on
+    its own topology.
+    """
+
+    def __init__(self, directory: str, process_id: Optional[int] = None,
+                 process_count: Optional[int] = None,
+                 max_to_keep: Optional[int] = 3,
+                 barrier_timeout_s: Optional[float] = None,
+                 launch_id: Optional[str] = None):
+        self.directory = os.path.abspath(directory)
+        self.rank = int(process_id if process_id is not None
+                        else jax.process_index())
+        self.world = int(process_count if process_count is not None
+                         else jax.process_count())
+        self.max_to_keep = max_to_keep
+        self.barrier_timeout_s = (60.0 if barrier_timeout_s is None
+                                  else float(barrier_timeout_s))
+        # cohort incarnation: acks are stamped with this id and the
+        # manifest barrier only counts SAME-incarnation acks — a stale
+        # ack from a torn-down previous launch (acks are never pruned)
+        # must not let rank 0 manifest a step its peers have not
+        # re-committed THIS run. The launcher exports one uuid per
+        # cohort attempt; None (library use without a supervisor) keeps
+        # the existence-only barrier.
+        self.launch_id = (launch_id if launch_id is not None
+                          else os.environ.get("FLEXFLOW_TPU_MH_LAUNCH_ID"))
+        self._torn_seen: set = set()  # count each torn manifest ONCE
+        self._mu = threading.Lock()  # guards _pending/_commit_err
+        self._pending: Optional[threading.Thread] = None
+        self._commit_err: Optional[BaseException] = None
+        os.makedirs(self._shard_dir(self.rank), exist_ok=True)
+
+    # ------------------------------------------------------------ paths
+    def _shard_dir(self, rank: int) -> str:
+        return os.path.join(self.directory, f"shard-{rank:03d}")
+
+    def _payload_path(self, step: int, rank: Optional[int] = None) -> str:
+        return os.path.join(self._shard_dir(
+            self.rank if rank is None else rank), f"step_{step}.npz")
+
+    def _extra_path(self, step: int, rank: Optional[int] = None) -> str:
+        return os.path.join(self._shard_dir(
+            self.rank if rank is None else rank), f"extra_{step}.json")
+
+    def _ack_path(self, step: int, rank: int) -> str:
+        return os.path.join(self._shard_dir(rank), f"ack_{step}.json")
+
+    def _manifest_path(self, step: int) -> str:
+        return os.path.join(self.directory, f"manifest_{step}.json")
+
+    # ---------------------------------------------------------- pending
+    def _join_pending(self) -> None:
+        """Wait out the in-flight commit; a commit failure surfaces HERE
+        (the next save/restore/close), mirroring Orbax's async contract."""
+        with self._mu:
+            t = self._pending
+            self._pending = None
+        if t is not None and t is not threading.current_thread():
+            t.join()  # outside the lock (CCY003)
+        with self._mu:
+            err = self._commit_err
+            self._commit_err = None
+        if err is not None:
+            raise RuntimeError(
+                f"async shard commit failed (rank {self.rank} under "
+                f"{self.directory})") from err
+
+    # ------------------------------------------------------------- save
+    def save(self, ffmodel, step: int, extra: Optional[Dict[str, Any]] = None,
+             wait: bool = True) -> None:
+        """Commit this process's shard for ``step``; rank 0 additionally
+        publishes the topology-stamped manifest once every rank acked."""
+        cm = ffmodel.compiled
+        assert cm is not None, "compile() before saving"
+        self._join_pending()
+        step = int(step)
+        topo = topology_signature(cm.mesh, process_count=self.world)
+        extra_doc = dict(extra or {})
+        extra_doc["topology"] = topo
+        manifest = {
+            "schema": MH_MANIFEST_SCHEMA,
+            "step": step,
+            "process_count": self.world,
+            "topology": topo,
+            "mesh_axes": topo.get("mesh_axes"),
+            "strategy_key": (getattr(ffmodel, "search_profile", None)
+                             or {}).get("cache_key"),
+            "ts_unix_s": round(time.time(), 3),
+            "ranks": list(range(self.world)),
+        }
+        flat = _flat_state(cm)  # device->host copy, synchronous
+        t = threading.Thread(
+            target=self._commit, args=(step, flat, extra_doc, manifest),
+            name=f"ff-mh-ckpt-r{self.rank}", daemon=False)
+        with self._mu:
+            self._pending = t
+        t.start()
+        if wait:
+            self._join_pending()
+
+    def _commit(self, step: int, flat: Dict, extra_doc: Dict,
+                manifest: Dict) -> None:
+        """Background commit: payload + sidecar + ack; rank 0 then waits
+        for the cohort's acks and publishes the manifest. All state this
+        thread touches is thread-local except the error slot (locked)
+        and the thread-safe metrics counters."""
+        try:
+            _IO_RETRY.call(self._write_payload, step, flat)
+            _IO_RETRY.call(_atomic_write_json, self._extra_path(step),
+                           extra_doc)
+            _IO_RETRY.call(_atomic_write_json,
+                           self._ack_path(step, self.rank),
+                           {"rank": self.rank, "step": step,
+                            "launch_id": self.launch_id,
+                            "ts_unix_s": round(time.time(), 3)})
+            metrics_registry().counter("checkpoint.shard_saves").inc()
+            if self.rank == 0:
+                if self._await_acks(step):
+                    _IO_RETRY.call(_atomic_write_json,
+                                   self._manifest_path(step), manifest)
+                else:
+                    metrics_registry().counter(
+                        "checkpoint.barrier_timeouts").inc()
+                    import sys
+
+                    print(f"[checkpoint] step {step}: not every rank "
+                          f"acked within {self.barrier_timeout_s}s — "
+                          f"manifest NOT written (restore will use the "
+                          f"previous manifested step)",
+                          file=sys.stderr, flush=True)
+            self._prune()
+            # chaos harness: tear what was just committed (the multihost
+            # arm of the checkpoint.torn_write site; target='manifest'
+            # tears the global commit point itself)
+            rule = _fault_fire("checkpoint.torn_write")
+            if rule is not None:
+                self._tear(step, rule.get("target", "payload"))
+        except BaseException as e:  # noqa: BLE001 — surfaces at next join
+            with self._mu:
+                self._commit_err = e
+
+    def _write_payload(self, step: int, flat: Dict) -> None:
+        path = self._payload_path(step)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            np.savez(f, **flat)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def _await_acks(self, step: int) -> bool:
+        deadline = time.monotonic() + self.barrier_timeout_s
+        want = [self._ack_path(step, r) for r in range(self.world)]
+        # each poll ticks a counter the launcher's heartbeat samples: a
+        # rank WAITING at the commit barrier (for a peer still paying
+        # its first-dispatch XLA compile) is alive, not hung — the
+        # supervisor must only flag ranks making NO progress of any kind
+        polls = metrics_registry().counter("checkpoint.barrier_polls")
+
+        def _acked(path: str) -> bool:
+            if self.launch_id is None:
+                return os.path.exists(path)
+            # incarnation-checked: a stale ack left by a previous
+            # (torn-down) launch does not count — the peer must have
+            # re-committed this step THIS run
+            import json
+
+            try:
+                with open(path) as f:
+                    return json.load(f).get("launch_id") == self.launch_id
+            except (OSError, ValueError):
+                return False  # absent or mid-write: not acked yet
+
+        while True:
+            polls.inc()
+            if all(_acked(p) for p in want):
+                return True
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.05)
+
+    def _prune(self) -> None:
+        """Retention: keep the newest ``max_to_keep`` steps of this
+        rank's shard files (and, on rank 0, of the manifests)."""
+        if self.max_to_keep is None:
+            return
+        import glob
+        import re
+
+        keep = max(1, int(self.max_to_keep))
+
+        def _steps(pattern, rx):
+            out = []
+            for p in glob.glob(pattern):
+                m = re.match(rx, os.path.basename(p))
+                if m:
+                    out.append((int(m.group(1)), p))
+            return sorted(out, reverse=True)
+
+        doomed: List[str] = []
+        shard = self._shard_dir(self.rank)
+        payloads = _steps(os.path.join(shard, "step_*.npz"),
+                          r"step_(\d+)\.npz$")
+        # retention counts MANIFESTED steps: a run of barrier-timeout
+        # saves (no manifest — e.g. a wedged peer) must never evict the
+        # payload a surviving manifest still points at, or "restore
+        # falls back to the previous manifested step" stops being true.
+        # The newest `keep` raw payloads are kept too — the newest
+        # step's manifest may still be in flight on rank 0.
+        manifested = {s for s, _ in self._manifests()}
+        keep_steps = {s for s, _ in payloads[:keep]}
+        keep_steps.update(
+            s for s, _ in
+            [(s, p) for s, p in payloads if s in manifested][:keep])
+        dead_steps = {s for s, _ in payloads} - keep_steps
+        doomed += [p for s, p in payloads if s in dead_steps]
+        # acks are NEVER pruned: a rank that sprints ahead (its peer
+        # still paying a first-dispatch compile) must not delete the
+        # receipt rank 0's step-2 barrier is about to poll for — acks
+        # are ~60 bytes, bounded by the run's step count
+        doomed += [p for s, p in _steps(
+            os.path.join(shard, "extra_*.json"),
+            r"extra_(\d+)\.json$") if s in dead_steps]
+        if self.rank == 0:
+            doomed += [p for _, p in _steps(
+                os.path.join(self.directory, "manifest_*.json"),
+                r"manifest_(\d+)\.json$")[keep:]]
+        for p in doomed:
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+
+    def _tear(self, step: int, target: str) -> None:
+        """Deterministic corruption (fault site ``checkpoint.torn_write``):
+        truncate this rank's payload, tear its sidecar, or tear the
+        global manifest (rank 0 only — other ranks hold no manifest)."""
+        metrics_registry().counter("faults.torn_checkpoints").inc()
+        if target == "sidecar":
+            with open(self._extra_path(step), "w") as f:
+                f.write('{"schema": 1, "epoch"')  # torn mid-key
+            return
+        if target == "manifest":
+            if self.rank == 0:
+                with open(self._manifest_path(step), "w") as f:
+                    f.write('{"schema": 1, "step"')  # torn mid-key
+            return
+        p = self._payload_path(step)
+        try:
+            size = os.path.getsize(p)
+            if size > 0:
+                os.truncate(p, size // 2)
+        except OSError:
+            pass
+
+    # ---------------------------------------------------------- restore
+    def _manifests(self) -> List[Tuple[int, str]]:
+        import glob
+        import re
+
+        out = []
+        for p in glob.glob(os.path.join(self.directory, "manifest_*.json")):
+            m = re.match(r"manifest_(\d+)\.json$", os.path.basename(p))
+            if m:
+                out.append((int(m.group(1)), p))
+        return sorted(out, reverse=True)
+
+    def _intact_manifests(self) -> List[Tuple[int, Dict]]:
+        """Newest-first intact manifests; a torn one is skipped and
+        counted on ``checkpoint.torn_manifests`` — never silent."""
+        import json
+
+        out = []
+        for step, path in self._manifests():
+            try:
+                with open(path) as f:
+                    doc = json.load(f)
+                if not isinstance(doc, dict) or \
+                        doc.get("schema") != MH_MANIFEST_SCHEMA:
+                    raise ValueError(f"bad manifest schema in {path}")
+                out.append((step, doc))
+            except (ValueError, OSError) as e:
+                if path not in self._torn_seen:
+                    # every latest_step()/restore() re-scans; one torn
+                    # file must count (and warn) once, not per scan
+                    self._torn_seen.add(path)
+                    metrics_registry().counter(
+                        "checkpoint.torn_manifests").inc()
+                    import sys
+
+                    print(f"[checkpoint] manifest {path} is not intact "
+                          f"({type(e).__name__}: {e}); falling back to "
+                          f"the next-newest manifest", file=sys.stderr,
+                          flush=True)
+        return out
+
+    def latest_manifest(self) -> Optional[Tuple[int, Dict]]:
+        self._join_pending()
+        items = self._intact_manifests()
+        return items[0] if items else None
+
+    def latest_step(self) -> Optional[int]:
+        m = self.latest_manifest()
+        return m[0] if m else None
+
+    def all_steps(self) -> List[int]:
+        self._join_pending()
+        return sorted(s for s, _ in self._intact_manifests())
+
+    def _load_extra(self, step: int,
+                    rank: Optional[int] = None) -> Optional[Dict]:
+        import json
+
+        path = self._extra_path(step, rank)
+        if not os.path.exists(path):
+            return None
+        with open(path) as f:
+            doc = json.load(f)
+        if not isinstance(doc, dict):
+            raise ValueError(f"sidecar {path} is not a JSON object")
+        return doc
+
+    def restore_extra(self, step: Optional[int] = None) -> Optional[Dict]:
+        """This rank's resume sidecar (shard 0's when the world changed
+        and this rank has none — the elastic source shard), or None;
+        corruption is counted, mirroring the single-host manager."""
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            return None
+        try:
+            doc = self._load_extra(step)
+            if doc is None and self.rank != 0:
+                doc = self._load_extra(step, rank=0)
+            return doc
+        except ValueError as e:
+            metrics_registry().counter("checkpoint.corrupt_sidecars").inc()
+            import sys
+
+            print(f"[checkpoint] corrupt sidecar for step {step}: {e}",
+                  file=sys.stderr, flush=True)
+            return None
+
+    def _restore_shard(self, ffmodel, step: int, require_extra: bool,
+                       rank: Optional[int] = None) -> None:
+        """Load one shard's payload onto the CURRENT compiled shardings;
+        mutations apply only after the whole payload parsed."""
+        if require_extra and self._load_extra(step, rank) is None:
+            raise ValueError(
+                f"step {step} has no resume sidecar "
+                f"({self._extra_path(step, rank)})")
+        cm = ffmodel.compiled
+        path = self._payload_path(step, rank)
+        with np.load(path, allow_pickle=False) as npz:
+            flat = {k: npz[k] for k in npz.files}
+        params = _rebuild_tree(cm.params, "params", flat, cm.mesh)
+        opt_state = _rebuild_tree(cm.opt_state, "opt", flat, cm.mesh)
+        cm.params = params
+        cm.opt_state = opt_state
+        cm.bump_params_version()
+        cm.load_resume_state({"iteration": int(flat["__iteration__"])})
+        if getattr(ffmodel, "pipelined", None) is not None:
+            ffmodel.pipelined.sync_from(cm)
+
+    def restore(self, ffmodel, step: Optional[int] = None,
+                require_extra: bool = False,
+                check_topology: bool = True) -> int:
+        """Restore this rank's shard at the newest manifested intact
+        step (or a strict explicit ``step``). The manifest's topology
+        must match the restoring cohort — a mismatch raises the coded
+        :class:`CheckpointTopologyError` (use :meth:`restore_elastic` /
+        ``config.elastic_resume`` for the portable path)."""
+        cm = ffmodel.compiled
+        assert cm is not None, "compile() before restoring"
+        self._join_pending()
+        cur = topology_signature(cm.mesh, process_count=self.world)
+
+        def _verify(man: Dict, s: int) -> None:
+            if check_topology and not topology_matches(
+                    man.get("topology"), cur):
+                raise CheckpointTopologyError(
+                    f"manifest step {s} under {self.directory} was "
+                    f"written for topology {man.get('topology')} "
+                    f"(process_count {man.get('process_count')}), but "
+                    f"this cohort runs {cur}; refusing to restore a "
+                    f"mismatched shard layout (set config.elastic_resume "
+                    f"for a portable restore)",
+                    expected=cur, found=man.get("topology"))
+
+        if step is not None:
+            import json
+
+            with open(self._manifest_path(step)) as f:
+                man = json.load(f)
+            _verify(man, step)
+            self._restore_shard(ffmodel, step, require_extra)
+            return step
+        items = self._intact_manifests()
+        if not items:
+            raise FileNotFoundError(
+                f"no intact manifest under {self.directory}")
+        # topology is a property of the COHORT, not of one step: verify
+        # on the newest intact manifest before touching any payload
+        _verify(items[0][1], items[0][0])
+        last_err: Optional[BaseException] = None
+        for s, man in items:
+            try:
+                _verify(man, s)
+                self._restore_shard(ffmodel, s, require_extra)
+                return s
+            except CheckpointTopologyError:
+                raise
+            except Exception as e:  # noqa: BLE001 — torn shard demotes
+                last_err = e
+                metrics_registry().counter(
+                    "checkpoint.corrupt_fallbacks").inc()
+                import sys
+
+                print(f"[checkpoint] shard step {s} is not intact "
+                      f"({type(e).__name__}: {e}); falling back to the "
+                      f"next-newest manifest", file=sys.stderr, flush=True)
+        raise RuntimeError(
+            f"no intact shard checkpoint under {self.directory} "
+            f"(tried {[s for s, _ in items]})") from last_err
+
+    def restore_elastic(self, ffmodel) -> int:
+        """Portable restore across a topology change (shrunk/grown world,
+        reshaped mesh): reads this rank's own shard when it exists, shard
+        0 otherwise, and re-places every leaf onto the NEW compiled
+        shardings. Search already re-ran at compile() (the strategy-cache
+        key covers the topology); counted on
+        ``checkpoint.elastic_resumes`` — explicit, never silent."""
+        self._join_pending()
+        items = self._intact_manifests()
+        if not items:
+            raise FileNotFoundError(
+                f"no intact manifest under {self.directory}")
+        last_err: Optional[BaseException] = None
+        for s, _man in items:
+            src = (None if os.path.exists(self._payload_path(s)) else 0)
+            try:
+                self._restore_shard(ffmodel, s, require_extra=True,
+                                    rank=src)
+                metrics_registry().counter(
+                    "checkpoint.elastic_resumes").inc()
+                import sys
+
+                print(f"[checkpoint] elastic resume: restored step {s} "
+                      f"from shard "
+                      f"{self.rank if src is None else src} under the "
+                      f"new topology", file=sys.stderr, flush=True)
+                return s
+            except Exception as e:  # noqa: BLE001 — torn shard demotes
+                last_err = e
+                metrics_registry().counter(
+                    "checkpoint.corrupt_fallbacks").inc()
+        raise RuntimeError(
+            f"no intact shard checkpoint under {self.directory} for an "
+            f"elastic restore (tried {[s for s, _ in items]})"
+        ) from last_err
+
+    def close(self) -> None:
+        self._join_pending()
 
 
 def save_checkpoint(ffmodel, path: str, step: int = 0) -> None:
@@ -305,4 +961,8 @@ def load_checkpoint(ffmodel, path: str, step: Optional[int] = None) -> int:
         m.close()
 
 
-__all__ = ["CheckpointManager", "load_checkpoint", "save_checkpoint"]
+__all__ = [
+    "CheckpointManager", "CheckpointTopologyError", "MH_MANIFEST_SCHEMA",
+    "MultiHostCheckpointManager", "is_multihost_dir", "load_checkpoint",
+    "save_checkpoint", "topology_matches", "topology_signature",
+]

@@ -80,6 +80,19 @@ SITES: Dict[str, str] = {
         "crash a serving batcher-worker after re-queuing its batch — "
         "proves the respawn budget and that every accepted future still "
         "resolves"),
+    "multihost.init_timeout": (
+        "raise TransientFault inside elastic_init's retried bootstrap "
+        "(before jax.distributed.initialize) — proves the jittered "
+        "timeout-retry init path (parallel/multihost.elastic_init)"),
+    "multihost.peer_kill": (
+        "hard-kill this worker process mid-fit (os._exit, default 43) "
+        "after the step completes — the supervisor (tools/mh_launch.py) "
+        "must detect the dead peer, tear the cohort down, and relaunch "
+        "with resume_from"),
+    "multihost.slow_peer": (
+        "sleep stall_s inside the step loop — the worker's heartbeat "
+        "stops progressing so the supervisor's hang detector (and the "
+        "PR 8 watchdog's black-box dump) must fire"),
 }
 
 # rule keys accepted per site (trigger keys are shared)
@@ -89,6 +102,8 @@ _SITE_PARAMS = {
     "train.stall": {"stall_s"},
     "train.kill": {"exit_code"},
     "checkpoint.torn_write": {"target"},
+    "multihost.peer_kill": {"exit_code"},
+    "multihost.slow_peer": {"stall_s"},
 }
 
 

@@ -1234,6 +1234,7 @@ class FFModel:
                 f"search_cache={cache_mode!r}: expected 'on', 'off' or "
                 "'refresh'")
         cache_key = None
+        self._strategy_cache_key = None  # search_profile["cache_key"]
         cache_dir = getattr(cfg, "search_cache_dir", ".ffcache/strategies")
         if cache_mode in ("on", "refresh") and not use_mcmc:
             from ..search.cache import (cache_path, load_payload,
@@ -1244,6 +1245,9 @@ class FFModel:
                 self.layers, inputs, machine, cfg,
                 mesh_axes=mesh_axis_sizes(mesh) if pinned else None,
                 protected=protected)
+            # the multihost checkpoint manifest records this key so an
+            # unchanged-topology resume provably warm-hits the same entry
+            self._strategy_cache_key = cache_key
             if cache_mode == "on":
                 payload = load_payload(cache_dir, cache_key)
                 if payload is not None:
@@ -1472,6 +1476,7 @@ class FFModel:
         self.search_profile = {
             "search_time_s": time.perf_counter() - t_start,
             "cache": cache_label,
+            "cache_key": getattr(self, "_strategy_cache_key", None),
             "candidates": getattr(result, "candidates", 0),
             "pruned": getattr(result, "pruned", 0),
             "states_explored": result.states_explored,
@@ -1808,21 +1813,47 @@ class FFModel:
         mgr = None
         start_epoch = skip_steps = 0
         if interval or resume_from:
-            from .checkpoint import CheckpointManager
+            from .checkpoint import (CheckpointManager,
+                                     MultiHostCheckpointManager,
+                                     is_multihost_dir)
 
             ckpt_dir = (resume_from
                         or getattr(cfg, "checkpoint_dir", None)
                         or os.path.join(".ffcache", "ckpt"))
-            mgr = CheckpointManager(
-                ckpt_dir,
-                max_to_keep=max(1, int(getattr(
-                    cfg, "checkpoint_max_to_keep", 3) or 3)))
+            keep = max(1, int(getattr(
+                cfg, "checkpoint_max_to_keep", 3) or 3))
+            if jax.process_count() > 1 or is_multihost_dir(ckpt_dir):
+                # multi-process cohort (or a cohort's directory read by
+                # a resized relaunch): per-process shard payloads plus
+                # rank 0's topology-stamped manifest barrier
+                mgr = MultiHostCheckpointManager(
+                    ckpt_dir, max_to_keep=keep,
+                    barrier_timeout_s=getattr(
+                        cfg, "checkpoint_barrier_timeout_s", None))
+            else:
+                mgr = CheckpointManager(ckpt_dir, max_to_keep=keep)
         if resume_from and mgr.latest_step() is not None:
             # newest intact step, where intact = payload AND resume
             # sidecar (a payload-only step would restart the epoch /
             # shuffle position from zero on mid-run params); fallbacks
-            # are counted, exhaustion raises loudly
-            step = mgr.restore(self, require_extra=True)
+            # are counted, exhaustion raises loudly. A topology change
+            # (resized world, reshaped mesh) raises the coded CKPT001
+            # error unless config.elastic_resume opts into the explicit
+            # portable restore — search already re-ran for the new
+            # topology at compile() (the strategy-cache key covers it)
+            from .checkpoint import CheckpointTopologyError
+
+            try:
+                step = mgr.restore(self, require_extra=True)
+            except CheckpointTopologyError as e:
+                if not getattr(cfg, "elastic_resume", False):
+                    raise
+                import sys
+
+                print(f"[resume] topology changed ({e}); performing the "
+                      f"elastic portable restore", file=sys.stderr,
+                      flush=True)
+                step = mgr.restore_elastic(self)
             extra = mgr.restore_extra(step) or {}
             self._rng_counter = int(
                 extra.get("rng_counter", self._rng_counter))
@@ -1856,6 +1887,8 @@ class FFModel:
             self.pipelined.sync_to(cm)
         opt = self.optimizer
         lr = getattr(opt, "lr", getattr(opt, "alpha", None))
+        from .checkpoint import topology_signature
+
         extra = {
             "schema": 1,
             "epoch": int(epoch),
@@ -1863,6 +1896,10 @@ class FFModel:
             "rng_counter": int(self._rng_counter),
             "lr": float(lr) if lr is not None else None,
             "guard": guard.state() if guard is not None else None,
+            # topology stamp: a resume under a different process count /
+            # device count / mesh fails loudly (CKPT001) instead of
+            # restoring into the wrong sharding
+            "topology": topology_signature(cm.mesh),
             **cm.resume_state(),
         }
         mgr.save(self, cm.iteration, extra=extra, wait=False)
@@ -2071,6 +2108,16 @@ class FFModel:
                     rule = _fx.fire("train.kill")
                     if rule is not None:
                         os._exit(int(rule.get("exit_code", 41)))
+                    # multihost chaos: a slow peer stalls its heartbeat
+                    # (the supervisor's hang detector + the watchdog's
+                    # black box must fire), a killed peer dies hard
+                    # AFTER the checkpoint block like train.kill
+                    rule = _fx.fire("multihost.slow_peer")
+                    if rule is not None:
+                        time.sleep(float(rule.get("stall_s", 2.0)))  # hotpath: sync-ok (plan-dict scalar sleep; chaos-run only — unreachable without an armed fault plan)
+                    rule = _fx.fire("multihost.peer_kill")
+                    if rule is not None:
+                        os._exit(int(rule.get("exit_code", 43)))
                 if recompile_state is not None:
                     # reference: recompile_on_condition evaluated per
                     # iteration inside the train loop (model.cc:2422).

@@ -228,6 +228,16 @@ def machine_signature(machine) -> Dict:
 
 def config_signature(config, mesh_axes: Optional[Dict[str, int]]) -> Dict:
     sig: Dict = {"mesh_axes": sorted((mesh_axes or {}).items())}
+    # launch topology: a resized multi-host cohort (changed world size)
+    # must RE-SEARCH, never warm-hit a plan selected for the old
+    # topology — the elastic-resume contract (runtime/checkpoint.py).
+    # Only stamped when multi-process, so every pre-existing SINGLE-host
+    # cache entry keeps its key (a 2-proc entry carries the field, a
+    # 1-proc lookup does not — resized worlds still miss)
+    import jax
+
+    if jax.process_count() > 1:
+        sig["process_count"] = jax.process_count()
     for k in _SEARCH_KNOBS:
         sig[k] = _attr_sig(getattr(config, k, None))
     # extra substitution rules change the candidate set: hash the file

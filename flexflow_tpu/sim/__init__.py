@@ -16,6 +16,8 @@ from .machine_model import (
     CHIP_PRESETS,
     detect_machine_model,
     load_machine_model,
+    machine_model_from_config,
+    multihost_machine_model,
 )
 from .cost_model import CostMetrics, OpCostModel, ProfilingCostModel
 from .network import (
@@ -35,6 +37,8 @@ __all__ = [
     "CHIP_PRESETS",
     "detect_machine_model",
     "load_machine_model",
+    "machine_model_from_config",
+    "multihost_machine_model",
     "CostMetrics",
     "OpCostModel",
     "ProfilingCostModel",

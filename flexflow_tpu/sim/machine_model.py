@@ -371,6 +371,20 @@ def load_machine_model(path: str) -> MachineModel:
 
     with open(path) as f:
         cfg = json.load(f)
+    try:
+        return machine_model_from_config(cfg)
+    except (ValueError, KeyError, TypeError) as e:
+        # re-attach the file context for EVERY config-shaped failure
+        # (unknown chip preset raises KeyError, bad chip fields
+        # TypeError — not just ValueError)
+        raise ValueError(f"{type(e).__name__}: {e} (from {path})") from e
+
+
+def machine_model_from_config(cfg: Dict) -> MachineModel:
+    """Build a machine model from an in-memory ``load_machine_model``
+    schema dict (the launcher writes these per cohort —
+    ``parallel/multihost.two_level_mesh_spec`` — and tests build them
+    directly)."""
     chip_cfg = cfg.get("chip", "v5e")
     if isinstance(chip_cfg, str):
         chip = CHIP_PRESETS[chip_cfg]
@@ -408,7 +422,23 @@ def load_machine_model(path: str) -> MachineModel:
         return NetworkedMachineModel(
             chip, topo, axis_degrees,
             device_order=cfg.get("device_order"), dcn_axes=dcn_axes)
-    raise ValueError(f"unknown machine model version {version!r} in {path}")
+    raise ValueError(f"unknown machine model version {version!r}")
+
+
+def multihost_machine_model(num_processes: int, devices_per_process: int,
+                            model_degree: int = 1,
+                            chip: str = "v5e") -> MachineModel:
+    """The cohort's two-level pricing model: a
+    :class:`MultiSliceMachineModel` whose composed ``data`` axis is
+    priced at DCN bandwidth while any ``model`` axis stays on ICI —
+    built from the same plan the launcher's workers feed the search
+    (``parallel/multihost.two_level_mesh_spec``), so simulator pricing
+    and the executed layout can never drift apart."""
+    from ..parallel.multihost import two_level_mesh_spec
+
+    return machine_model_from_config(two_level_mesh_spec(
+        num_processes, devices_per_process, model_degree=model_degree,
+        chip=chip)["machine_model"])
 
 
 def detect_machine_model(n_devices: Optional[int] = None) -> MachineModel:

@@ -88,3 +88,34 @@ def test_extra_sidecar_roundtrip(tmp_path):
     assert mgr.restore_extra(step=5) is None
     mgr.restore(ff, step=4)  # state saved with extra still restores
     mgr.close()
+
+
+def test_restore_checks_sidecar_topology(tmp_path):
+    """A sidecar topology stamp from a DIFFERENT topology fails loudly
+    with the coded CKPT001 error instead of silently restoring into the
+    wrong sharding; check_topology=False (the counted elastic path) and
+    stamp-free legacy sidecars restore as before."""
+    import pytest
+
+    from flexflow_tpu.runtime.checkpoint import (CheckpointTopologyError,
+                                                 topology_signature)
+
+    ff = _model()
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    sig = topology_signature(ff.compiled.mesh)
+    mgr.save(ff, 1, extra={"schema": 1, "topology": sig})
+    # matching topology restores fine
+    ff2 = _model(seed=5)
+    assert mgr.restore(ff2, require_extra=True) == 1
+    # a stamp from another world fails with the coded error — and the
+    # newest-intact fallback must NOT swallow it (config error, not
+    # corruption)
+    mgr.save(ff, 2, extra={"schema": 1, "topology": {
+        **sig, "process_count": 4, "device_count": 32}})
+    ff3 = _model(seed=6)
+    with pytest.raises(CheckpointTopologyError) as ei:
+        mgr.restore(ff3, require_extra=True)
+    assert ei.value.code == "CKPT001"
+    # elastic override: explicit, counted, restores the newest step
+    assert mgr.restore_elastic(ff3) == 2
+    mgr.close()

@@ -1,0 +1,420 @@
+"""Elastic multi-host runtime: launcher, sharded checkpoints, elastic
+resume, and the multihost fault sites.
+
+The in-process tests drive :class:`MultiHostCheckpointManager` with
+explicit (process_id, process_count) pairs — the layout, manifest
+barrier, torn-manifest fallback, topology gate, and elastic restore are
+all testable without spawning a cohort. One subprocess test launches a
+REAL 2-process ``jax.distributed`` cohort through the supervisor
+(tools/mh_launch.py); the truly-unsupported in-process cross-process
+collective keeps its CPU-backend skip in tests/test_multihost.py."""
+
+import importlib.util
+import json
+import os
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from flexflow_tpu import FFConfig, FFModel, LossType
+from flexflow_tpu.models.mlp import build_mlp
+from flexflow_tpu.obs.metrics import metrics_registry
+from flexflow_tpu.runtime.checkpoint import (CheckpointTopologyError,
+                                             MultiHostCheckpointManager,
+                                             is_multihost_dir,
+                                             topology_matches,
+                                             topology_signature)
+from flexflow_tpu.runtime.optimizer import AdamOptimizer
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_mh_launch():
+    spec = importlib.util.spec_from_file_location(
+        "mh_launch", os.path.join(_REPO, "tools", "mh_launch.py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules.setdefault("mh_launch", mod)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _ctr(name):
+    m = metrics_registry().get(name)
+    return int(m.value) if m is not None else 0
+
+
+def _model(seed=3, mesh_shape=None, **cfg_kw):
+    ff = FFModel(FFConfig(batch_size=16, epochs=2, seed=seed,
+                          mesh_shape=mesh_shape or {}, **cfg_kw))
+    build_mlp(ff, 16, in_dim=8, hidden_dims=(16,), num_classes=4)
+    ff.compile(optimizer=AdamOptimizer(alpha=0.01),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=["sparse_categorical_crossentropy"])
+    return ff
+
+
+def _data(n=64, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 8)).astype(np.float32)
+    w = rng.normal(size=(8, 4)).astype(np.float32)
+    y = np.argmax(x @ w, axis=1).astype(np.int32).reshape(-1, 1)
+    return x, y
+
+
+def _params_np(ff):
+    return jax.tree.map(lambda a: np.asarray(a), ff.compiled.params)
+
+
+# ------------------------------------------------------------ fault sites
+def test_fault_plan_accepts_multihost_sites():
+    from flexflow_tpu.runtime.faults import SITES, FaultPlan
+
+    for site in ("multihost.init_timeout", "multihost.peer_kill",
+                 "multihost.slow_peer"):
+        assert site in SITES
+    plan = FaultPlan({"schema": 1, "seed": 0, "sites": {
+        "multihost.init_timeout": {"at_step": 1},
+        "multihost.peer_kill": {"at_step": 6, "exit_code": 43},
+        "multihost.slow_peer": {"at_step": 2, "stall_s": 0.5},
+    }})
+    assert plan.should_fire("multihost.init_timeout") is not None
+    with pytest.raises(ValueError, match="unknown rule keys"):
+        FaultPlan({"schema": 1, "sites": {
+            "multihost.init_timeout": {"at_step": 1, "stall_s": 1.0}}})
+
+
+def test_elastic_init_retries_injected_timeout():
+    from flexflow_tpu.parallel.multihost import elastic_init
+    from flexflow_tpu.runtime import faults
+
+    faults.configure_faults(type("_P", (), {"fault_plan": {
+        "schema": 1, "seed": 0,
+        "sites": {"multihost.init_timeout": {"at_step": 1}}}}))
+    try:
+        calls = []
+        before = _ctr("retry.mh_init.retries")
+        info = elastic_init(_init_fn=lambda: calls.append(1),
+                            base_delay_s=0.001, seed=0)
+        assert calls == [1]  # first attempt faulted BEFORE the init fn
+        assert info["attempts"] == 2
+        assert _ctr("retry.mh_init.retries") == before + 1
+        assert _ctr("faults.multihost.init_timeout") >= 1
+    finally:
+        faults.configure_faults(type("_Off", (), {"fault_plan": None}))
+
+
+def test_multiprocess_compute_support_single_process():
+    from flexflow_tpu.parallel.multihost import multiprocess_compute_support
+
+    supported, reason = multiprocess_compute_support()
+    assert supported is True and reason is None
+
+
+# -------------------------------------------------- two-level mesh + sim
+def test_two_level_mesh_spec_and_dcn_pricing():
+    from flexflow_tpu.parallel.multihost import two_level_mesh_spec
+    from flexflow_tpu.sim.machine_model import (machine_model_from_config,
+                                                multihost_machine_model)
+
+    spec = two_level_mesh_spec(2, 4, model_degree=2)
+    assert spec["mesh_shape"] == {"data": 2, "model": 2}
+    assert spec["dcn_mesh_shape"] == {"data": 2}
+    mm = spec["machine_model"]
+    assert mm["version"] == "multislice"
+    assert mm["axis_degrees"] == {"data": 4, "model": 2}
+    assert mm["dcn_axes"] == ["data"]
+    model = machine_model_from_config(mm)
+    assert model.dcn_axes == ("data",)
+    # DCN pricing: the cross-process data axis is slower than the same
+    # collective priced on ICI
+    ici_only = machine_model_from_config({**mm, "dcn_axes": []})
+    nbytes = 1 << 20
+    assert model.allreduce_time(nbytes, 4, axis="data") > \
+        ici_only.allreduce_time(nbytes, 4, axis="data")
+    # the convenience factory builds the same plan
+    m2 = multihost_machine_model(2, 4, model_degree=2)
+    assert m2.dcn_axes == ("data",)
+    with pytest.raises(ValueError, match="model_degree"):
+        two_level_mesh_spec(2, 4, model_degree=3)
+
+
+# ----------------------------------------------------- topology signature
+def test_topology_signature_and_match():
+    sig = topology_signature()
+    assert sig["process_count"] == 1
+    assert sig["device_count"] == 8
+    assert "mesh_axes" not in sig
+    ff = _model()
+    full = topology_signature(ff.compiled.mesh, process_count=2)
+    assert full["process_count"] == 2
+    assert full["mesh_axes"] == {"data": 8}
+    assert topology_matches(full, dict(full))
+    assert topology_matches(None, full)  # legacy sidecar: no stamp
+    assert not topology_matches(full, {**full, "process_count": 1})
+    # fields only one side carries don't constrain
+    assert topology_matches({"process_count": 2},
+                            {"process_count": 2, "mesh_axes": {"data": 8}})
+
+
+# --------------------------------------------- multihost manager (2 ranks)
+def _mh_save(tmp_path, step=1, extra=None, world=2):
+    """Simulate a 2-rank cohort in one process: rank 1 commits first,
+    then rank 0 (whose ack barrier then passes) publishes the manifest."""
+    ffs = [_model(seed=3), _model(seed=3)]
+    mgrs = [MultiHostCheckpointManager(str(tmp_path), process_id=r,
+                                       process_count=world)
+            for r in range(world)]
+    base = dict(extra or {"schema": 1, "epoch": 0, "step_in_epoch": 0,
+                          "rng_counter": 0, "lr": None, "guard": None})
+    for r in reversed(range(world)):
+        ffs[r].compiled.iteration = step
+        mgrs[r].save(ffs[r], step, extra=dict(base), wait=True)
+    return ffs, mgrs
+
+
+def test_mh_manager_roundtrip_and_manifest(tmp_path):
+    ffs, mgrs = _mh_save(tmp_path, step=4)
+    assert is_multihost_dir(str(tmp_path))
+    assert mgrs[0].latest_step() == 4
+    step, man = mgrs[0].latest_manifest()
+    assert step == 4
+    assert man["schema"] == 1
+    assert man["process_count"] == 2
+    assert man["topology"]["process_count"] == 2
+    assert man["mesh_axes"] == {"data": 8}
+    assert "strategy_key" in man
+    saved = _params_np(ffs[0])
+    fresh = _model(seed=99)
+    got = mgrs[0].restore(fresh, require_extra=True)
+    assert got == 4
+    for a, b in zip(jax.tree.leaves(saved),
+                    jax.tree.leaves(_params_np(fresh))):
+        np.testing.assert_array_equal(a, b)
+    assert fresh.compiled.iteration == 4
+    extra = mgrs[0].restore_extra(4)
+    assert extra["epoch"] == 0 and extra["topology"]["process_count"] == 2
+
+
+def test_mh_manager_topology_mismatch_is_coded(tmp_path):
+    _mh_save(tmp_path, step=2)
+    shrunk = MultiHostCheckpointManager(str(tmp_path), process_id=0,
+                                        process_count=1)
+    fresh = _model(seed=99)
+    with pytest.raises(CheckpointTopologyError) as ei:
+        shrunk.restore(fresh, require_extra=True)
+    assert ei.value.code == "CKPT001"
+    assert "CKPT001" in str(ei.value)
+    assert ei.value.found["process_count"] == 2
+
+
+def test_mh_manager_elastic_restore_changed_world(tmp_path):
+    ffs, _ = _mh_save(tmp_path, step=2)
+    saved = _params_np(ffs[0])
+    before = _ctr("checkpoint.elastic_resumes")
+    # shrink 2 -> 1: own shard (rank 0) exists
+    shrunk = MultiHostCheckpointManager(str(tmp_path), process_id=0,
+                                        process_count=1)
+    fresh = _model(seed=99)
+    assert shrunk.restore_elastic(fresh) == 2
+    for a, b in zip(jax.tree.leaves(saved),
+                    jax.tree.leaves(_params_np(fresh))):
+        np.testing.assert_array_equal(a, b)
+    # grow 2 -> 3: rank 2 has no shard of its own — shard 0 is the source
+    grown = MultiHostCheckpointManager(str(tmp_path), process_id=2,
+                                       process_count=3)
+    fresh2 = _model(seed=98)
+    assert grown.restore_elastic(fresh2) == 2
+    for a, b in zip(jax.tree.leaves(saved),
+                    jax.tree.leaves(_params_np(fresh2))):
+        np.testing.assert_array_equal(a, b)
+    assert _ctr("checkpoint.elastic_resumes") == before + 2
+
+
+def test_mh_manager_torn_manifest_falls_back(tmp_path):
+    ffs, mgrs = _mh_save(tmp_path, step=1)
+    step1 = _params_np(ffs[0])
+    for r in reversed(range(2)):
+        ffs[r].fit(*_data(), epochs=1, verbose=False)
+        ffs[r].compiled.iteration = 2
+        mgrs[r].save(ffs[r], 2, extra={"schema": 1}, wait=True)
+    # tear the NEWEST manifest (the global commit point)
+    with open(tmp_path / "manifest_2.json", "w") as f:
+        f.write('{"schema": 1, "step"')
+    before = _ctr("checkpoint.torn_manifests")
+    fresh = _model(seed=99)
+    assert mgrs[0].restore(fresh) == 1
+    assert _ctr("checkpoint.torn_manifests") > before
+    for a, b in zip(jax.tree.leaves(step1),
+                    jax.tree.leaves(_params_np(fresh))):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_elastic_init_real_failure_retried():
+    """A REAL bootstrap failure (not the injected fault) must also be
+    retried — and the failed attempt's cleanup path runs so the next
+    attempt is not poisoned by jax.distributed's initialize-only-once
+    global state."""
+    from flexflow_tpu.parallel.multihost import elastic_init
+
+    attempts = []
+
+    def _flaky():
+        attempts.append(1)
+        if len(attempts) == 1:
+            raise RuntimeError("connect timed out")
+
+    info = elastic_init(_init_fn=_flaky, base_delay_s=0.001, seed=0)
+    assert len(attempts) == 2 and info["attempts"] == 2
+
+
+def test_mh_manager_prune_keeps_manifested_payloads(tmp_path):
+    """A run of barrier-timeout saves (wedged peer => no manifests)
+    must not evict the payload the newest surviving manifest points at:
+    retention counts manifested steps, so restore's documented fallback
+    to the previous manifested step keeps working."""
+    ffs, mgrs = _mh_save(tmp_path, step=2, world=2)  # manifested step 2
+    saved = _params_np(ffs[0])
+    lone = MultiHostCheckpointManager(str(tmp_path), process_id=0,
+                                      process_count=2, max_to_keep=2,
+                                      barrier_timeout_s=0.1)
+    for step in (4, 6, 8):  # rank 1 gone: acks never complete
+        ffs[0].compiled.iteration = step
+        lone.save(ffs[0], step, extra={"schema": 1}, wait=True)
+    # un-manifested payloads beyond the keep window pruned, but the
+    # manifested step 2 payload SURVIVES even though it is older
+    assert os.path.exists(tmp_path / "shard-000" / "step_2.npz")
+    assert not os.path.exists(tmp_path / "shard-000" / "step_4.npz")
+    fresh = _model(seed=99)
+    assert mgrs[0].restore(fresh) == 2
+    for a, b in zip(jax.tree.leaves(saved),
+                    jax.tree.leaves(_params_np(fresh))):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_mh_manager_ack_barrier_timeout_skips_manifest(tmp_path):
+    ff = _model(seed=3)
+    mgr = MultiHostCheckpointManager(str(tmp_path), process_id=0,
+                                     process_count=2,
+                                     barrier_timeout_s=0.2)
+    before = _ctr("checkpoint.barrier_timeouts")
+    mgr.save(ff, 5, extra={"schema": 1}, wait=True)  # rank 1 never acks
+    assert _ctr("checkpoint.barrier_timeouts") == before + 1
+    # no manifest => the step never became globally visible
+    assert mgr.latest_step() is None
+    assert not os.path.exists(tmp_path / "manifest_5.json")
+    # ...but the shard payload itself committed (a later cohort-wide
+    # step can still manifest)
+    assert os.path.exists(tmp_path / "shard-000" / "step_5.npz")
+
+
+def test_mh_manager_stale_ack_incarnation_guard(tmp_path):
+    """An ack left by a torn-down PREVIOUS launch (acks are never
+    pruned) must not let rank 0 manifest a step its peer has not
+    re-committed this incarnation."""
+    ff0, ff1 = _model(seed=3), _model(seed=3)
+    stale = MultiHostCheckpointManager(str(tmp_path), process_id=1,
+                                       process_count=2, launch_id="old")
+    ff1.compiled.iteration = 5
+    stale.save(ff1, 5, extra={"schema": 1}, wait=True)
+    assert os.path.exists(tmp_path / "shard-001" / "ack_5.json")
+    new0 = MultiHostCheckpointManager(str(tmp_path), process_id=0,
+                                      process_count=2, launch_id="new",
+                                      barrier_timeout_s=0.2)
+    ff0.compiled.iteration = 5
+    new0.save(ff0, 5, extra={"schema": 1}, wait=True)
+    # the stale ack did NOT count: no manifest for step 5
+    assert not os.path.exists(tmp_path / "manifest_5.json")
+    # the peer re-commits under the CURRENT incarnation -> manifests
+    new1 = MultiHostCheckpointManager(str(tmp_path), process_id=1,
+                                      process_count=2, launch_id="new")
+    new1.save(ff1, 5, extra={"schema": 1}, wait=True)
+    new0.save(ff0, 5, extra={"schema": 1}, wait=True)
+    assert os.path.exists(tmp_path / "manifest_5.json")
+
+
+def test_fit_elastic_resume_on_changed_topology(tmp_path):
+    """A shrunk relaunch resuming a 2-process cohort's directory: the
+    default is the coded CKPT001 error; config.elastic_resume opts into
+    the counted portable restore and training continues."""
+    ffs, _ = _mh_save(tmp_path, step=4)
+    saved = _params_np(ffs[0])
+    x, y = _data()
+    # default: loud coded failure, never a silent mismatched load
+    ff_strict = _model(seed=99)
+    with pytest.raises(CheckpointTopologyError):
+        ff_strict.fit(x, y, verbose=False, resume_from=str(tmp_path))
+    # elastic: portable restore + keep training
+    before = _ctr("checkpoint.elastic_resumes")
+    ff2 = _model(seed=99, elastic_resume=True)
+    hist = ff2.fit(x, y, epochs=1, verbose=False,
+                   resume_from=str(tmp_path))
+    assert len(hist) == 1 and np.isfinite(hist[-1].sparse_cce_loss)
+    assert _ctr("checkpoint.elastic_resumes") == before + 1
+    # params actually came from the cohort's shard before training on
+    assert ff2.compiled.iteration > 4  # trained past the restored step
+
+
+# --------------------------------------------------------- ledger cohorts
+def test_model_context_process_count_knob(monkeypatch):
+    from flexflow_tpu.obs.ledger import cohort_key, model_context
+
+    ff = _model()
+    ctx1 = model_context(ff)
+    assert "process_count" not in ctx1["knobs"]  # single-host unchanged
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    ctx2 = model_context(ff)
+    assert ctx2["knobs"]["process_count"] == 2
+    rec1 = {"kind": "fit", "perf": {"metric": "fit.steps_per_s"}, **ctx1}
+    rec2 = {"kind": "fit", "perf": {"metric": "fit.steps_per_s"}, **ctx2}
+    assert cohort_key(rec1) != cohort_key(rec2)
+
+
+def test_ledger_merge_dedupes_to_one_cohort(tmp_path):
+    from flexflow_tpu.obs.ledger import merge_runs, record_run, scan_ledger
+
+    dirs = [str(tmp_path / f"rank-{r}") for r in range(2)]
+    for i, d in enumerate(dirs):
+        cfg = type("_C", (), {"ledger": "on", "ledger_dir": d})
+        record_run("fit", {"model_sig": "abc", "knobs": {
+            "process_count": 2}, "rank": i}, config=cfg)
+    cohort = str(tmp_path / "cohort")
+    merged = sum(merge_runs(d, cohort) for d in dirs)
+    assert merged == 2
+    # idempotent: run_id dedupe makes a re-merge a no-op
+    assert sum(merge_runs(d, cohort) for d in dirs) == 0
+    runs = scan_ledger(cohort)["runs"]
+    assert len(runs) == 2
+    assert {r["knobs"]["process_count"] for r in runs} == {2}
+
+
+# ----------------------------------------------------- the real launcher
+def test_supervised_two_process_fit(tmp_path):
+    """A REAL 2-process jax.distributed cohort through the supervisor:
+    both workers bootstrap, train the same trajectory, and the merged
+    ledger is one deduped cohort. (Launch mechanics only — search off;
+    the kill/hang/shrink matrix runs under `make mh-smoke`/`make
+    chaos`.)"""
+    mh = _load_mh_launch()
+    rep = mh.supervise(nproc=2, run_dir=str(tmp_path / "run"),
+                       epochs=1, interval=0, devices_per_proc=2,
+                       max_relaunches=0, no_search=True,
+                       cohort_timeout_s=360.0)
+    assert rep["ok"], rep
+    assert rep["relaunches"] == 0 and rep["events"] == []
+    res = rep["results"]
+    assert set(res) == {"0", "1"}
+    assert res["0"]["scope"] in ("global", "local_replica")
+    assert res["0"]["topology"]["process_count"] == 2
+    # one cohort: same trajectory on every rank, one deduped ledger
+    assert rep["agree"], res
+    assert rep["ledger"]["merged"] >= 2
+    assert rep["ledger"]["remerged"] == 0
+    from flexflow_tpu.obs.ledger import scan_ledger
+
+    fits = [r for r in scan_ledger(rep["ledger"]["cohort_dir"])["runs"]
+            if r.get("kind") == "fit"]
+    assert len(fits) == 2
+    assert all((r.get("knobs") or {}).get("process_count") == 2
+               for r in fits)

@@ -28,7 +28,16 @@ the RECOVERY — not just the failure — worked:
   within its budget, and the breaker opens after consecutive failures;
 * ``ledger_cohort_exclusion`` — chaotic fit records carry a ``faults``
   block and ``tools/perf_sentinel.py`` excludes them from every perf
-  cohort (``faulted_excluded`` > 0).
+  cohort (``faulted_excluded`` > 0);
+* ``multihost`` — the elastic-runtime matrix (tools/mh_launch.py):
+  a 2-process jax.distributed cohort baseline, a mid-fit
+  ``multihost.peer_kill`` of one peer that the supervisor detects and
+  relaunch-resumes **bit-identically** from the sharded checkpoints,
+  and a shrunk-to-1-process resume that re-runs search (topology-keyed
+  strategy-cache miss + counted elastic restore) instead of loading a
+  mismatched shard layout. ``--skip-multihost`` drops it (it spawns
+  subprocess cohorts); ``make mh-smoke`` runs the FULL matrix
+  including the hang/init-retry scenarios.
 
 Prints ONE line::
 
@@ -455,6 +464,19 @@ def _scenario_ledger_exclusion(violations, ledger_dir) -> dict:
     return row
 
 
+def _scenario_multihost(violations) -> dict:
+    """Elastic multi-host matrix (kill→relaunch-resume bit-identity +
+    shrink→re-search), delegated to tools/mh_launch.py's scenario
+    runner against its own scratch dirs."""
+    import mh_launch
+
+    out = mh_launch.run_matrix(
+        scenarios=("kill_resume", "shrink_resize"))
+    for v in out["violations"]:
+        violations.append(f"multihost: {v}")
+    return {name: row for name, row in out["scenarios"].items()}
+
+
 # ------------------------------------------------------------------- main
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
@@ -466,6 +488,9 @@ def main(argv=None) -> int:
     ap.add_argument("--resume-from", default=None)
     ap.add_argument("--skip-subprocess", action="store_true",
                     help="skip the (slower) kill/resume subprocess matrix")
+    ap.add_argument("--skip-multihost", action="store_true",
+                    help="skip the multi-process elastic-runtime matrix "
+                         "(tools/mh_launch.py cohorts)")
     ns = ap.parse_args(argv)
     if ns.child == "fit":
         return _child_fit(ns)
@@ -489,6 +514,8 @@ def main(argv=None) -> int:
     scenarios["serving_degradation"] = _scenario_serving(violations)
     scenarios["ledger_cohort_exclusion"] = _scenario_ledger_exclusion(
         violations, ledger_dir)
+    if not ns.skip_subprocess and not ns.skip_multihost:
+        scenarios["multihost"] = _scenario_multihost(violations)
     out = {
         "scenarios": scenarios,
         "violations": violations,

@@ -194,13 +194,11 @@ def run_sentinel(ledger_dir: Optional[str] = None,
                         "exec_telemetry=on)"})
 
     # ---- watchdog block: live process state + on-disk dump count -----
+    from flexflow_tpu.obs.watchdog import list_dumps
+
     wd = watchdog().stats()
     bdir = blackbox_dir or wd.get("dump_dir") or _BLACKBOX_DEFAULT
-    try:
-        dumps = sorted(n for n in os.listdir(bdir)
-                       if n.startswith("blackbox-"))
-    except OSError:
-        dumps = []
+    dumps = [os.path.basename(p) for p in list_dumps(bdir)]
     wd_rec = _newest_with(runs, "watchdog")
     watchdog_block = {
         "live": wd,
