@@ -40,7 +40,11 @@
 #                   tokens/s and the decode loop issued exactly one
 #                   dispatch per decode step; appends the
 #                   serving.tokens_per_s ledger record the sentinel
-#                   cohorts
+#                   cohorts; a second --trace longtail invocation
+#                   replays a seeded length-distribution trace and
+#                   exits non-zero unless token-budget prefill
+#                   batching strictly beats uniform pad-to-max with
+#                   identical generated sequences
 #   make obs-report — flight-recorder smoke (obs/): traced pipelined fit
 #                     + serving requests -> one JSON line with the trace
 #                     event counts (schema-validated), the metrics
@@ -169,6 +173,7 @@ serve-bench:
 # decode dispatch per step regardless of active-request count
 serve-bench-smoke:
 	$(CPU_MESH) $(PY) tools/serve_bench.py --smoke
+	$(CPU_MESH) $(PY) tools/serve_bench.py --smoke --trace longtail
 
 obs-report:
 	$(CPU_MESH) $(PY) tools/obs_report.py

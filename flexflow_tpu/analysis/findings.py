@@ -120,6 +120,22 @@ CODE_CATALOG: Dict[str, str] = {
               "step program (mutating it silently reuses the stale "
               "executable — jit only re-traces on argument changes), or "
               "a static argument value is unhashable",
+    # dynamic shapes (runtime/buckets.py) — token-native bucketing /
+    # packing contract violations, raised at plan time (AUD006's dynamic
+    # complement: every bucket compile is planned and counted, so these
+    # codes fire where an unplanned shape would otherwise retrace)
+    "DYN001": "row length exceeds the bucket ladder top — the ladder "
+              "was resolved against different data; dispatching would "
+              "silently retrace at an unplanned width",
+    "DYN002": "non-trailing label padding: a -1 appears before a valid "
+              "token, so pad-to-row-length would drop real tokens — "
+              "bucketed packing requires trailing padding only",
+    "DYN003": "dynamic-shape misconfiguration: bad seq_buckets/"
+              "seq_bucket_pad_max spec, token_budget without a ladder, "
+              "non-(N,S) sparse-CE labels, or a mode the packed loader "
+              "cannot serve (dense loss, pipelined fit)",
+    "DYN004": "token_budget below the ladder top — a max-length row "
+              "could never ship within the budget",
     # concurrency auditor (analysis/concurrency_check.py) — whole-package
     # thread-role / lock-graph / shared-state checks
     "CCY000": "unparseable module (syntax error) — excluded from the "

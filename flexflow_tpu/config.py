@@ -306,6 +306,13 @@ class FFConfig:
     # prompts prefilled between two decode steps while requests are
     # active — bounds the decode stall a prompt burst can cause
     serving_max_prefills_per_step: int = 1
+    # token-budget prefill batching: when > 0, one admission pass groups
+    # its admitted prompts by prefill bucket and dispatches up to
+    # floor(budget / bucket) prompts per bucketed prefill call (row
+    # counts padded to powers of two so the compile set stays bounded).
+    # 0 (default) = one prompt per prefill dispatch, the historical
+    # behavior.
+    serving_prefill_token_budget: int = 0
     # numerics
     computation_mode: CompMode = CompMode.TRAINING
     # mixed precision: "bfloat16" runs activations/matmuls in bf16 on the
@@ -375,6 +382,32 @@ class FFConfig:
     # fit falls back to K=1 when a recompile_state or the pipeline engine
     # needs step granularity.
     steps_per_dispatch: int = 1
+    # --- token-native dynamic shapes (runtime/buckets.py) -----------------
+    # bucketed train/eval compilation: pad each ragged batch's sequence
+    # dim to the smallest ladder bucket that fits its longest row instead
+    # of the data's max. "off" (default) = pad-to-max, the historical
+    # single-executable behavior; "pow2" = powers of two from
+    # seq_bucket_min up to seq_bucket_max; or an explicit comma list
+    # ("32,64,128"). One executable per (rows, bucket) shape, counted on
+    # fit.bucket_compiles and attributed on the ledger; row lengths come
+    # from the sparse-CE label tensor's trailing -1 padding.
+    seq_buckets: str = "off"
+    seq_bucket_min: int = 8
+    # ladder ceiling; 0 = the data's sequence dim
+    seq_bucket_max: int = 0
+    # token-budget batch packing (runtime/dataloader.py): when > 0, fit
+    # groups the shuffled epoch by token budget instead of a fixed row
+    # count — each packed batch pads to one shared bucket b and holds at
+    # most budget // b rows (row counts quantized to pow2 multiples of
+    # the data-parallel degree so the executable set stays bounded). A
+    # pure function of (seed, epoch lengths), so resume/replay and the
+    # chaos invariants hold. Requires seq_buckets != "off". 0 = off.
+    token_budget: int = 0
+    # A/B complement for tools/fit_bench.py --ragged: "on" keeps the
+    # token-budget packing PLAN (same groups, same order) but pads every
+    # batch's seq dim to the ladder max — the pad-to-max baseline with
+    # bit-comparable per-step trajectories. "off" (default) = bucketed.
+    seq_bucket_pad_max: str = "off"
     seed: int = 0
     # mesh description: axis names and sizes; None => 1-D data mesh over all
     # visible devices (reference analog: register_all_machine_views'
@@ -566,6 +599,16 @@ class FFConfig:
                 cfg.serving_prefill_buckets = _next()
             elif a == "--serving-max-prefills":
                 cfg.serving_max_prefills_per_step = int(_next())
+            elif a == "--serving-prefill-token-budget":
+                cfg.serving_prefill_token_budget = int(_next())
+            elif a == "--seq-buckets":
+                cfg.seq_buckets = _next()
+            elif a == "--seq-bucket-min":
+                cfg.seq_bucket_min = int(_next())
+            elif a == "--seq-bucket-max":
+                cfg.seq_bucket_max = int(_next())
+            elif a == "--token-budget":
+                cfg.token_budget = int(_next())
             # unknown flags are ignored, matching the reference's tolerance
             i += 1
         return cfg

@@ -68,7 +68,7 @@ RULE_FAMILIES: Dict[str, tuple] = {
     "pipeline_bubble": ("schedule", "microbatches"),
     "collective_transfer": ("mesh_reshape",),
     "optimizer_fold": ("optimizer_sharding",),
-    "device_compute": ("precision", "fusion"),
+    "device_compute": ("precision", "fusion", "token_bucketing"),
     # serving phases (continuous-batching session records)
     "queue_wait": ("decode_slots", "kv_pool"),
     "prefill": ("prefill_interleave",),
@@ -277,6 +277,38 @@ def _rule_device_compute(s: float, total: float, knobs: Dict) -> List[Dict]:
     return out
 
 
+def _rule_token_bucketing(s: float, total: float, knobs: Dict,
+                          buckets: Dict) -> List[Dict]:
+    """Padded-token-heavy bucketed fit: the record's bucket block
+    (ledger ``buckets``, from ``fit_profile``) carries the measured
+    padded-token fraction, which prices the dead device FLOPs directly
+    — every padded position runs the full forward/backward and
+    contributes an exact zero."""
+    frac = float(buckets.get("padded_token_fraction") or 0.0)
+    if frac <= 0.2:
+        return []
+    ladder = buckets.get("ladder") or []
+    top = int(ladder[-1]) if ladder else 0
+    pct = round(frac * 100, 1)
+    if buckets.get("pad_max"):
+        return [_sug(
+            "device_compute", "token_bucketing", "seq_bucket_pad_max",
+            "on", "off", {"seq_bucket_pad_max": "off"}, s * frac, total,
+            "modeled", "padded_flops_fraction",
+            f"{pct}% of dispatched tokens are padding at the ladder "
+            f"top; dispatching each group at its own rung removes the "
+            f"width padding (bit-identical loss trajectory)")]
+    if int(buckets.get("token_budget") or 0) <= 0 and top:
+        return [_sug(
+            "device_compute", "token_bucketing", "token_budget", 0,
+            top * 4, {"token_budget": top * 4}, 0.5 * s * frac, total,
+            "modeled", "padded_flops_fraction",
+            f"{pct}% of dispatched tokens are padding with fixed-row "
+            f"batches; packing rows under a {top * 4}-token budget "
+            f"fills short-row groups (seed-deterministic plan)")]
+    return []
+
+
 # --------------------------------------------------------- serving rules
 def _serving_phase_means(rec: Dict) -> Dict[str, float]:
     out = {}
@@ -411,6 +443,10 @@ def advise_record(rec: Dict,
         if secs.get("device_compute", 0) > 0:
             sugs += _rule_device_compute(secs["device_compute"], measured,
                                          knobs)
+            if rec.get("buckets"):
+                sugs += _rule_token_bucketing(secs["device_compute"],
+                                              measured, knobs,
+                                              rec["buckets"])
         if not sugs:
             return None
         report = {

@@ -245,6 +245,19 @@ def append_rows(rows: List[Dict], config=None,
                 "error": f"{type(e).__name__}: {e}"}
 
 
+def merge_corpus(src_dir: str, dst_dir: str) -> int:
+    """Fold another corpus directory's rows into ``dst_dir`` (e.g.
+    pulling worker-host corpora onto the coordinator after a
+    ``tools/mh_launch.py`` cohort run), de-duplicated by the content
+    ``key`` — the :func:`~flexflow_tpu.obs.ledger.merge_runs` discipline
+    applied to the training set: merging is idempotent, and the same op
+    profiled on the same machine by N ranks converges to ONE row.
+    Returns the number of rows appended."""
+    fresh = [r for r in scan_corpus(src_dir)["rows"]]
+    out = append_rows(fresh, dirpath=dst_dir)
+    return int(out.get("appended", 0))
+
+
 def load_rows(dirpath: Optional[str] = None,
               op_type: Optional[str] = None, **match) -> List[Dict]:
     """The filtered corpus (e.g. ``op_type="linear"`` for a per-op-type
@@ -280,5 +293,5 @@ def maybe_collect_corpus(ffmodel) -> Optional[Dict]:
 __all__ = [
     "CORPUS_SCHEMA", "append_rows", "build_rows", "corpus_dir",
     "corpus_mode", "existing_keys", "load_rows", "maybe_collect_corpus",
-    "op_features", "row_key", "scan_corpus",
+    "merge_corpus", "op_features", "row_key", "scan_corpus",
 ]

@@ -300,6 +300,23 @@ def model_context(ff) -> Dict:
         ctx["knobs"]["pipeline_submesh"] = json.dumps(
             sorted((a, s) for a, s in mesh_axis_sizes(pm.mesh).items()
                    if a != pm.cfg.axis and s > 1))
+    if getattr(ff.config, "seq_buckets", "off") not in (None, "off"):
+        # the RESOLVED dynamic-shape envelope (the pipeline-envelope
+        # pattern): a bucketed run dispatches per-(rows, rung)
+        # executables over packed batches — a different throughput
+        # regime — so the resolved ladder and token budget key its
+        # cohort apart; static-shape records stay knob-free and their
+        # baselines untouched
+        ladder = getattr(ff, "_resolved_ladder", None)
+        ctx["knobs"]["seq_bucket_ladder"] = json.dumps(
+            list(ladder) if ladder
+            else [getattr(ff.config, "seq_buckets", None)])
+        ctx["knobs"]["token_budget"] = getattr(
+            ff, "_resolved_token_budget",
+            getattr(ff.config, "token_budget", 0))
+        pad_max = getattr(ff.config, "seq_bucket_pad_max", "off")
+        if pad_max != "off":
+            ctx["knobs"]["seq_bucket_pad_max"] = pad_max
     return ctx
 
 
@@ -409,6 +426,12 @@ def record_fit(ff, kind: str = "fit") -> Optional[Dict]:
             **_scalars(prof),
             "epochs": [dict(e) for e in prof.get("epochs") or []],
         }
+        if prof.get("buckets"):
+            # dynamic-shape envelope: _scalars drops nested dicts, so
+            # the bucket block (ladder, padded-token fraction, counted
+            # recompile misses) is copied onto the record explicitly —
+            # the advisor's token-bucketing rule reads it from here
+            rec["buckets"] = dict(prof["buckets"])
         if prof.get("divergence"):
             rec["divergence"] = _divergence_for_ledger(
                 prof["divergence"], ff.config)

@@ -336,6 +336,16 @@ class EpochThroughput:
         self._m_steps.inc(n)
         self._m_bytes.inc(nbytes)
 
+    def record_tokens(self, valid: int, total: int) -> None:
+        """Token accounting for dynamic-shape epochs (runtime/buckets.py
+        plans): ``valid`` real tokens out of ``total`` dispatched —
+        ``finish()`` emits the padded-token fraction only when this was
+        recorded, so fixed-shape epoch records are unchanged."""
+        v, t = getattr(self, "_tokens", (0, 0))
+        self._tokens = (v + int(valid), t + int(total))
+        _REGISTRY.counter(f"{self.prefix}.valid_tokens").inc(int(valid))
+        _REGISTRY.counter(f"{self.prefix}.total_tokens").inc(int(total))
+
     def finish(self) -> Dict:
         wall = time.perf_counter() - self._t0
         occ = (self._inflight_sum / self._inflight_obs
@@ -343,7 +353,7 @@ class EpochThroughput:
         if wall > 0:
             _REGISTRY.gauge(f"{self.prefix}.steps_per_s").set(
                 round(self.steps / wall, 3))
-        return {
+        rec = {
             "steps": self.steps,
             "wall_s": round(wall, 6),
             "steps_per_s": round(self.steps / wall, 3) if wall > 0 else 0.0,
@@ -353,6 +363,12 @@ class EpochThroughput:
             "queue_depth_hist": dict(sorted(self.depth_hist.items())),
             "dispatch_ahead_occupancy": round(occ, 3),
         }
+        tokens = getattr(self, "_tokens", None)
+        if tokens is not None:
+            rec["tokens"] = tokens[0]
+            rec["padded_token_fraction"] = round(
+                1.0 - tokens[0] / max(1, tokens[1]), 6)
+        return rec
 
 
 __all__ = [

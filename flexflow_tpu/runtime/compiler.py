@@ -96,6 +96,24 @@ class CompiledModel:
     # ``id(params)`` (ids are reusable after GC) or pinning the old tree
     # alive.
     params_version: int = 0
+    # dispatch-shape ledger for bucketed train/eval (config.seq_buckets):
+    # every (kind, rows, seq_length) this model has dispatched. The fit
+    # loop consults it BEFORE dispatch so an unseen bucket shape is a
+    # counted, ledger-attributed compile miss, never a silent retrace
+    # (AUD006 is the static complement). Lives on the CompiledModel so
+    # replaying a seen trace across fit() calls registers zero misses.
+    _seen_shapes: set = dataclasses.field(default_factory=set)
+
+    def note_dispatch_shape(self, kind: str, rows: int,
+                            seq_length: int) -> bool:
+        """Record a (kind, rows, seq_length) dispatch shape; True the
+        first time it is seen — the caller counts that as the bucket
+        compile the matching jit retrace is about to pay."""
+        key = (kind, int(rows), int(seq_length))
+        if key in self._seen_shapes:
+            return False
+        self._seen_shapes.add(key)
+        return True
 
     # ---- public resume-state surface ---------------------------------- #
     # Checkpoint, recompile, playoff and ledger paths all need the step
@@ -454,6 +472,11 @@ def compile_model(
     from_logits = _logits_op is None or _logits_op.op_type is not OpType.SOFTMAX
 
     cdt = _resolve_compute_dtype(config.compute_dtype)
+    # token-native dynamic shapes: bucketed compiles pad rows with -1
+    # labels, and the masked sparse-CE path makes those positions exact
+    # zeros in loss/metrics/gradients. Compile-time constant — with the
+    # knob off the historical unmasked programs are traced unchanged.
+    mask_pad = getattr(config, "seq_buckets", "off") != "off"
 
     def _f32(x):
         # loss/metrics always in float32, whatever the compute dtype
@@ -479,7 +502,8 @@ def compile_model(
                 seq_length, cdt,
             )
             logits = _f32(acts[logits_id])
-            loss = compute_loss(loss_type, logits, y, from_logits)
+            loss = compute_loss(loss_type, logits, y, from_logits,
+                                mask_pad)
             for a in aux:
                 loss = loss + _f32(a)
             # weight regularizers (keras frontend: kernel_regularizer attr;
@@ -496,7 +520,7 @@ def compile_model(
         if accum == 1:
             (loss, (logits, updates)), grads = vag(params, xs, y, rng)
             batch_metrics = compute_batch_metrics(
-                metrics, loss_type, logits, y, from_logits)
+                metrics, loss_type, logits, y, from_logits, mask_pad)
         else:
             # gradient accumulation: split the batch into K microbatches,
             # run them through a lax.scan (ONE compiled body, K x less
@@ -516,7 +540,7 @@ def compile_model(
             def one(xs_i, y_i, rng_i):
                 (li, (lgi, updi)), gi = vag(params, xs_i, y_i, rng_i)
                 bmi = compute_batch_metrics(
-                    metrics, loss_type, lgi, y_i, from_logits)
+                    metrics, loss_type, lgi, y_i, from_logits, mask_pad)
                 return li, gi, bmi, updi
 
             def micro(carry, mb):
@@ -611,7 +635,8 @@ def compile_model(
                 ops, mesh, params, dict(zip(input_ids, xs)), True, rng,
                 seq_length, cdt,
             )
-            loss = compute_loss(loss_type, _f32(acts[logits_id]), y, from_logits)
+            loss = compute_loss(loss_type, _f32(acts[logits_id]), y,
+                                from_logits, mask_pad)
             for a in aux:
                 loss = loss + _f32(a)
             return loss
@@ -625,8 +650,10 @@ def compile_model(
         acts, _, _ = _forward_graph(ops, mesh, params, dict(zip(input_ids, xs)),
                                     False, None, seq_length, cdt)
         logits = _f32(acts[logits_id])
-        loss = compute_loss(loss_type, logits, y, from_logits) if loss_type else jnp.zeros(())
-        return loss, logits, compute_batch_metrics(metrics, loss_type, logits, y, from_logits)
+        loss = (compute_loss(loss_type, logits, y, from_logits, mask_pad)
+                if loss_type else jnp.zeros(()))
+        return loss, logits, compute_batch_metrics(
+            metrics, loss_type, logits, y, from_logits, mask_pad)
 
     def forward_fn(params, *xs, seq_length: int = -1):
         acts, _, _ = _forward_graph(ops, mesh, params, dict(zip(input_ids, xs)),

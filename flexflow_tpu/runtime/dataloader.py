@@ -37,6 +37,7 @@ from jax.sharding import NamedSharding, PartitionSpec
 from ..obs.trace import tracer
 from ..obs.watchdog import beat as _wd_beat
 from ..obs.watchdog import watch as _wd_watch
+from .buckets import PackingSpec, build_epoch_plan, plan_token_stats
 from .faults import TransientFault
 from .faults import active as _faults_active
 from .faults import inject as _fault_inject
@@ -156,14 +157,35 @@ class DataLoaderGroup:
     Python-level ahead-of-time queue over either.
     """
 
-    def __init__(self, loaders: List[SingleDataLoader], seed: int = 0, shuffle: bool = False):
+    def __init__(self, loaders: List[SingleDataLoader], seed: int = 0,
+                 shuffle: bool = False,
+                 packing: Optional[PackingSpec] = None,
+                 lengths: Optional[np.ndarray] = None):
         assert loaders
         n = {l.num_samples for l in loaders}
         assert len(n) == 1, "all loaders must have the same sample count"
         self.loaders = loaders
         self.shuffle = shuffle
         self._rng = np.random.default_rng(seed)
+        # token-native dynamic shapes (runtime/buckets.py): when a
+        # PackingSpec rides along, every epoch reset rebuilds the packed
+        # plan from the permuted per-row ``lengths`` — batches become
+        # (pad_rows, width) groups padded to their ladder rung instead
+        # of fixed (batch_size, max) slabs. The plan is a pure function
+        # of (seed, epoch), so skip/replay/resume reproduce it exactly.
+        self.packing = packing
+        self._lengths = (np.asarray(lengths, dtype=np.int64)
+                        if lengths is not None else None)
+        self._pack_plan = None
+        self._plan_idx = 0
+        self._row_cursor = 0
+        self._pack_perm: Optional[np.ndarray] = None
+        self.epoch_token_stats: Tuple[int, int] = (0, 0)
         self._native = None
+        if packing is not None:
+            # packed assembly is Python-only: the native loader's
+            # fixed-row prefetch cannot express variable (rows, width)
+            return
         try:
             from .. import native_bridge
 
@@ -182,12 +204,18 @@ class DataLoaderGroup:
 
     @property
     def num_batches(self) -> int:
+        if self.packing is not None:
+            assert self._pack_plan is not None, \
+                "packed loader group used before its first reset()"
+            return len(self._pack_plan)
         return self.loaders[0].num_batches
 
     @property
     def batch_nbytes(self) -> int:
         if self._native is not None:
             return self._native.batch_nbytes
+        # packed mode: batch geometry varies per group; the fixed-row
+        # estimate below stays the throughput-accounting approximation
         return sum(l.batch_nbytes for l in self.loaders)
 
     def reset(self, reshuffle: bool = True) -> None:
@@ -200,6 +228,16 @@ class DataLoaderGroup:
             perm = self._rng.permutation(self.loaders[0].num_samples)
             for l in self.loaders:
                 l.perm = perm
+        if self.packing is not None:
+            perm = self.loaders[0].perm
+            if perm is None:  # shuffle off: epoch order is dataset order
+                perm = np.arange(self.loaders[0].num_samples)
+            self._pack_perm = perm  # concurrency: race-ok (epoch handshake: worker joins before reset)
+            self._pack_plan = build_epoch_plan(self._lengths[perm],  # concurrency: race-ok (epoch handshake: worker joins before reset)
+                                               self.packing)
+            self._plan_idx = 0  # concurrency: race-ok (epoch handshake: worker joins before reset)
+            self._row_cursor = 0  # concurrency: race-ok (epoch handshake: worker joins before reset)
+            self.epoch_token_stats = plan_token_stats(self._pack_plan)
 
     def advance_epochs(self, n: int) -> None:
         """Advance the shuffle stream exactly as ``n`` epoch resets
@@ -214,11 +252,50 @@ class DataLoaderGroup:
         placement) — the resume path's fast-forward within an epoch.
         Implemented as real host pulls so cursor/wrap/native semantics
         stay bit-identical to the steps the original run took."""
+        if self.packing is not None:
+            # cursor arithmetic only — the gather/pad work is pure
+            # function of the plan, so skipping it cannot drift
+            for _ in range(max(0, int(n))):
+                if self._plan_idx >= len(self._pack_plan):
+                    self._plan_idx = 0  # concurrency: race-ok (single consumer per epoch, worker joins first)
+                    self._row_cursor = 0  # concurrency: race-ok (single consumer per epoch, worker joins first)
+                self._row_cursor += self._pack_plan[self._plan_idx].rows  # concurrency: race-ok (single consumer per epoch)
+                self._plan_idx += 1  # concurrency: race-ok (single consumer per epoch)
+            return
         for _ in range(max(0, int(n))):
             self.next_batch_host()
 
+    def _next_packed_host(self) -> List[np.ndarray]:
+        """One packed group: ``rows`` consecutive permuted samples,
+        sequence dims sliced to the group's rung, row count padded to
+        ``pad_rows`` with all-padding rows (labels -1 -> the masked
+        loss/metric paths make them exact zeros)."""
+        if self._plan_idx >= len(self._pack_plan):
+            # wrap like SingleDataLoader: replay the epoch plan without
+            # redrawing the permutation
+            self._plan_idx = 0  # concurrency: race-ok (single consumer per epoch, worker joins first)
+            self._row_cursor = 0  # concurrency: race-ok (single consumer per epoch, worker joins first)
+        g = self._pack_plan[self._plan_idx]
+        idx = self._pack_perm[self._row_cursor:self._row_cursor + g.rows]
+        self._plan_idx += 1  # concurrency: race-ok (single consumer per epoch)
+        self._row_cursor += g.rows  # concurrency: race-ok (single consumer per epoch)
+        out = []
+        spec = self.packing
+        for li, l in enumerate(self.loaders):
+            rows = l.data[idx]
+            if spec.seq_axes[li]:
+                rows = rows[:, :g.width]
+            if g.pad_rows > g.rows:
+                pad = np.full((g.pad_rows - g.rows,) + rows.shape[1:],
+                              spec.pad_values[li], dtype=rows.dtype)
+                rows = np.concatenate([rows, pad])
+            out.append(np.ascontiguousarray(rows))
+        return out
+
     def next_batch_host(self) -> List[np.ndarray]:
         """One batch per loader, still on host (numpy)."""
+        if self.packing is not None:
+            return self._next_packed_host()
         if self._native is not None:
             rows = self._native.next_batch()
             if rows is None:  # epoch end: wrap like SingleDataLoader does
@@ -231,6 +308,11 @@ class DataLoaderGroup:
         """Host half of a (super-)batch: gather ``k`` consecutive batches
         and stack them on a leading step dim (k=1: no stack). This is
         the work the Prefetcher's thread runs ahead of compute."""
+        if k > 1 and self.packing is not None:
+            raise ValueError("packed (dynamic-shape) batches cannot be "
+                             "stacked into a super-batch; the step loop "
+                             "forces steps_per_dispatch=1 when "
+                             "seq_buckets is active")
         if k <= 1:
             return self.next_batch_host()
         host = [self.next_batch_host() for _ in range(k)]

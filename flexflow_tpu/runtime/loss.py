@@ -21,7 +21,7 @@ from ..ffconst import LossType
 
 def compute_loss(
     loss_type: LossType, logits: jnp.ndarray, labels: jnp.ndarray,
-    from_logits: bool = False,
+    from_logits: bool = False, mask_padding: bool = False,
 ) -> jnp.ndarray:
     """Return scalar loss (mean over batch).
 
@@ -32,8 +32,28 @@ def compute_loss(
     in which case a fused log-softmax is applied here instead — raw logits
     through the probability path would be clipped into [1e-10, 1] and the
     gradient destroyed.
+
+    ``mask_padding`` (token-level sparse CE only; set by the compiler
+    when ``config.seq_buckets`` is active): positions labelled ``-1``
+    contribute an EXACTLY-zero loss term — so their cotangents, and
+    every weight-gradient contribution flowing from them, are exact
+    float zeros — and the mean divides by the valid-token count. The
+    reduction runs per row first and then across rows: pow2 bucket
+    widths nest a narrower row's pairwise reduction tree inside a wider
+    one's (the extra leaves are exact zeros), so the same batch padded
+    to two different rungs folds bit-identically.
     """
     if loss_type is LossType.SPARSE_CATEGORICAL_CROSSENTROPY:
+        if mask_padding and logits.ndim >= 3:
+            lab = labels.reshape(logits.shape[:-1]).astype(jnp.int32)
+            valid = lab >= 0
+            logp = (jax.nn.log_softmax(logits, axis=-1) if from_logits
+                    else jnp.log(jnp.clip(logits, 1e-10, 1.0)))
+            ll = jnp.take_along_axis(
+                logp, jnp.where(valid, lab, 0)[..., None], axis=-1)[..., 0]
+            row = jnp.sum(jnp.where(valid, ll, 0.0), axis=-1)
+            n = jnp.maximum(1, jnp.sum(valid)).astype(row.dtype)
+            return -jnp.sum(row) / n
         if logits.ndim >= 3:
             # token-level CE (seq2seq / NMT): logits (B, ..., V) with one
             # label per position — flatten positions into the batch

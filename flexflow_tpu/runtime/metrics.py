@@ -121,12 +121,32 @@ def compute_batch_metrics(
     logits: jnp.ndarray,
     labels: jnp.ndarray,
     from_logits: bool = False,
+    mask_padding: bool = False,
 ) -> Dict[str, jnp.ndarray]:
     """Per-batch metric computation (reference: Metrics::compute kernels,
     src/metrics_functions/metrics_functions.cu). Runs inside jit.
     ``from_logits`` mirrors compute_loss: True when the graph does not end
-    in a softmax."""
+    in a softmax. ``mask_padding`` mirrors compute_loss's masked
+    token-level path: ``-1``-labelled positions drop out of count /
+    correct / cce sums exactly, with the same row-major two-stage
+    reduction so bucket widths fold bit-identically."""
     sparse = loss_type is LossType.SPARSE_CATEGORICAL_CROSSENTROPY
+    if sparse and logits.ndim >= 3 and mask_padding:
+        lab = labels.reshape(logits.shape[:-1]).astype(jnp.int32)
+        valid = lab >= 0
+        out: Dict[str, jnp.ndarray] = {"count": jnp.sum(valid)}
+        if MetricsType.ACCURACY in metrics:
+            pred = jnp.argmax(logits, axis=-1)
+            out["correct"] = jnp.sum(
+                jnp.sum(valid & (pred == lab), axis=-1))
+        if MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY in metrics:
+            logp = (jax.nn.log_softmax(logits, axis=-1) if from_logits
+                    else jnp.log(jnp.clip(logits, 1e-10, 1.0)))
+            ll = jnp.take_along_axis(
+                logp, jnp.where(valid, lab, 0)[..., None], axis=-1)[..., 0]
+            out["sparse_cce_loss"] = -jnp.sum(
+                jnp.sum(jnp.where(valid, ll, 0.0), axis=-1))
+        return out
     if sparse and logits.ndim >= 3:
         # token-level metrics (seq2seq/NMT): positions flatten into the
         # batch, matching compute_loss's rank-3 path (runtime/loss.py)

@@ -37,10 +37,12 @@ from ..ffconst import (
 )
 from ..config import FFConfig, FFIterationConfig
 from ..core.layer import Layer
-from ..core.machine import make_mesh
+from ..core.machine import DATA_AXIS, make_mesh, mesh_axis_sizes
 from ..core.tensor import Parameter, Tensor
 from ..obs.metrics import metrics_registry
 from ..obs.trace import configure_tracer, span, tracer
+from .buckets import (DynamicShapeError, PackingSpec, resolve_ladder,
+                      row_lengths)
 from .compiler import CompiledModel, compile_model
 from .dataloader import DataLoaderGroup, Prefetcher, SingleDataLoader
 from .loss import loss_from_string
@@ -1739,11 +1741,67 @@ class FFModel:
         return jax.random.fold_in(jax.random.key(self.config.seed), self._rng_counter)
 
     # ---- high-level fit/eval (reference: flexflow_cffi.py:2062-2105) ----- #
+    def _dynamic_shapes_spec(self, cm, loaders, y_arr):
+        """Resolve the token-native dynamic-shape knobs into a
+        (PackingSpec, per-row lengths) pair, or ``None`` with the mode
+        off. Validates at entry (the mode-knob convention): a ladder
+        typo, a budget without buckets, or labels that violate the
+        trailing ``-1`` padding contract all raise a coded
+        DynamicShapeError before a single step runs. Stores the
+        resolved ladder on the model so the ledger's cohort key sees
+        the envelope actually dispatched."""
+        cfg = self.config
+        mode = getattr(cfg, "seq_buckets", "off")
+        budget = max(0, int(getattr(cfg, "token_budget", 0) or 0))
+        pad_max = getattr(cfg, "seq_bucket_pad_max", "off")
+        if pad_max not in ("on", "off"):
+            raise DynamicShapeError(
+                "DYN003", f"seq_bucket_pad_max={pad_max!r} "
+                "(expected 'on' or 'off')")
+        if mode == "off":
+            if budget:
+                raise DynamicShapeError(
+                    "DYN003", "token_budget requires seq_buckets "
+                    "(the packing plan is defined per bucket ladder)")
+            return None
+        if cm.loss_type is not LossType.SPARSE_CATEGORICAL_CROSSENTROPY:
+            raise DynamicShapeError(
+                "DYN003", "seq_buckets needs token-level sparse-CE "
+                "labels (the row lengths come from their -1 padding)")
+        if self.pipelined is not None:
+            raise DynamicShapeError(
+                "DYN003", "seq_buckets does not compose with the "
+                "pipeline engine yet (its schedule programs are "
+                "compiled for one microbatch shape)")
+        lengths = row_lengths(y_arr)
+        seq_dim = y_arr.shape[1]
+        hi = int(getattr(cfg, "seq_bucket_max", 0) or 0) or seq_dim
+        ladder = resolve_ladder(mode, getattr(cfg, "seq_bucket_min", 8),
+                                min(hi, seq_dim))
+        dp = (mesh_axis_sizes(cm.mesh).get(DATA_AXIS, 1)
+              if cfg.enable_sample_parallel else 1)
+        # which loaders carry the sequence axis: dim 1 matching the
+        # label seq dim (tokens/positions/(N,S) labels); feature-only
+        # inputs keep their width
+        seq_axes = tuple(l.data.ndim >= 2 and l.data.shape[1] == seq_dim
+                         for l in loaders)
+        pad_values = tuple([0] * (len(loaders) - 1) + [-1])
+        self._resolved_ladder = ladder
+        self._resolved_token_budget = budget
+        return PackingSpec(
+            ladder=ladder, token_budget=budget,
+            batch_size=loaders[0].batch_size, quantum=dp,
+            pad_max=(pad_max == "on"), seq_axes=seq_axes,
+            pad_values=pad_values), lengths
+
     def _make_loader_group(self, xs, y, bs: int, cm,
                            shuffle: bool) -> DataLoaderGroup:
         """The shared loader stack of fit() and eval(): one
         SingleDataLoader per input with its compiled sharding, plus the
-        label loader (sparse-CE labels reshaped/cast once, host-side)."""
+        label loader (sparse-CE labels reshaped/cast once, host-side).
+        With ``config.seq_buckets`` active the group carries the
+        dynamic-shape packing spec and builds its per-epoch plan at
+        every reset."""
         loaders = [
             SingleDataLoader(np.asarray(a), bs, sh)
             for a, sh in zip(xs, cm.input_shardings)
@@ -1752,20 +1810,29 @@ class FFModel:
         if cm.loss_type is LossType.SPARSE_CATEGORICAL_CROSSENTROPY:
             y_arr = y_arr.reshape(y_arr.shape[0], -1).astype(np.int32)
         loaders.append(SingleDataLoader(y_arr, bs, cm.label_sharding))
+        dyn = self._dynamic_shapes_spec(cm, loaders, y_arr)
+        if dyn is None:
+            return DataLoaderGroup(loaders, seed=self.config.seed,
+                                   shuffle=shuffle)
+        spec, lengths = dyn
         return DataLoaderGroup(loaders, seed=self.config.seed,
-                               shuffle=shuffle)
+                               shuffle=shuffle, packing=spec,
+                               lengths=lengths)
 
     def _step_loop_knobs(self, cm, recompile_state=None):
         """(prefetch_depth, max_inflight, steps_per_dispatch) for the
         async step loop. Multi-step dispatch needs a scannable train step
         and no per-step hooks: the pipeline engine and recompile-on-
-        condition both require step granularity, so they force k=1."""
+        condition both require step granularity, so they force k=1 — as
+        do dynamic shapes (variable (rows, width) batches cannot stack
+        into one scanned super-batch)."""
         cfg = self.config
         depth = max(0, int(getattr(cfg, "prefetch_depth", 0)))
         max_inflight = max(1, int(getattr(cfg, "max_inflight_steps", 2)))
         k = max(1, int(getattr(cfg, "steps_per_dispatch", 1)))
         if (self.pipelined is not None or recompile_state is not None
-                or cm.train_k_steps is None):
+                or cm.train_k_steps is None
+                or getattr(cfg, "seq_buckets", "off") != "off"):
             k = 1
         return depth, max_inflight, k
 
@@ -1988,6 +2055,11 @@ class FFModel:
                     f"recompile with pipeline=PipelineConfig(...)")
         group = self._make_loader_group(xs, y, bs, cm, shuffle)
         depth, max_inflight, k = self._step_loop_knobs(cm, recompile_state)
+        # token-native dynamic shapes: per-batch (rows, width) dispatch
+        # shapes, each unseen one a counted compile miss
+        dyn = group.packing is not None
+        bucket_missed = 0
+        tok_valid = tok_total = 0
         # crash-safe resume + periodic checkpointing (runtime/checkpoint)
         ckpt_mgr, ckpt_interval, start_epoch, skip_steps = \
             self._resume_setup(guard, resume_from, verbose)
@@ -2048,9 +2120,21 @@ class FFModel:
                     pm.accumulate(bm_folded)
                     guard_add = losses.sum() if guard is not None else None
                 else:
+                    sl = self.iter_config.seq_length
+                    if dyn:
+                        # dispatch at the batch's bucket: seq_length is
+                        # a STATIC step argument, so each (rows, width)
+                        # is its own executable — note the shape FIRST
+                        # so an unseen bucket is a counted miss, never
+                        # a silent retrace
+                        rows, sl = batch[-1].shape[0], batch[-1].shape[1]
+                        if cm.note_dispatch_shape("train", rows, sl):
+                            bucket_missed += 1
+                            metrics_registry().counter(
+                                "fit.bucket_compiles").inc()
                     cm.params, cm.opt_state, loss, bm = cm.train_step(
                         cm.params, cm.opt_state, self._next_rng(), *batch,
-                        seq_length=self.iter_config.seq_length,
+                        seq_length=sl,
                     )
                     guard_add = loss
                 if _fx.active():
@@ -2143,6 +2227,11 @@ class FFModel:
                                  cat="fit", args={"k": nk})
             with span("fit.host_sync", cat="fit", epoch=epoch):
                 pm.flush()  # the epoch-boundary host sync (device-side accum)
+            if dyn:
+                v, t = group.epoch_token_stats
+                stats.record_tokens(v, t)
+                tok_valid += v
+                tok_total += t
             epoch_records.append(stats.finish())
             if self.config.profiling:
                 r = epoch_records[-1]
@@ -2176,6 +2265,18 @@ class FFModel:
             ckpt_mgr.close()  # waits out any pending async commit
         self.fit_profile = self._step_loop_profile(
             epoch_records, depth, max_inflight, k)
+        if dyn:
+            # the dynamic-shape envelope + compile accounting the ledger
+            # record and the advisor's padded-FLOPs rule read
+            self.fit_profile["buckets"] = {
+                "ladder": list(self._resolved_ladder),
+                "token_budget": self._resolved_token_budget,
+                "pad_max": group.packing.pad_max,
+                "new_compiles": bucket_missed,
+                "known_shapes": len(cm._seen_shapes),
+                "padded_token_fraction": round(
+                    1.0 - tok_valid / max(1, tok_total), 6),
+            }
         if guard is not None:
             # recovery narrative for the ledger record + explain_run
             self.fit_profile["guard"] = guard.report()
@@ -2249,6 +2350,8 @@ class FFModel:
         bs = batch_size or self.config.batch_size
         group = self._make_loader_group(xs, y, bs, cm, shuffle=False)
         depth, max_inflight, _ = self._step_loop_knobs(cm)
+        dyn = group.packing is not None
+        bucket_missed = 0
         batch_nbytes = group.batch_nbytes
         stats = EpochThroughput(prefix="eval")  # eval.* registry series
         pf = Prefetcher(group, depth, stats=stats)
@@ -2256,9 +2359,15 @@ class FFModel:
         inflight = collections.deque()
         for _nk, batch in pf.epoch(reshuffle=False):
             _ts = _tr.now() if _tr.enabled else 0.0
+            sl = self.iter_config.seq_length
+            if dyn:
+                rows, sl = batch[-1].shape[0], batch[-1].shape[1]
+                if cm.note_dispatch_shape("eval", rows, sl):
+                    bucket_missed += 1
+                    metrics_registry().counter(
+                        "eval.bucket_compiles").inc()
             loss, logits, bm = cm.eval_step(
-                cm.params, *batch,
-                seq_length=self.iter_config.seq_length)
+                cm.params, *batch, seq_length=sl)
             pm.accumulate(bm)
             self._advance_window(stats, inflight, loss, 1, batch_nbytes,
                                  max_inflight)
@@ -2267,8 +2376,21 @@ class FFModel:
                 _tr.complete("eval.step", _ts, _tr.now() - _ts, cat="eval")
         with span("eval.host_sync", cat="eval"):
             pm.flush()
+        if dyn:
+            stats.record_tokens(*group.epoch_token_stats)
         self.eval_profile = self._step_loop_profile(
             [stats.finish()], depth, max_inflight, 1)
+        if dyn:
+            v, t = group.epoch_token_stats
+            self.eval_profile["buckets"] = {
+                "ladder": list(self._resolved_ladder),
+                "token_budget": self._resolved_token_budget,
+                "pad_max": group.packing.pad_max,
+                "new_compiles": bucket_missed,
+                "known_shapes": len(cm._seen_shapes),
+                "padded_token_fraction": round(
+                    1.0 - v / max(1, t), 6),
+            }
         if self.config.profiling:
             rec = self.eval_profile["epochs"][0]
             print(f"[eval] {rec['steps_per_s']:.1f} steps/s input_wait "

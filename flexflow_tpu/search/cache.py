@@ -238,6 +238,15 @@ def config_signature(config, mesh_axes: Optional[Dict[str, int]]) -> Dict:
 
     if jax.process_count() > 1:
         sig["process_count"] = jax.process_count()
+    # token-native dynamic shapes: the bucket ladder / packing budget
+    # change the shapes the plan will be dispatched at, so a bucketed
+    # compile must never warm-hit a pad-to-max plan (or vice versa).
+    # Stamped only when the mode is ON — the process_count pattern —
+    # so every pre-existing fixed-shape cache entry keeps its key.
+    if getattr(config, "seq_buckets", "off") not in (None, "off"):
+        for k in ("seq_buckets", "seq_bucket_min", "seq_bucket_max",
+                  "token_budget", "seq_bucket_pad_max"):
+            sig[k] = _attr_sig(getattr(config, k, None))
     for k in _SEARCH_KNOBS:
         sig[k] = _attr_sig(getattr(config, k, None))
     # extra substitution rules change the candidate set: hash the file

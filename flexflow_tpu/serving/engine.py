@@ -266,6 +266,8 @@ class GenerationInstance:
             "block_size": getattr(cfg, "serving_block_size", 16),
             "max_prefills_per_step": getattr(
                 cfg, "serving_max_prefills_per_step", 1),
+            "prefill_token_budget": getattr(
+                cfg, "serving_prefill_token_budget", 0),
         }
         num_blocks = getattr(cfg, "serving_num_blocks", 0)
         if num_blocks:

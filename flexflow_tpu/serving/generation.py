@@ -564,7 +564,7 @@ class PagedDecoder(_DecodeGraph):
         if self.prefill_buckets[-1] < self.max_length:
             self.prefill_buckets.append(self.max_length)
         self._decode = jax.jit(self._decode_step, donate_argnums=(2,))
-        self._prefill_fns: Dict[int, object] = {}
+        self._prefill_fns: Dict[Tuple[int, int], object] = {}
         self.decode_dispatches = 0
         self.decode_steps = 0
         self.audit_report = None
@@ -591,15 +591,17 @@ class PagedDecoder(_DecodeGraph):
         logits = self._forward_block(params, acts, attn)
         return logits[:, -1, :], new_pool
 
-    def _prefill_step(self, params, tokens, pool, table, length):
-        """Bucketed prefill for ONE request: tokens (1, Sb) int32 (the
-        prompt padded to the bucket), pool donated, table (MB,) int32,
-        length scalar int32 (the true prompt length). Computes the
-        prompt's K/V with ordinary dense causal attention over the
-        bucket (padding keys are causally masked for every valid query
-        row), scatters positions [0, length) into the pool through the
-        block table (padding rows write into the null block), and
-        returns ((1, Sb, vocab) float32 logits, new pool)."""
+    def _prefill_step(self, params, tokens, pool, tables, lengths):
+        """Bucketed prefill for a GROUP of requests: tokens (P, Sb)
+        int32 (each prompt padded to the bucket), pool donated, tables
+        (P, MB) int32, lengths (P,) int32 true prompt lengths. Rows
+        are independent — batched dense causal attention (padding keys
+        are causally masked for every valid query row), each row's K/V
+        scattered through its own block table with padding positions
+        redirected into the null block — so one multi-prompt dispatch
+        computes exactly what P single-prompt dispatches would, in one
+        XLA program. Returns ((P, Sb, vocab) float32 logits, new
+        pool)."""
         b, s_blk = tokens.shape
         positions = jnp.broadcast_to(
             jax.lax.iota(jnp.int32, s_blk)[None, :], (b, s_blk))
@@ -626,19 +628,22 @@ class PagedDecoder(_DecodeGraph):
             out = jnp.einsum("bqhd,hde->bqe", ctxv, p["wo"])
             if op.use_bias:
                 out = out + p["bo"]
-            # scatter the prompt K/V into the pool: position p lands in
-            # block table[p // bs] at offset p % bs; padding rows
-            # (p >= length) are redirected into the null block
+            # scatter each row's prompt K/V into the pool: row i's
+            # position p lands in block tables[i, p // bs] at offset
+            # p % bs; padding positions (p >= lengths[i]) are
+            # redirected into the null block (real positions never
+            # collide — each row owns its blocks)
             kpool, vpool = new_pool[op.name]
             nb = kpool.shape[0]
-            blk = table[pos // bs]                              # (Sb,)
-            flat = jnp.where(pos < length, blk * bs + pos % bs,
-                             NULL_BLOCK * bs)
+            blk = tables[:, pos // bs]                          # (P, Sb)
+            flat = jnp.where(pos[None, :] < lengths[:, None],
+                             blk * bs + (pos % bs)[None, :],
+                             NULL_BLOCK * bs)                   # (P, Sb)
             heads, hdim = kh.shape[2], kh.shape[3]
-            kflat = kpool.reshape(nb * bs, heads, hdim).at[flat].set(
-                kh[0])
-            vflat = vpool.reshape(nb * bs, heads, hdim).at[flat].set(
-                vh[0])
+            kflat = kpool.reshape(nb * bs, heads, hdim).at[
+                flat.reshape(-1)].set(kh.reshape(b * s_blk, heads, hdim))
+            vflat = vpool.reshape(nb * bs, heads, hdim).at[
+                flat.reshape(-1)].set(vh.reshape(b * s_blk, heads, hdim))
             new_pool[op.name] = (kflat.reshape(kpool.shape),
                                  vflat.reshape(vpool.shape))
             return out
@@ -646,11 +651,15 @@ class PagedDecoder(_DecodeGraph):
         logits = self._forward_block(params, acts, attn)
         return logits, new_pool
 
-    def _prefill_fn(self, bucket: int):
-        fn = self._prefill_fns.get(bucket)
+    def _prefill_fn(self, bucket: int, width: int = 1):
+        """The (bucket, row-width) executable — the seen-set is the
+        dict itself, so ``serving.prefill_bucket_compiles`` counts
+        distinct compiled shapes, not dispatches."""
+        key = (bucket, width)
+        fn = self._prefill_fns.get(key)
         if fn is None:
             fn = jax.jit(self._prefill_step, donate_argnums=(2,))
-            self._prefill_fns[bucket] = fn
+            self._prefill_fns[key] = fn
             from ..obs.metrics import metrics_registry
 
             metrics_registry().counter(
@@ -694,21 +703,50 @@ class PagedDecoder(_DecodeGraph):
         its K/V into the pool. ``prompt``: (S,) int32; ``table``: the
         request's block table. Returns the last-prompt-position logits
         (vocab,) float32."""
-        prompt = np.asarray(prompt, np.int32).ravel()
-        n = prompt.shape[0]
-        if n < 1:
+        return self.prefill_many([prompt], [table])[0]
+
+    def prefill_many(self, prompts: Sequence[np.ndarray],
+                     tables: Sequence[np.ndarray]) -> np.ndarray:
+        """Prefill a group of requests in ONE dispatch. ``prompts``:
+        (S_i,) int32 each, with matching block tables; the whole group
+        runs at the bucket of its longest prompt (the scheduler groups
+        by bucket before calling). The row count is padded up to the
+        next power of two with zero-length dummy rows whose writes all
+        land in the null block, so the executable set stays bounded at
+        distinct (bucket, pow2 rows) pairs. Returns (len(prompts),
+        vocab) float32 last-prompt-position logits, row-aligned with
+        ``prompts``."""
+        if not prompts or len(prompts) != len(tables):
+            raise ValueError("prefill group needs matching non-empty "
+                             "prompt/table lists")
+        arrs = [np.asarray(p, np.int32).ravel() for p in prompts]
+        lens = [int(a.shape[0]) for a in arrs]
+        if min(lens) < 1:
             raise ValueError("empty prompt")
-        if n > self.max_length:
+        if max(lens) > self.max_length:
             raise ValueError(
-                f"prompt {n} tokens > max_length {self.max_length}")
-        bucket = self.bucket_for(n)
-        padded = np.zeros((1, bucket), np.int32)
-        padded[0, :n] = prompt
-        fn = self._prefill_fn(bucket)
+                f"prompt {max(lens)} tokens > max_length "
+                f"{self.max_length}")
+        bucket = self.bucket_for(max(lens))
+        width = 1
+        while width < len(arrs):
+            width *= 2
+        toks = np.zeros((width, bucket), np.int32)
+        tabs = np.full((width, self.max_blocks_per_request), NULL_BLOCK,
+                       np.int32)
+        lengths = np.zeros((width,), np.int32)
+        for i, (a, t) in enumerate(zip(arrs, tables)):
+            toks[i, :lens[i]] = a
+            t = np.asarray(t, np.int32).ravel()
+            tabs[i, :t.shape[0]] = t
+            lengths[i] = lens[i]
+        fn = self._prefill_fn(bucket, width)
         logits, self.pool.kv = fn(
-            self._exec_params(), jnp.asarray(padded), self.pool.kv,
-            jnp.asarray(table, jnp.int32), jnp.int32(n))
-        return np.asarray(logits)[0, n - 1]
+            self._exec_params(), jnp.asarray(toks), self.pool.kv,
+            jnp.asarray(tabs), jnp.asarray(lengths))
+        out = np.asarray(logits)
+        rows = np.arange(len(arrs))
+        return out[rows, np.asarray(lens) - 1]
 
     def decode(self, tokens: np.ndarray, tables: np.ndarray,
                seq_lens: np.ndarray) -> np.ndarray:

@@ -143,6 +143,7 @@ class ContinuousBatchingScheduler:
                  num_blocks: Optional[int] = None,
                  prefill_buckets: Optional[Sequence[int]] = None,
                  max_prefills_per_step: int = 1,
+                 prefill_token_budget: int = 0,
                  admission_limit: Optional[int] = None,
                  default_deadline_s: Optional[float] = None,
                  breaker_threshold: int = 0,
@@ -157,6 +158,9 @@ class ContinuousBatchingScheduler:
             block_size=block_size, num_blocks=num_blocks,
             prefill_buckets=prefill_buckets)
         self.max_prefills_per_step = max(1, int(max_prefills_per_step))
+        self.prefill_token_budget = max(0, int(prefill_token_budget))
+        self._prefill_dispatches = 0
+        self._prefill_prompts = 0
         self.admission_limit = (int(admission_limit)
                                 if admission_limit else None)
         self.default_deadline_s = (float(default_deadline_s)
@@ -373,7 +377,10 @@ class ContinuousBatchingScheduler:
         its place), admitted requests prefill immediately. While decodes
         are active at most ``max_prefills_per_step`` prompts are
         prefilled per call, bounding the decode stall a prompt burst can
-        cause."""
+        cause. With ``prefill_token_budget`` set the stall bound is
+        token-native instead: see :meth:`_admit_batched`."""
+        if self.prefill_token_budget > 0:
+            return self._admit_batched(closed)
         reg = metrics_registry()
         with self._mu:
             active = any(r is not None for r in self._slots)
@@ -439,12 +446,129 @@ class ContinuousBatchingScheduler:
             with self._mu:
                 self._slots[slot] = req
 
+    def _admit_batched(self, closed: bool) -> None:
+        """Token-budget admission: the same deadline/slot/pool gates as
+        the one-per-dispatch path, but admitted prompts are grouped by
+        prefill bucket and each group runs through ONE batched prefill
+        dispatch of at most ``floor(prefill_token_budget / bucket)``
+        prompts. While decodes are active, collection stops once the
+        group's padded prefill tokens would pass the budget — the
+        decode-stall bound is measured in tokens, which is what the
+        stall actually costs, instead of prompt count."""
+        reg = metrics_registry()
+        with self._mu:
+            active = any(r is not None for r in self._slots)
+            n_slots = len(self._slots)
+        batch: List = []  # (slot, req, bucket)
+        reserved: set = set()
+        spent = 0
+        while len(batch) < n_slots:
+            with self._mu:
+                if not self._queue:
+                    break
+                req = self._queue.popleft()
+            if closed:
+                if not req.future.done():
+                    req.future.set_exception(
+                        RuntimeError("engine stopped"))
+                continue
+            now = time.perf_counter()
+            if req.expired(now):
+                with self._mu:
+                    self._deadline_rejects += 1
+                reg.counter("serving.deadline_rejects").inc()
+                if not req.future.done():
+                    req.future.set_exception(DeadlineExceeded(
+                        f"request {req.request_id} waited "
+                        f"{now - req.t_enqueue:.3f}s > deadline "
+                        f"{req.deadline_s:.3f}s"))
+                continue
+            bucket = self.decoder.bucket_for(req.prompt.size)
+            if active and batch and spent + bucket > \
+                    self.prefill_token_budget:
+                with self._mu:
+                    self._queue.appendleft(req)
+                break
+            slot = None
+            with self._mu:
+                for i, r in enumerate(self._slots):
+                    if r is None and i not in reserved:
+                        slot = i
+                        break
+            if slot is None:
+                with self._mu:
+                    self._queue.appendleft(req)
+                break
+            table = self.decoder.pool.try_admit(
+                req.prompt.size + req.max_new_tokens)
+            if table is None:
+                # pool momentarily full: head of line keeps its place
+                with self._mu:
+                    self._queue.appendleft(req)
+                break
+            with self._mu:
+                req.table = table
+                req.t_admit = now
+                self._lat["queue_wait"].append(now - req.t_enqueue)
+            reg.histogram("serving.gen_queue_wait_s").observe(
+                now - req.t_enqueue)
+            reserved.add(slot)
+            spent += bucket
+            batch.append((slot, req, bucket))
+        if not batch:
+            return
+        groups: Dict[int, List] = {}
+        for slot, req, bucket in batch:
+            groups.setdefault(bucket, []).append((slot, req))
+        for bucket in sorted(groups):
+            members = groups[bucket]
+            cap = max(1, self.prefill_token_budget // bucket)
+            for i in range(0, len(members), cap):
+                self._prefill_group(members[i:i + cap])
+
+    def _prefill_group(self, members: List) -> None:
+        """ONE batched prefill dispatch for same-bucket requests; a
+        dispatch failure fails exactly the group's requests (their
+        blocks free), mirroring the single-prefill error contract."""
+        reg = metrics_registry()
+        reqs = [r for _, r in members]
+        t0 = time.perf_counter()
+        try:
+            logits = _DECODE_RETRY.call(
+                self.decoder.prefill_many,
+                [r.prompt for r in reqs], [r.table for r in reqs])
+        except Exception as e:  # noqa: BLE001 — fail the group only
+            reg.counter("serving.errors").inc()
+            for _, req in members:
+                self.decoder.pool.free(req.table)
+                if not req.future.done():
+                    req.future.set_exception(e)
+            return
+        t_done = time.perf_counter()
+        with self._mu:
+            self._prefill_dispatches += 1
+            self._prefill_prompts += len(reqs)
+            for req in reqs:
+                req.t_prefill_done = t_done
+                req.seq_len = req.prompt.size
+                req.rng = np.random.default_rng(req.seed)
+                self._lat["prefill"].append(t_done - t0)
+        reg.histogram("serving.prefill_s").observe(t_done - t0)
+        for i, (slot, req) in enumerate(members):
+            self._append_token(req, logits[i])
+            if req.future.done():  # single-token request retired here
+                continue
+            with self._mu:
+                self._slots[slot] = req
+
     def _prefill(self, req: GenerationRequest) -> None:
         t0 = time.perf_counter()
         logits = _DECODE_RETRY.call(self.decoder.prefill, req.prompt,
                                     req.table)
         t_done = time.perf_counter()
         with self._mu:
+            self._prefill_dispatches += 1
+            self._prefill_prompts += 1
             req.t_prefill_done = t_done
             req.seq_len = req.prompt.size
             req.rng = np.random.default_rng(req.seed)
@@ -625,6 +749,8 @@ class ContinuousBatchingScheduler:
             shed = self._shed
             deadline = self._deadline_rejects
             completed = self._completed
+            prefill_dispatches = self._prefill_dispatches
+            prefill_prompts = self._prefill_prompts
             phases = {k: _percentiles(v) for k, v in self._lat.items()}
         now = time.perf_counter()
         tps = (tokens / (now - t_start)
@@ -643,6 +769,8 @@ class ContinuousBatchingScheduler:
             "kv": self.decoder.pool.stats(),
             "decode_steps": self.decoder.decode_steps,
             "decode_dispatches": self.decoder.decode_dispatches,
+            "prefill_dispatches": prefill_dispatches,
+            "prefill_prompts": prefill_prompts,
             "prefill_buckets": list(self.decoder.prefill_buckets),
             "knobs": {
                 "decode_slots": self.decoder.decode_slots,
@@ -650,6 +778,8 @@ class ContinuousBatchingScheduler:
                 "num_blocks": self.decoder.pool.num_blocks,
                 "max_length": self.decoder.max_length,
                 "max_prefills_per_step": self.max_prefills_per_step,
+                **({"prefill_token_budget": self.prefill_token_budget}
+                   if self.prefill_token_budget > 0 else {}),
             },
         }
 

@@ -182,6 +182,42 @@ def test_rule_device_compute_maps_to_precision():
     assert top["knobs"] == {"compute_dtype": "bfloat16"}
 
 
+def test_rule_token_bucketing_prices_padded_flops():
+    """A padded-token-heavy bucketed fit record (the ledger ``buckets``
+    block record_fit carries from ``fit_profile``) maps to the
+    token-native knob deltas, priced by the measured padded-FLOPs
+    fraction."""
+    assert "token_bucketing" in RULE_FAMILIES["device_compute"]
+    # fixed-row bucketed fit, 60% padding -> propose a token budget
+    rec = _fit_rec("device_compute")
+    rec["buckets"] = {"padded_token_fraction": 0.6, "pad_max": False,
+                      "token_budget": 0, "ladder": [8, 16, 32]}
+    rep = advise_record(rec)
+    sug = next(s for s in rep["suggestions"]
+               if s["family"] == "token_bucketing")
+    assert sug["knob"] == "token_budget"
+    assert sug["knobs"] == {"token_budget": 128}  # 4x the ladder top
+    assert sug["expected"]["priced_by"] == "padded_flops_fraction"
+    # pad-to-max dispatch -> propose dropping to per-rung widths, and
+    # the full padded fraction prices the delta (vs half for packing)
+    rec2 = _fit_rec("device_compute")
+    rec2["buckets"] = {"padded_token_fraction": 0.6, "pad_max": True,
+                       "token_budget": 128, "ladder": [8, 16, 32]}
+    rep2 = advise_record(rec2)
+    sug2 = next(s for s in rep2["suggestions"]
+                if s["family"] == "token_bucketing")
+    assert sug2["knobs"] == {"seq_bucket_pad_max": "off"}
+    assert (sug2["expected"]["phase_delta_s"]
+            > sug["expected"]["phase_delta_s"])
+    # a well-packed run (20% padding) stays silent — no noop advice
+    rec3 = _fit_rec("device_compute")
+    rec3["buckets"] = {"padded_token_fraction": 0.2, "pad_max": False,
+                       "token_budget": 128, "ladder": [8, 16, 32]}
+    rep3 = advise_record(rec3)
+    assert all(s["family"] != "token_bucketing"
+               for s in rep3["suggestions"])
+
+
 def test_serving_rules_map_phases_to_knob_families():
     for dominant, family, knob in (
             ("queue_wait", "decode_slots", "decode_slots"),

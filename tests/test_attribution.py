@@ -320,6 +320,27 @@ def test_corpus_key_separates_shapes_not_measurements():
     assert {r["key"] for r in rows_a} != {r["key"] for r in rows_b}
 
 
+def test_corpus_merge_folds_rank_dirs_idempotently(tmp_path):
+    """merge_corpus: the mh_launch cohort fold — per-rank corpus dirs
+    merge into one, dedup by content key, idempotent on re-merge (the
+    merge_runs discipline applied to the training set)."""
+    ff = _mlp()
+    rows = costcorpus.build_rows(ff, iters=1)
+    src_a = str(tmp_path / "rank-0")
+    src_b = str(tmp_path / "rank-1")
+    dst = str(tmp_path / "cohort")
+    costcorpus.append_rows(rows, dirpath=src_a)
+    costcorpus.append_rows(rows, dirpath=src_b)  # rank 1 profiled the same ops
+    assert costcorpus.merge_corpus(src_a, dst) == len(rows)
+    # rank 1's rows are the same (op, sharding, machine) content keys
+    assert costcorpus.merge_corpus(src_b, dst) == 0
+    assert costcorpus.merge_corpus(src_a, dst) == 0  # idempotent
+    merged = costcorpus.scan_corpus(dst)
+    assert {r["key"] for r in merged["rows"]} == {r["key"] for r in rows}
+    # an empty / missing source dir folds zero rows, never throws
+    assert costcorpus.merge_corpus(str(tmp_path / "rank-9"), dst) == 0
+
+
 # ------------------------------------------------------------ obs server
 def test_obs_server_endpoints_on_ephemeral_port(tmp_path, monkeypatch):
     import urllib.request

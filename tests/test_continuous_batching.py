@@ -194,6 +194,83 @@ def test_one_dispatch_per_step_regardless_of_mix(gpt):
     assert stats["decode_steps"] < sum(m - 1 for m in (8, 2, 6, 3, 4))
 
 
+# -------------------------------------------- token-budget prefill batching
+def test_prefill_many_bit_identical_to_single_path(gpt):
+    """Multi-prompt bucketed prefill: each prompt's last-position logits
+    through one batched dispatch must equal the single-prompt prefill
+    path bit for bit (rows are independent — batched dense causal
+    attention, per-row block-table scatter, dummy rows write the null
+    block)."""
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(0, V, (n,)).astype(np.int32)
+               for n in (3, 6, 2, 5, 4)]
+    one = PagedDecoder(gpt, max_length=32, decode_slots=8, block_size=8)
+    many = PagedDecoder(gpt, max_length=32, decode_slots=8, block_size=8)
+    singles, tabs_one, tabs_many = [], [], []
+    for p in prompts:
+        tabs_one.append(one.pool.try_admit(p.size + 2))
+        singles.append(one.prefill(p, tabs_one[-1]))
+        tabs_many.append(many.pool.try_admit(p.size + 2))
+    batched = many.prefill_many(prompts, tabs_many)
+    assert len(batched) == len(prompts)
+    for i, (s, b) in enumerate(zip(singles, batched)):
+        assert np.array_equal(s, b), f"prompt {i} prefill logits diverge"
+    # the batched path wrote the SAME kv pool contents for each request:
+    # a decode step after either prefill is bitwise the same
+    seq_lens = np.zeros(8, np.int32)
+    toks = np.zeros(8, np.int32)
+    tables_one = np.zeros((8, one.max_blocks_per_request), np.int32)
+    tables_many = np.zeros((8, many.max_blocks_per_request), np.int32)
+    for i, p in enumerate(prompts):
+        seq_lens[i] = p.size
+        toks[i] = int(batched[i].argmax())
+        tables_one[i], tables_many[i] = tabs_one[i], tabs_many[i]
+    d_one = one.decode(toks, tables_one, seq_lens)
+    d_many = many.decode(toks, tables_many, seq_lens)
+    assert np.array_equal(d_one[:len(prompts)], d_many[:len(prompts)])
+    # one executable per (bucket, width) — the seen-set that makes an
+    # unseen shape a counted compile miss
+    assert all(w > 1 for (_b, w) in many._prefill_fns)
+    assert all(w == 1 for (_b, w) in one._prefill_fns)
+
+
+def test_token_budget_scheduler_batches_prefills_same_tokens(gpt):
+    """prefill_token_budget>0: the scheduler admits >1 queued prompt per
+    bucketed prefill dispatch under the token budget, generating exactly
+    the tokens the single-prefill path generates, with one decode
+    dispatch per step preserved."""
+    rng = np.random.default_rng(9)
+    reqs = [(rng.integers(0, V, (n,)).astype(np.int32), m)
+            for n, m in [(2, 4), (5, 3), (3, 4), (6, 2), (4, 3),
+                         (2, 3), (7, 2), (3, 3)]]
+
+    def run(**kw):
+        sched = ContinuousBatchingScheduler(gpt, max_length=32,
+                                            decode_slots=8, block_size=8,
+                                            max_prefills_per_step=8, **kw)
+        futs = [sched.submit(p, m, seed=100 + i)
+                for i, (p, m) in enumerate(reqs)]
+        outs = [f.result(timeout=120).tolist() for f in futs]
+        stats = sched.stats()
+        sched.stop()
+        return outs, stats
+
+    base_outs, base = run()
+    tb_outs, tb = run(prefill_token_budget=16)
+    assert tb_outs == base_outs
+    # decode loop untouched: one dispatch per step in both modes
+    assert base["decode_steps"] == base["decode_dispatches"]
+    assert tb["decode_steps"] == tb["decode_dispatches"]
+    # the budget path batched: fewer dispatches than prompts (the first
+    # prefill compiles while the rest of the burst queues up)
+    assert base["prefill_dispatches"] == base["prefill_prompts"] == 8
+    assert tb["prefill_prompts"] == 8
+    assert tb["prefill_dispatches"] < 8
+    # the knob is only stamped on the record when it is on
+    assert "prefill_token_budget" not in base["knobs"]
+    assert tb["knobs"]["prefill_token_budget"] == 16
+
+
 # ------------------------------------------------- degradation semantics
 def test_burst_sheds_with_kv_pool_as_binding_constraint(gpt):
     """A burst past admission_limit sheds; the pool (2 worst-case

@@ -39,3 +39,23 @@ def test_fit_bench_smoke():
     # round-trip exactly as main() prints it
     rt = json.loads(json.dumps(out))
     assert rt["prefetch_depth"] == 2 and rt["steps_per_dispatch"] == 2
+
+
+def test_fit_bench_ragged_smoke():
+    """The dynamic-shapes A/B (--ragged --smoke config): bucketed
+    dispatch must cut the padded-token fraction vs the pad-to-max
+    complement with a bit-identical first-epoch loss, ULP-tracking
+    params, and ZERO bucket compiles after the warmup epoch — the
+    bench gates all of that itself (failures -> exit 1)."""
+    fb = _load()
+    out = fb.run_ragged_bench(samples=96, seq=32, vocab=32, batch=8,
+                              token_budget=128, trials=2)
+    assert out["exit"] == 0 and out["failures"] == []
+    assert out["losses_bit_identical"] is True
+    assert out["params_ulp_tracking"] is True
+    assert (out["padded_token_fraction_bucketed"]
+            < out["padded_token_fraction_padmax"])
+    assert out["replay_new_compiles"] == {"bucketed": 0, "padmax": 0}
+    assert out["known_shapes"] >= 2  # >1 rung actually dispatched
+    assert out["ladder"][-1] == 32
+    json.loads(json.dumps(out))  # the one-JSON-line contract

@@ -187,6 +187,149 @@ def _run_bench(samples, dim, hidden, classes, batch, trials,
     }
 
 
+# --------------------------------------------------------------- ragged
+def _ragged_dataset(n: int, seq: int, vocab: int, seed: int = 0):
+    """Seeded long-tail token dataset: geometric row lengths clipped to
+    [2, seq], labels −1-padded past each row's length (the sparse-CE
+    masking convention runtime/buckets.py validates)."""
+    rng = np.random.default_rng(seed)
+    lengths = np.clip(rng.geometric(0.08, size=n), 2, seq)
+    tokens = np.zeros((n, seq), np.int32)
+    labels = np.full((n, seq), -1, np.int32)
+    for i, ln in enumerate(lengths):
+        tokens[i, :ln] = rng.integers(0, vocab, ln)
+        labels[i, :ln] = rng.integers(0, vocab, ln)
+    positions = np.tile(np.arange(seq, dtype=np.int32), (n, 1))
+    return [tokens, positions], labels
+
+
+def _build_ragged_gpt(batch: int, seq: int, vocab: int,
+                      token_budget: int, pad_max: bool):
+    from flexflow_tpu import (FFConfig, FFModel, LossType, MetricsType,
+                              SGDOptimizer)
+    from flexflow_tpu.models import GPTConfig, build_gpt
+
+    cfg = FFConfig(batch_size=batch, seed=0, seq_buckets="pow2",
+                   seq_bucket_min=8, token_budget=token_budget,
+                   seq_bucket_pad_max="on" if pad_max else "off")
+    ff = FFModel(cfg)
+    build_gpt(ff, batch, seq,
+              GPTConfig(vocab_size=vocab, max_positions=seq,
+                        hidden_size=32, num_heads=4, num_layers=2))
+    ff.compile(
+        optimizer=SGDOptimizer(lr=0.05),
+        loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+        metrics=[MetricsType.SPARSE_CATEGORICAL_CROSSENTROPY],
+    )
+    return ff
+
+
+def run_ragged_bench(samples: int = 512, seq: int = 64, vocab: int = 64,
+                     batch: int = 16, token_budget: int = 512,
+                     trials: int = 5) -> dict:
+    """The dynamic-shapes fit A/B: the SAME token-budget packing plan
+    over a seeded long-tail dataset, dispatched once at each group's
+    bucket width (``seq_buckets=pow2``) and once at the ladder top
+    (``seq_bucket_pad_max=on`` — pad-to-max with identical grouping).
+    Same interleaved-pairs / median-of-ratios / bit-identity hygiene as
+    the pipeline bench, with one honest caveat: the first epoch runs
+    both models from the identical seed-0 init, so its per-epoch loss
+    must match BIT FOR BIT (padded positions are provably inert).
+    Gradient reductions, however, contract over the position axis, and
+    XLA associates that sum differently at different dispatch widths —
+    so params (and every later epoch's loss) are asserted to track
+    within float32 last-ULP noise rather than exactly."""
+    x, y = _ragged_dataset(samples, seq, vocab)
+    bucketed = _build_ragged_gpt(batch, seq, vocab, token_budget,
+                                 pad_max=False)
+    padmax = _build_ragged_gpt(batch, seq, vocab, token_budget,
+                               pad_max=True)
+    losses = {"bucketed": [], "padmax": []}
+    first_epoch = {}
+    rates = {"bucketed": [], "padmax": []}
+    fractions = {}
+    replay_compiles = {"bucketed": 0, "padmax": 0}
+    ratios = []
+    pair = {"bucketed": bucketed, "padmax": padmax}
+
+    def one_epoch(name):
+        ff = pair[name]
+        hist = ff.fit(x, y, epochs=1, verbose=False)
+        losses[name] += [pm.sparse_cce_loss for pm in hist]
+        prof = ff.fit_profile
+        rates[name].append(prof["steps_per_s"])
+        fractions[name] = prof["buckets"]["padded_token_fraction"]
+        replay_compiles[name] += prof["buckets"]["new_compiles"]
+        return prof["steps_per_s"]
+
+    # warmup epoch each: the plan is seed-deterministic, so this
+    # compiles every (rows, bucket) shape the timed epochs will see —
+    # any timed-epoch compile is a replay-determinism failure
+    for name, ff in pair.items():
+        hist = ff.fit(x, y, epochs=1, verbose=False)
+        first_epoch[name] = [pm.sparse_cce_loss for pm in hist]
+        losses[name] += first_epoch[name]
+    for t in range(trials):
+        if t % 2 == 0:
+            rb = one_epoch("bucketed")
+            rp = one_epoch("padmax")
+        else:
+            rp = one_epoch("padmax")
+            rb = one_epoch("bucketed")
+        ratios.append(rb / rp)
+    pa, pb = _params(bucketed), _params(padmax)
+    bit_identical = first_epoch["bucketed"] == first_epoch["padmax"]
+    ulp_tracking = (
+        set(pa) == set(pb)
+        and np.allclose(losses["bucketed"], losses["padmax"],
+                        rtol=1e-4, atol=1e-6)
+        and all(np.allclose(pa[kk], pb[kk], rtol=1e-4, atol=1e-6)
+                for kk in pa))
+    prof = bucketed.fit_profile
+    out = {
+        "mode": "ragged",
+        "steps_per_s_bucketed": round(_median(rates["bucketed"]), 3),
+        "steps_per_s_padmax": round(_median(rates["padmax"]), 3),
+        "speedup": round(_median(ratios), 3),
+        "bucketed_trials": [round(r, 2) for r in rates["bucketed"]],
+        "padmax_trials": [round(r, 2) for r in rates["padmax"]],
+        "padded_token_fraction_bucketed": fractions["bucketed"],
+        "padded_token_fraction_padmax": fractions["padmax"],
+        "replay_new_compiles": replay_compiles,
+        "ladder": prof["buckets"]["ladder"],
+        "known_shapes": prof["buckets"]["known_shapes"],
+        "losses_bit_identical": bit_identical,
+        "params_ulp_tracking": ulp_tracking,
+        "steps": len(losses["bucketed"]),
+        "trials": trials,
+        "batch": batch,
+        "token_budget": token_budget,
+        "seq": seq,
+    }
+    failures = []
+    if not bit_identical:
+        failures.append(
+            "first-epoch losses diverged from the pad-to-max "
+            f"complement: {first_epoch['bucketed']} vs "
+            f"{first_epoch['padmax']}")
+    if not ulp_tracking:
+        failures.append(
+            "bucketed run drifted beyond float32 ULP noise from its "
+            f"pad-to-max complement: {losses['bucketed'][:4]} vs "
+            f"{losses['padmax'][:4]}")
+    if fractions["bucketed"] >= fractions["padmax"]:
+        failures.append(
+            f"bucketing did not reduce the padded-token fraction "
+            f"({fractions['bucketed']} vs {fractions['padmax']})")
+    if replay_compiles["bucketed"] or replay_compiles["padmax"]:
+        failures.append(
+            f"replaying the seeded plan recompiled {replay_compiles} "
+            "new bucket shapes after warmup")
+    out["failures"] = failures
+    out["exit"] = 1 if failures else 0
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--samples", type=int, default=8192)
@@ -202,9 +345,42 @@ def main(argv=None) -> int:
     ap.add_argument("--native", action="store_true",
                     help="keep the native C++ loader engaged (default: "
                          "off, so the bench isolates the Python pipeline)")
+    ap.add_argument("--ragged", action="store_true",
+                    help="dynamic-shapes A/B: bucketed GPT fit over a "
+                         "seeded long-tail dataset vs its pad-to-max "
+                         "complement (same packing plan); exits 1 "
+                         "unless bit-identical with a lower padded-"
+                         "token fraction")
+    ap.add_argument("--token-budget", type=int, default=512,
+                    help="--ragged: per-dispatch packed token budget")
+    ap.add_argument("--seq", type=int, default=64,
+                    help="--ragged: dataset sequence dim (ladder top)")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny workload (the tier-1 invocation)")
     ns = ap.parse_args(argv)
+    from flexflow_tpu.obs.ledger import record_bench
+
+    if ns.ragged:
+        if ns.smoke:
+            out = run_ragged_bench(samples=96, seq=32, vocab=32, batch=8,
+                                   token_budget=128, trials=2)
+        else:
+            out = run_ragged_bench(samples=ns.samples if ns.samples != 8192
+                                   else 512, seq=ns.seq, batch=ns.batch
+                                   if ns.batch != 512 else 16,
+                                   token_budget=ns.token_budget,
+                                   trials=ns.trials if ns.trials != 9
+                                   else 5)
+        record_bench(
+            "fit_bench", out,
+            perf={"metric": "fit_bench.steps_per_s_bucketed",
+                  "value": out["steps_per_s_bucketed"],
+                  "higher_is_better": True},
+            label="fit_bench_ragged" + ("_smoke" if ns.smoke else ""),
+            knobs={k: out[k] for k in ("batch", "token_budget", "seq",
+                                       "steps")})
+        print(json.dumps(out))
+        return out["exit"]
     if ns.smoke:
         out = run_bench(samples=256, dim=64, hidden=32, classes=4,
                         batch=64, trials=2, depth=2, k=2, native=ns.native)
@@ -215,8 +391,6 @@ def main(argv=None) -> int:
                         k=ns.steps_per_dispatch, native=ns.native)
     # durable trend line: the record lands in the run ledger so
     # tools/perf_sentinel.py can judge the next run against this one
-    from flexflow_tpu.obs.ledger import record_bench
-
     record_bench(
         "fit_bench", out,
         perf={"metric": "fit_bench.steps_per_s_pipeline",

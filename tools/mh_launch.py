@@ -381,6 +381,11 @@ def _spawn(rank: int, nproc: int, coord: str, run_dir: str, ckpt_dir: str,
         env["FLEXFLOW_TPU_MH_LAUNCH_ID"] = launch_id
     env["FLEXFLOW_TPU_LEDGER_DIR"] = os.path.join(
         run_dir, "ledger", f"rank-{rank}")
+    # per-rank cost corpus (collected only under cost_corpus=on): ranks
+    # must not interleave appends into one shared default dir — the
+    # coordinator folds them into a cohort corpus after the run
+    env["FLEXFLOW_TPU_COSTCORPUS_DIR"] = os.path.join(
+        run_dir, "costcorpus", f"rank-{rank}")
     env["PYTHONPATH"] = os.pathsep.join(
         filter(None, [_REPO, env.get("PYTHONPATH")]))
     # a wedged worker killed by the supervisor should leave thread
@@ -622,6 +627,23 @@ def supervise(nproc: int = 2, run_dir: Optional[str] = None,
         remerged += merge_runs(src, cohort_dir)
     report["ledger"] = {"cohort_dir": cohort_dir, "merged": merged,
                         "remerged": remerged}
+    # one cohort cost corpus, same discipline: fold every rank's
+    # per-op rows (present only under cost_corpus=on), key-deduped so
+    # N ranks profiling the same ops converge to one row set
+    from flexflow_tpu.obs.costcorpus import merge_corpus
+
+    corpus_cohort = os.path.join(run_dir, "costcorpus", "cohort")
+    corpus_merged = 0
+    any_corpus = False
+    for r in range(nproc):
+        src = os.path.join(run_dir, "costcorpus", f"rank-{r}")
+        if not os.path.isdir(src):
+            continue
+        any_corpus = True
+        corpus_merged += merge_corpus(src, corpus_cohort)
+    if any_corpus:
+        report["cost_corpus"] = {"cohort_dir": corpus_cohort,
+                                 "merged": corpus_merged}
     return report
 
 

@@ -212,23 +212,31 @@ def _static_window(gen, trace: List[Dict], width: int,
 # ----------------------------------------------------------- continuous
 def run_continuous(ff, trace: List[Dict], *, decode_slots: int,
                    block_size: int, max_length: int,
-                   repeats: int = 2) -> Dict:
+                   repeats: int = 2, sched_kw: Dict = None,
+                   return_outputs: bool = False):
     """The serving engine's continuous-batching path over the same
     trace; like :func:`run_static`, the best of ``repeats`` replay
     windows wins (tokens/s per window; the TTFT / per-token percentiles
-    are over all windows — the windows are statistically identical)."""
+    are over all windows — the windows are statistically identical).
+    ``sched_kw`` overrides scheduler knobs (the long-tail A/B uses it
+    to pin the prefill ladder / token budget per variant);
+    ``return_outputs`` additionally returns the last window's generated
+    sequences (greedy — deterministic across windows) for cross-variant
+    bit-identity checks."""
     from flexflow_tpu.serving import InferenceEngine
 
     eng = InferenceEngine()
-    inst = eng.register_generator(ff, name="gpt",
-                                  decode_slots=decode_slots,
-                                  block_size=block_size,
-                                  max_length=max_length,
-                                  # short prompts: a prefill costs about
-                                  # one decode step, so refill every
-                                  # free slot between steps (the knob
-                                  # exists for LONG-prompt workloads)
-                                  max_prefills_per_step=decode_slots)
+    kw = {
+        "decode_slots": decode_slots,
+        "block_size": block_size,
+        "max_length": max_length,
+        # short prompts: a prefill costs about one decode step, so
+        # refill every free slot between steps (the knob exists for
+        # LONG-prompt workloads)
+        "max_prefills_per_step": decode_slots,
+    }
+    kw.update(sched_kw or {})
+    inst = eng.register_generator(ff, name="gpt", **kw)
     dec = inst.scheduler.decoder
     # warm every executable the trace will touch (decode + the prefill
     # buckets its prompts map to) outside the timed window — TWICE each
@@ -246,6 +254,7 @@ def run_continuous(ff, trace: List[Dict], *, decode_slots: int,
                    np.zeros(decode_slots, np.int32))
     tokens = sum(r["max_new"] for r in trace)
     best = None
+    outs: List[np.ndarray] = []
     for _ in range(max(1, repeats)):
         steps0, disp0 = dec.decode_steps, dec.decode_dispatches
         t0 = time.perf_counter()
@@ -256,8 +265,7 @@ def run_continuous(ff, trace: List[Dict], *, decode_slots: int,
                 time.sleep(r["arrival_s"] - now)
             futs.append(eng.generate_async("gpt", r["prompt"],
                                            r["max_new"]))
-        for f in futs:
-            f.result(timeout=600)
+        outs = [f.result(timeout=600) for f in futs]
         # wall measured on the main thread after the LAST future
         # resolves — the same observation point the static loop uses
         # (a done-callback can lag the result() wakeup, undercounting)
@@ -274,11 +282,13 @@ def run_continuous(ff, trace: List[Dict], *, decode_slots: int,
     eng.stop()
     ttft = [stats["phases"]["ttft"][k] for k in ("p50", "p99")]
     pt = [stats["phases"]["per_token"][k] for k in ("p50", "p99")]
-    return {
+    doc = {
         "engine": "continuous",
         "tokens": tokens,
         **best,
         "prefill_buckets_compiled": len(buckets),
+        "prefill_dispatches": stats["prefill_dispatches"],
+        "prefill_prompts": stats["prefill_prompts"],
         "shed": stats["shed"],
         "deadline_rejects": stats["deadline_rejects"],
         "kv": stats["kv"],
@@ -287,6 +297,9 @@ def run_continuous(ff, trace: List[Dict], *, decode_slots: int,
         "per_token_p50_s": round(pt[0], 6),
         "per_token_p99_s": round(pt[1], 6),
     }
+    if return_outputs:
+        return doc, outs
+    return doc
 
 
 def run_bench(seed: int = 0, requests: int = 12, decode_slots: int = 4,
@@ -346,15 +359,123 @@ def run_bench(seed: int = 0, requests: int = 12, decode_slots: int = 4,
     return doc
 
 
+def make_longtail_trace(seed: int, n: int, rate_per_s: float,
+                        max_prompt: int, max_new: int) -> List[Dict]:
+    """Seeded **length-distribution** trace: geometric prompt lengths
+    clipped to [2, max_prompt] — most prompts short, a heavy tail out
+    to the max, the realistic serving length mix where uniform
+    pad-to-max prefill burns most of its FLOPs on padding."""
+    rng = np.random.default_rng(seed)
+    t = 0.0
+    out = []
+    for _ in range(n):
+        t += float(rng.exponential(1.0 / rate_per_s))
+        ln = int(np.clip(rng.geometric(0.12), 2, max_prompt))
+        out.append({
+            "arrival_s": t,
+            "prompt": rng.integers(0, 64, size=ln).astype(np.int32),
+            "max_new": int(rng.integers(2, max_new + 1)),
+        })
+    return out
+
+
+def run_longtail_bench(seed: int = 0, requests: int = 24,
+                       decode_slots: int = 4, block_size: int = 8,
+                       rate_per_s: float = 5000.0,
+                       prefill_token_budget: int = 64,
+                       smoke: bool = False) -> Dict:
+    """The dynamic-shapes serving A/B: the SAME continuous-batching
+    engine over the SAME long-tail trace, once with uniform pad-to-max
+    prefill (a single max_length bucket, one prompt per dispatch) and
+    once token-native (the pow2 prefill ladder + multi-prompt dispatch
+    under ``prefill_token_budget``). Both variants share the compiled
+    model; generated sequences are asserted identical (greedy), so the
+    comparison is pure dispatch-shape economics. Exits 1 unless the
+    token-native side STRICTLY wins tokens/s."""
+    max_length = 48
+    trace = make_longtail_trace(seed, requests, rate_per_s,
+                                max_prompt=40, max_new=8)
+    ff = build_model(seed)
+    padmax, out_p = run_continuous(
+        ff, trace, decode_slots=decode_slots, block_size=block_size,
+        max_length=max_length, return_outputs=True,
+        sched_kw={"prefill_buckets": [max_length]})
+    bucketed, out_b = run_continuous(
+        ff, trace, decode_slots=decode_slots, block_size=block_size,
+        max_length=max_length, return_outputs=True,
+        sched_kw={"prefill_token_budget": prefill_token_budget})
+    identical = (len(out_p) == len(out_b)
+                 and all(np.array_equal(a, b)
+                         for a, b in zip(out_p, out_b)))
+    speedup = (bucketed["tokens_per_s"] / padmax["tokens_per_s"]
+               if padmax["tokens_per_s"] else None)
+    doc: Dict = {
+        "tool": "serve_bench",
+        "smoke": smoke,
+        "trace": {
+            "kind": "longtail",
+            "seed": seed,
+            "requests": requests,
+            "rate_per_s": rate_per_s,
+            "prompt_lens": [int(len(r["prompt"])) for r in trace],
+            "max_new": [r["max_new"] for r in trace],
+        },
+        "knobs": {"decode_slots": decode_slots, "block_size": block_size,
+                  "max_length": max_length,
+                  "prefill_token_budget": prefill_token_budget},
+        "pad_to_max": padmax,
+        "token_native": bucketed,
+        "speedup": round(speedup, 4) if speedup else None,
+        "generated_identical": identical,
+        "one_dispatch_per_step": (
+            padmax["decode_steps"] == padmax["decode_dispatches"]
+            and bucketed["decode_steps"] == bucketed["decode_dispatches"]),
+    }
+    failures = []
+    if not doc["one_dispatch_per_step"]:
+        failures.append("decode loop issued retraced/extra dispatches "
+                        "(steps != dispatches)")
+    if not identical:
+        failures.append("token-native prefill changed the generated "
+                        "sequences vs pad-to-max")
+    if speedup is None or speedup <= 1.0:
+        failures.append(
+            f"token-budget prefill did not beat uniform pad-to-max "
+            f"(speedup {speedup})")
+    doc["failures"] = failures
+    doc["exit"] = 1 if failures else 0
+    from flexflow_tpu.obs.ledger import model_context, record_bench
+
+    ctx = model_context(ff)
+    record_bench(
+        "serve_bench", doc,
+        perf={"metric": "serving.tokens_per_s",
+              "value": bucketed["tokens_per_s"],
+              "higher_is_better": True},
+        label=f"serve_longtail:{ctx.get('model_sig')}",
+        knobs={"model_sig": ctx.get("model_sig"),
+               "decode_slots": decode_slots, "block_size": block_size,
+               "prefill_token_budget": prefill_token_budget},
+        config=ff.config)
+    return doc
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--smoke", action="store_true",
                     help="small trace; exit 1 unless continuous strictly "
                          "beats static on tokens/s")
+    ap.add_argument("--trace", choices=("mix", "longtail"), default="mix",
+                    help="mix: static vs continuous on the long/short "
+                         "mix; longtail: pad-to-max vs token-budget "
+                         "prefill on a length-distribution trace")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--requests", type=int, default=None)
     ap.add_argument("--decode-slots", type=int, default=4)
     ap.add_argument("--block-size", type=int, default=8)
+    ap.add_argument("--prefill-token-budget", type=int, default=64,
+                    help="longtail trace: the token-native variant's "
+                         "per-dispatch prefill token budget")
     ap.add_argument("--rate", type=float, default=5000.0,
                     help="Poisson arrival rate (requests/s). The default "
                          "saturates the toy model (service-bound, near-"
@@ -362,11 +483,20 @@ def main(argv=None) -> int:
                          "just keep up and tokens/s measures the trace, "
                          "not the server")
     ns = ap.parse_args(argv)
-    requests = ns.requests or (12 if ns.smoke else 24)
-    doc = run_bench(seed=ns.seed, requests=requests,
-                    decode_slots=ns.decode_slots,
-                    block_size=ns.block_size, rate_per_s=ns.rate,
-                    smoke=ns.smoke)
+    if ns.trace == "longtail":
+        requests = ns.requests or (12 if ns.smoke else 24)
+        doc = run_longtail_bench(
+            seed=ns.seed, requests=requests,
+            decode_slots=ns.decode_slots, block_size=ns.block_size,
+            rate_per_s=ns.rate,
+            prefill_token_budget=ns.prefill_token_budget,
+            smoke=ns.smoke)
+    else:
+        requests = ns.requests or (12 if ns.smoke else 24)
+        doc = run_bench(seed=ns.seed, requests=requests,
+                        decode_slots=ns.decode_slots,
+                        block_size=ns.block_size, rate_per_s=ns.rate,
+                        smoke=ns.smoke)
     print(json.dumps(doc, sort_keys=True, default=str))
     return doc["exit"]
 
