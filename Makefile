@@ -12,6 +12,13 @@
 #                   + reasonless-pragma hygiene; one JSON line
 #                   (tools/concurrency_lint.py); exit 1 on any error
 #                   finding or decorative suppression
+#   make knob-lint — config-knob key-coverage audit (KNB0xx: compile/
+#                   perf reachability of every FFConfig knob read,
+#                   strategy-cache + ledger-cohort key coverage, dead
+#                   knobs, CLI-flag parity, serializer schema
+#                   validation) + reasonless-pragma hygiene; one JSON
+#                   line (tools/knob_lint.py); exit 1 on any error
+#                   finding or decorative suppression
 #   make pcg-lint — PCG validator + strategy linter over the model zoo;
 #                   one JSON line (tools/pcg_lint.py)
 #   make audit    — program audit (jaxpr-level AUD0xx checks: donation,
@@ -103,7 +110,8 @@
 PY ?= python
 CPU_MESH = JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-.PHONY: ci native native-check lint concurrency-lint pcg-lint audit \
+.PHONY: ci native native-check lint concurrency-lint knob-lint \
+        pcg-lint audit \
         test dryrun bench bench-fit bench-pipe bench-pipe-smoke \
         serve-bench serve-bench-smoke obs-report sentinel chaos \
         mh-smoke explain advise
@@ -119,7 +127,8 @@ CPU_MESH = JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8
 # FULL multihost matrix, so repeating its kill/shrink cohorts inside
 # chaos would only double the subprocess bill; standalone `make chaos`
 # keeps the complete default matrix
-ci: native native-check lint concurrency-lint test dryrun obs-report \
+ci: native native-check lint concurrency-lint knob-lint test dryrun \
+    obs-report \
     bench-pipe-smoke serve-bench-smoke sentinel chaos-ci mh-smoke \
     explain advise audit
 
@@ -130,6 +139,9 @@ lint:
 
 concurrency-lint:
 	$(PY) tools/concurrency_lint.py
+
+knob-lint:
+	$(PY) tools/knob_lint.py
 
 pcg-lint:
 	$(CPU_MESH) $(PY) tools/pcg_lint.py --hotpath

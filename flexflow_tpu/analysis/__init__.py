@@ -35,9 +35,11 @@ grammar (:mod:`.pragmas`).
 from .concurrency_check import check_package as check_concurrency
 from .concurrency_check import check_source as check_concurrency_source
 from .findings import (CODE_CATALOG, ConcurrencyAuditError, Finding,
-                       PCGValidationError, ProgramAuditError,
-                       ValidationReport, layer_provenance,
-                       report_to_json_line)
+                       KnobFlowAuditError, PCGValidationError,
+                       ProgramAuditError, ValidationReport,
+                       layer_provenance, report_to_json_line)
+from .knobflow_check import check_package as check_knobflow
+from .knobflow_check import check_sources as check_knobflow_sources
 from .hotpath_lint import lint_paths as lint_hotpaths
 from .hotpath_lint import lint_source as lint_hotpath_source
 from .pcg_check import propagate_strategies, validate_pcg
@@ -51,6 +53,7 @@ __all__ = [
     "ConcurrencyAuditError",
     "ExecutableSpec",
     "Finding",
+    "KnobFlowAuditError",
     "PCGValidationError",
     "ProgramAuditError",
     "ValidationReport",
@@ -60,6 +63,8 @@ __all__ = [
     "audit_traced",
     "check_concurrency",
     "check_concurrency_source",
+    "check_knobflow",
+    "check_knobflow_sources",
     "layer_provenance",
     "lint_donated_reuse",
     "lint_hotpath_source",

@@ -158,6 +158,34 @@ CODE_CATALOG: Dict[str, str] = {
     "CCY006": "guarded-by inconsistency: the same field is guarded by "
               "DIFFERENT locks at different sites, so the regions do "
               "not exclude each other",
+    # knob-flow auditor (analysis/knobflow_check.py) — cache-key /
+    # cohort-key coverage for every compile-determinant config knob
+    "KNB000": "unparseable module (syntax error) — excluded from the "
+              "knob-flow audit",
+    "KNB001": "uncovered compile-determinant knob: a config knob read "
+              "on the compile/search path is stamped into neither "
+              "_SEARCH_KNOBS nor config_signature — a cached plan "
+              "selected under one value would silently replay under "
+              "another",
+    "KNB002": "uncovered perf-relevant knob: a config knob read on the "
+              "fit/serving path is absent from the ledger cohort "
+              "context (_KNOB_FIELDS/model_context/"
+              "serving_knob_context) — perf_sentinel would compare "
+              "runs across different settings (warning)",
+    "KNB003": "dead knob: defined in config.py, never read anywhere "
+              "in the scanned source (warning)",
+    "KNB004": "CLI-flag/config-field parity drift: parse_args sets an "
+              "unknown field, one flag claims two fields, or a field "
+              "has no flag at all (the last: warning)",
+    "KNB005": "unvalidated serializer version: a *_SCHEMA/*_VERSION "
+              "constant is written into records but no reader ever "
+              "compares against it — a layout change would be "
+              "consumed silently instead of demoting to a counted "
+              "skip",
+    "KNB006": "guard-asymmetric stamp: a knob stamped into the key "
+              "only under a mode guard is read without consulting the "
+              "same mode knob — the knob can influence the run while "
+              "the key omits it",
     # hot-path lint (analysis/hotpath_lint.py) — source-level race/sync
     "HOT000": "unparseable source file (syntax error) — nothing else "
               "could be checked",
@@ -215,8 +243,9 @@ class ValidationReport:
     findings: List[Finding] = dataclasses.field(default_factory=list)
     source: str = "builder"  # "builder" | "cache" | "rewrite" | path
     # which gate produced the report: "pcg" (graph passes), "audit"
-    # (program audit) or "concurrency" (whole-package concurrency
-    # audit) — picks the print prefix and the error class
+    # (program audit), "concurrency" (whole-package concurrency
+    # audit) or "knobflow" (config-knob key-coverage audit) — picks
+    # the print prefix and the error class
     tag: str = "pcg"
 
     def add(self, code: str, message: str, *, severity: str = "error",
@@ -310,9 +339,17 @@ class ConcurrencyAuditError(PCGValidationError):
     _WHAT = "concurrency audit failed"
 
 
+class KnobFlowAuditError(PCGValidationError):
+    """A knob-flow audit gate failure (KNB0xx codes); same subclass
+    rationale as :class:`ProgramAuditError`."""
+
+    _WHAT = "knob-flow audit failed"
+
+
 _TAG_ERRORS = {
     "audit": ProgramAuditError,
     "concurrency": ConcurrencyAuditError,
+    "knobflow": KnobFlowAuditError,
 }
 
 

@@ -10,13 +10,15 @@ ONE grammar every pass shares:
 
 * ``tool``  — the pass family: ``hotpath`` (AST lint), ``audit``
   (jaxpr program audit), ``concurrency`` (whole-package concurrency
-  audit). Lowercase letters only.
+  audit), ``knobflow`` (config-knob key-coverage audit). Lowercase
+  letters only.
 * ``token`` — the specific suppression, conventionally ``<what>-ok``:
   ``sync-ok``/``lock-ok`` (HOT001-003), ``const-ok`` (AUD001),
   ``donate-ok`` (AUD002), ``callback-ok`` (AUD003), ``accum-ok``
   (AUD004), ``retrace-ok`` (AUD006), ``race-ok``/``order-ok``/
-  ``block-ok``/``cond-ok``/``leak-ok``/``guard-ok`` (CCY001-006).
-  Lowercase letters/digits/dashes.
+  ``block-ok``/``cond-ok``/``leak-ok``/``guard-ok`` (CCY001-006),
+  ``key-ok``/``cohort-ok``/``dead-ok``/``flag-ok``/``schema-ok``/
+  ``guard-ok`` (KNB001-006). Lowercase letters/digits/dashes.
 * ``reason`` — REQUIRED free text. The pragma is the review trail:
   a suppression without a reason does not suppress (and
   :func:`lint_reasonless` reports it so the gap is visible).

@@ -40,9 +40,9 @@ class FFConfig:
     model.cc:3566-3730 ``parse_args``)."""
 
     batch_size: int = 64
-    epochs: int = 1
-    learning_rate: float = 0.01
-    weight_decay: float = 1e-4
+    epochs: int = 1  # knobflow: cohort-ok (run length, not per-step performance)
+    learning_rate: float = 0.01  # knobflow: key-ok (optimizer scalar baked into the step executable, rebuilt every run; never read by the search)
+    weight_decay: float = 1e-4  # knobflow: key-ok (optimizer scalar baked into the step executable, rebuilt every run; never read by the search)
     # parallelism/search knobs (reference: config.h:116-160)
     num_nodes: int = 1
     workers_per_node: int = 0  # 0 => autodetect
@@ -58,7 +58,7 @@ class FFConfig:
     # replicated (reference: enable_sample_parallel, config.h:116-160).
     # NOTE: the reference's enable_inplace_optimizations has no equivalent
     # field — XLA's buffer assignment performs in-place reuse automatically.
-    enable_sample_parallel: bool = True
+    enable_sample_parallel: bool = True  # knobflow: cohort-ok (plan-shaping switch already keyed in _SEARCH_KNOBS; its perf effect rides the compiled plan)
     enable_parameter_parallel: bool = False
     enable_attribute_parallel: bool = False
     perform_fusion: bool = False
@@ -83,7 +83,7 @@ class FFConfig:
     # non-DP strategy, time the searched step vs a data-parallel compile
     # for this many real steps and keep the measured winner (0 = off).
     # The honest answer to the reference measuring kernels in-search.
-    playoff_steps: int = 0
+    playoff_steps: int = 0  # knobflow: cohort-ok (bench length of the startup playoff; steady-state step time unchanged)
     # benchmark hygiene: examples repeat their timed fit window this many
     # times and print one THROUGHPUT line each (median/spread recorded by
     # scripts/osdi_ae/run_ae.py)
@@ -97,19 +97,19 @@ class FFConfig:
     # candidates where pool overhead beats the win), 1 = the historical
     # serial path, N = exactly N workers. Selection is bit-identical to
     # serial at any setting (deterministic candidate-index tie-break).
-    search_num_workers: int = 0
+    search_num_workers: int = 0  # knobflow: key-ok (search execution parallelism; unity's deterministic ranking is worker-count invariant)
     # bound-based mesh pruning: skip the inner DP for candidates whose
     # compute-only lower bound already exceeds the incumbent x adoption
     # margin. Selection-neutral by construction (search/unity.py
     # _shape_lower_bound); pruned counts surface in the profiling export.
-    search_prune: bool = True
+    search_prune: bool = True  # knobflow: key-ok (bound pruning is selection-neutral by construction; a cached plan transfers across prune settings, pinned by test_search_cache)
     # persistent strategy cache (the reference's --import-strategy made
     # automatic, model.cc:3609-3618): "on" consults
     # <search_cache_dir>/<sha256-key>.json before any search and stores
     # fresh results; "refresh" re-runs the search and overwrites the
     # entry; "off" (default) bypasses the cache entirely.
-    search_cache: str = "off"
-    search_cache_dir: str = ".ffcache/strategies"
+    search_cache: str = "off"  # knobflow: key-ok (the cache on/off switch gates the lookup itself; it cannot stale a stored plan)
+    search_cache_dir: str = ".ffcache/strategies"  # knobflow: key-ok (cache location; a different dir is a different store, never a stale hit)
     # PCG validation gate (analysis/pcg_check.py): every compile — and
     # every strategy rehydrated from the cache or produced by a graph
     # rewrite — is statically checked for graph well-formedness and
@@ -117,7 +117,7 @@ class FFConfig:
     # PCG0xx-coded, layer-attributed PCGValidationError; "warn" prints
     # every finding and proceeds (a corrupt cached strategy is treated
     # as a miss); "off" restores the unchecked historical behavior.
-    validate_pcg: str = "error"
+    validate_pcg: str = "error"  # knobflow: key-ok (validation gate: raises or warns, never alters the selected plan)
     # program-audit gate (analysis/program_audit.py): after lowering,
     # every compiled step executable's jaxpr is statically audited —
     # donation coverage, baked-in constants, host callbacks, accumulator
@@ -126,51 +126,51 @@ class FFConfig:
     # error-severity finding; "warn" prints everything and proceeds;
     # "off" skips the walk. The audit traces through jit's AOT API, so
     # its trace is shared with the first real dispatch (paid once).
-    audit_programs: str = "error"
+    audit_programs: str = "error"  # knobflow: key-ok (program-audit gate: raises or warns, never alters the selected plan)  # knobflow: cohort-ok (compile-time audit gate; no steady-state perf effect)
     # AUD001: closed-over constants at or above this many bytes are
     # reported (below it, a baked table is cheaper than an argument)
-    audit_const_bytes: int = 1 << 20
+    audit_const_bytes: int = 1 << 20  # knobflow: key-ok (audit threshold; tunes findings, never the plan)
     # AUD002: non-donated arguments at or above this many bytes with a
     # matching output aval are reported
-    audit_donate_bytes: int = 1 << 20
+    audit_donate_bytes: int = 1 << 20  # knobflow: key-ok (audit threshold; tunes findings, never the plan)
     substitution_json_path: Optional[str] = None
     machine_model_file: Optional[str] = None
-    export_strategy_file: Optional[str] = None
-    export_strategy_task_graph_file: Optional[str] = None
-    import_strategy_file: Optional[str] = None
-    export_strategy_computation_graph_file: Optional[str] = None
-    include_costs_dot_graph: bool = False
+    export_strategy_file: Optional[str] = None  # knobflow: key-ok (debug artifact output path; no influence on selection)
+    export_strategy_task_graph_file: Optional[str] = None  # knobflow: key-ok (debug artifact output path; no influence on selection)
+    import_strategy_file: Optional[str] = None  # knobflow: key-ok (an imported strategy bypasses the search/cache branch entirely)
+    export_strategy_computation_graph_file: Optional[str] = None  # knobflow: key-ok (debug artifact output path; no influence on selection)
+    include_costs_dot_graph: bool = False  # knobflow: key-ok (debug artifact output path; no influence on selection)
     base_optimize_threshold: int = 10
     # profiling / tracing
-    profiling: bool = False
-    print_freq: int = 10
+    profiling: bool = False  # knobflow: key-ok (console diagnostics gate; prints the ranking it does not change)  # knobflow: cohort-ok (console diagnostics gate; no steady-state perf effect)
+    print_freq: int = 10  # knobflow: cohort-ok (console progress cadence; host-side counter print only)
     # --- flight recorder (obs/) -------------------------------------------
     # span tracer: "on" arms the process-wide ring-buffered tracer
     # (obs/trace.py) — spans across compile/search/cache, the fit/eval
     # step loop, the pipeline engines, and serving; export with
     # Tracer.export(path) as Chrome/Perfetto trace-event JSON. "off"
     # (default) keeps the hot loops span-free (a single flag check).
-    trace: str = "off"
+    trace: str = "off"  # knobflow: key-ok (observability gate armed at compile; no effect on the plan)
     # sim-vs-measured divergence (obs/divergence.py), recorded into
     # fit_profile["divergence"] after each fit: "off" (default, zero
     # overhead), "e2e" (end-to-end est_step_time vs measured — derived
     # from counters fit already records), "on" (adds the per-op
     # cost-model-vs-profile_ops comparison; jits each op once)
-    divergence: str = "off"
+    divergence: str = "off"  # knobflow: cohort-ok (divergence monitor gate; epoch-boundary host work only)
     # |measured/predicted - 1| beyond which the OBS001 warn finding
     # fires (1.0 = within 2x either way tolerated)
-    divergence_threshold: float = 1.0
+    divergence_threshold: float = 1.0  # knobflow: cohort-ok (divergence monitor threshold; epoch-boundary host work only)
     # --- durable observability (obs/ledger, exec_telemetry, watchdog) -----
     # run ledger (obs/ledger.py): "on" (default) appends one schema-
     # versioned JSONL record per compile/fit/eval/serving/bench run to
     # ledger_dir — the durable corpus the divergence flywheel and
     # tools/perf_sentinel.py read; "off" disables all appends.
-    ledger: str = "on"
+    ledger: str = "on"  # knobflow: key-ok (observability gate armed at compile; no effect on the plan)
     # None = unset: resolution is explicit knob > FLEXFLOW_TPU_LEDGER_DIR
     # env > .ffcache/obs/runs (obs/ledger.ledger_dir) — so a config that
     # never touched the knob and a config-less reader (tools) agree on
     # the directory even under the env override
-    ledger_dir: Optional[str] = None
+    ledger_dir: Optional[str] = None  # knobflow: key-ok (ledger output location; no effect on the plan)
     # executable telemetry (obs/exec_telemetry.py): "on" pulls XLA's
     # cost_analysis()/memory_analysis() off every compiled step
     # executable at compile time (flops/bytes/peak memory per program,
@@ -180,17 +180,17 @@ class FFConfig:
     # ahead-of-time compile the analyses hang off is NOT shared with
     # the dispatch path's executable cache, so "on" pays one extra XLA
     # compile per program — a profiling-run cost, not an inner-loop one.
-    exec_telemetry: str = "off"
+    exec_telemetry: str = "off"  # knobflow: key-ok (observability gate armed at compile; no effect on the plan)
     # symmetric peak-memory divergence (max(r, 1/r) - 1 for
     # r = xla_peak/static_peak) tolerated before OBS002; 3.0 = within 4x
     # in either direction (the two models count different things —
     # static prices every intermediate at full aval size, XLA's
     # allocator reuses and fuses buffers — so only order-level drift is
     # signal)
-    exec_mem_threshold: float = 3.0
+    exec_mem_threshold: float = 3.0  # knobflow: key-ok (telemetry reconcile threshold; warns or raises, never re-plans)
     # program name -> REASON for waiving OBS002 on a known-divergent
     # program (the pragma contract: an empty reason does not suppress)
-    exec_mem_allow: Optional[dict] = None
+    exec_mem_allow: Optional[dict] = None  # knobflow: key-ok (post-compile memory reconciliation gate; fails or allows, never re-plans)  # knobflow: cohort-ok (serving program audit gate; no steady-state perf effect)  # knobflow: flag-ok (list-valued allowlist set programmatically by tests/tools)
     # step-time attribution (obs/attribution.py): "on" (default)
     # decomposes each fit's measured steady-state step time into phases
     # (input wait, host dispatch, device compute, collective/transfer,
@@ -200,10 +200,10 @@ class FFConfig:
     # plus one analytic replay — no extra XLA work; the report lands in
     # fit_profile["attribution"], the run ledger, and the obs server's
     # /attribution endpoint. "off" skips it.
-    attribution: str = "on"
+    attribution: str = "on"  # knobflow: key-ok (observability gate armed at compile; no effect on the plan)
     # rows in the attribution report's top-ops and divergence-outlier
     # rankings
-    attribution_top_k: int = 8
+    attribution_top_k: int = 8  # knobflow: cohort-ok (attribution report size; observability-only)
     # perf advisor (obs/advisor.py): "on" (default) maps each fit's
     # attribution verdict (and each continuous-batching serving
     # session's phase table) to ranked, concrete knob deltas — the
@@ -212,30 +212,30 @@ class FFConfig:
     # /advice endpoint. Pure-python walk over records the run already
     # produced; "off" skips it. tools/perf_advisor.py is the
     # ledger-wide tool (and the --apply-top auto-benchmark harness).
-    advisor: str = "on"
+    advisor: str = "on"  # knobflow: cohort-ok (advisor gate; observability-only)
     # ranked suggestions kept per advisor report
-    advisor_max_suggestions: int = 5
+    advisor_max_suggestions: int = 5  # knobflow: cohort-ok (advisor report size; observability-only)
     # per-op cost corpus (obs/costcorpus.py): "on" times every compiled
     # op forward AND backward under its real mesh sharding after each
     # fit and appends featurized, dedup-keyed rows to
     # .ffcache/costmodel/corpus/ — the training set ROADMAP item 2's
     # learned cost model consumes. Opt-in ("off" default): collection
     # jits each op fwd+bwd once, a profiling-run cost.
-    cost_corpus: str = "off"
+    cost_corpus: str = "off"  # knobflow: key-ok (observability gate armed at compile; no effect on the plan)
     # None = unset: knob > FLEXFLOW_TPU_COSTCORPUS_DIR env > default
-    cost_corpus_dir: Optional[str] = None
+    cost_corpus_dir: Optional[str] = None  # knobflow: cohort-ok (corpus output location; observability-only)
     # observability HTTP server (obs/server.py): a port arms a zero-dep
     # http.server background thread exposing /metrics (Prometheus),
     # /healthz (watchdog heartbeat ages), /runs (ledger tail), /trace
     # (Chrome trace download), /attribution (latest report). None
     # (default) = no socket, no thread; 0 = OS-assigned ephemeral port
     # (the bound port is on obs_server().port).
-    obs_server_port: Optional[int] = None
+    obs_server_port: Optional[int] = None  # knobflow: key-ok (obs scrape surface port; no effect on the plan)
     # divergence per-op rows kept on each ledger fit record (the top-k
     # by measured time; 0 = keep none; the record counts what it
     # truncated either way so it never silently claims full coverage).
     # The full rows stay in the in-process fit_profile regardless.
-    ledger_per_op_topk: int = 16
+    ledger_per_op_topk: int = 16  # knobflow: cohort-ok (ledger report size; observability-only)
     # stall watchdog (obs/watchdog.py): "on" arms a daemon thread fed
     # heartbeats by the fit/eval dispatch loops, the Prefetcher worker,
     # and serving workers; a watched source silent past
@@ -243,9 +243,9 @@ class FFConfig:
     # dump (all thread stacks, tracer ring tail, metrics snapshot, last
     # ledger record) to watchdog_dir. "off" (default) costs one flag
     # check per heartbeat site.
-    watchdog: str = "off"
-    watchdog_threshold_s: float = 60.0
-    watchdog_dir: str = ".ffcache/obs/blackbox"
+    watchdog: str = "off"  # knobflow: cohort-ok (stall monitor gate; heartbeats are O(1) host work)
+    watchdog_threshold_s: float = 60.0  # knobflow: cohort-ok (stall monitor threshold; observability-only)
+    watchdog_dir: str = ".ffcache/obs/blackbox"  # knobflow: cohort-ok (black-box dump location; observability-only)
     # --- fault tolerance (runtime/faults.py, retry.py, checkpoint.py) -----
     # deterministic fault injection: a schema-versioned plan dict
     # ({"schema": 1, "seed": ..., "sites": {...}}) arming named failure
@@ -256,7 +256,7 @@ class FFConfig:
     # raises at compile()/fit()/serving entry, the mode-knob convention.
     # Runs with an armed plan carry a ledger "faults" block and are
     # cohort-EXCLUDED by tools/perf_sentinel.py.
-    fault_plan: Optional[dict] = None
+    fault_plan: Optional[dict] = None  # knobflow: key-ok (chaos injection plan armed at compile; orthogonal to plan selection)
     # crash-safe training: fit() saves a full-resume checkpoint (params,
     # optimizer state, step/epoch, rng, dataloader cursor + shuffle
     # state, guard budget, lr) every N steps through CheckpointManager,
@@ -267,8 +267,8 @@ class FFConfig:
     # proves it).
     checkpoint_interval_steps: int = 0
     # None = .ffcache/ckpt; fit(resume_from=...) overrides per call
-    checkpoint_dir: Optional[str] = None
-    checkpoint_max_to_keep: int = 3
+    checkpoint_dir: Optional[str] = None  # knobflow: cohort-ok (resume plumbing; the perf-relevant cadence knob checkpoint_interval_steps IS keyed)
+    checkpoint_max_to_keep: int = 3  # knobflow: cohort-ok (resume plumbing; retention never touches the step loop)
     # --- elastic multi-host (parallel/multihost.py, tools/mh_launch.py) ---
     # topology-portable resume: a fit(resume_from=...) whose checkpoint
     # was written under a DIFFERENT topology (process count, device
@@ -277,13 +277,13 @@ class FFConfig:
     # (params/optimizer state re-placed onto the NEW compiled shardings,
     # counted on checkpoint.elastic_resumes) after search re-ran for the
     # new topology — the shrunk/grown-world relaunch path.
-    elastic_resume: bool = False
+    elastic_resume: bool = False  # knobflow: cohort-ok (resume handoff switch; no steady-state perf effect)
     # multi-host checkpoint commit barrier: rank 0 publishes the
     # topology-stamped manifest only after every rank's shard ack lands
     # within this bound; a dead peer means no manifest for that step
     # (counted on checkpoint.barrier_timeouts) and restore falls back to
     # the previous manifested step.
-    checkpoint_barrier_timeout_s: float = 60.0
+    checkpoint_barrier_timeout_s: float = 60.0  # knobflow: cohort-ok (resume barrier timeout; no steady-state perf effect)
     # --- continuous-batching serving (serving/scheduler.py) ---------------
     # decode-slot width of the single compiled decode program: all
     # in-flight requests batch into these slots, one dispatch per decode
@@ -314,7 +314,7 @@ class FFConfig:
     # behavior.
     serving_prefill_token_budget: int = 0
     # numerics
-    computation_mode: CompMode = CompMode.TRAINING
+    computation_mode: CompMode = CompMode.TRAINING  # knobflow: flag-ok (CompMode enum set by the serving entry points, not a CLI scalar)
     # mixed precision: "bfloat16" runs activations/matmuls in bf16 on the
     # MXU while master weights, the optimizer state, loss, and BatchNorm
     # statistics stay float32 (the reference is fp32-only — model.cc has no
@@ -381,7 +381,7 @@ class FFConfig:
     # overhead for small models. 1 = off. Requires no per-step hooks —
     # fit falls back to K=1 when a recompile_state or the pipeline engine
     # needs step granularity.
-    steps_per_dispatch: int = 1
+    steps_per_dispatch: int = 1  # knobflow: key-ok (shapes the K-step dispatch wrapper built AFTER the plan is fixed; payloads store the plan, not executables)
     # --- token-native dynamic shapes (runtime/buckets.py) -----------------
     # bucketed train/eval compilation: pad each ragged batch's sequence
     # dim to the smallest ladder bucket that fits its longest row instead
@@ -392,9 +392,9 @@ class FFConfig:
     # fit.bucket_compiles and attributed on the ledger; row lengths come
     # from the sparse-CE label tensor's trailing -1 padding.
     seq_buckets: str = "off"
-    seq_bucket_min: int = 8
+    seq_bucket_min: int = 8  # knobflow: cohort-ok (subsumed by the RESOLVED seq_bucket_ladder model_context stamps under the same guard)
     # ladder ceiling; 0 = the data's sequence dim
-    seq_bucket_max: int = 0
+    seq_bucket_max: int = 0  # knobflow: cohort-ok (subsumed by the RESOLVED seq_bucket_ladder model_context stamps under the same guard)
     # token-budget batch packing (runtime/dataloader.py): when > 0, fit
     # groups the shuffled epoch by token budget instead of a fixed row
     # count — each packed batch pads to one shared bucket b and holds at
@@ -408,11 +408,11 @@ class FFConfig:
     # batch's seq dim to the ladder max — the pad-to-max baseline with
     # bit-comparable per-step trajectories. "off" (default) = bucketed.
     seq_bucket_pad_max: str = "off"
-    seed: int = 0
+    seed: int = 0  # knobflow: key-ok (param-init/timing rng; MCMC, the only seed-sensitive search, bypasses the cache)  # knobflow: cohort-ok (rng; does not change step time)
     # mesh description: axis names and sizes; None => 1-D data mesh over all
     # visible devices (reference analog: register_all_machine_views'
     # 1-D GPU views, src/runtime/graph.cc:2329-2360)
-    mesh_shape: Optional[dict] = None
+    mesh_shape: Optional[dict] = None  # knobflow: key-ok (keyed as the resolved mesh_axes argument of config_signature)  # knobflow: flag-ok (dict-valued axis map; the bench tools build it from their own --mesh flags)
 
     def __post_init__(self):
         if self.workers_per_node == 0:
@@ -450,6 +450,10 @@ class FFConfig:
                 cfg.search_budget = int(_next())
             elif a == "--alpha" or a == "--search-alpha":
                 cfg.search_alpha = float(_next())
+            elif a == "--search-method":
+                cfg.search_method = _next()
+            elif a == "--base-optimize-threshold":
+                cfg.base_optimize_threshold = int(_next())
             elif a == "--only-data-parallel":
                 cfg.only_data_parallel = True
             elif a == "--enable-parameter-parallel":
@@ -607,6 +611,8 @@ class FFConfig:
                 cfg.seq_bucket_min = int(_next())
             elif a == "--seq-bucket-max":
                 cfg.seq_bucket_max = int(_next())
+            elif a == "--seq-bucket-pad-max":
+                cfg.seq_bucket_pad_max = _next()
             elif a == "--token-budget":
                 cfg.token_budget = int(_next())
             # unknown flags are ignored, matching the reference's tolerance

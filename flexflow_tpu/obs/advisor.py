@@ -416,6 +416,14 @@ def advise_record(rec: Dict,
         }
     else:
         attr = rec.get("attribution") or {}
+        # schema-gate the block before advising off it: a future
+        # attribution layout must demote to "no advice", not be
+        # half-read into wrong knob deltas (absent schema = same
+        # producer process, pre-envelope publish path — accepted)
+        from .attribution import ATTRIBUTION_SCHEMA
+
+        if attr.get("schema", ATTRIBUTION_SCHEMA) != ATTRIBUTION_SCHEMA:
+            return None
         secs = _phase_seconds(attr)
         measured = attr.get("measured_step_s")
         if not secs or not isinstance(measured, (int, float)) \

@@ -166,11 +166,13 @@ def build_rows(ffmodel, iters: int = 3) -> List[Dict]:
 def scan_corpus(dirpath: Optional[str] = None) -> Dict:
     """Read every ``*.jsonl`` under the corpus dir; corrupt lines
     (crash-truncated appends, foreign garbage) are skipped and counted,
-    the ledger's tolerance discipline. Returns
-    ``{"rows": [...], "files": n, "corrupt_lines": n}``."""
+    the ledger's tolerance discipline — and so are rows whose
+    ``schema`` VALUE is not this reader's ``CORPUS_SCHEMA``. Returns
+    ``{"rows": [...], "files": n, "corrupt_lines": n,
+    "foreign_schema": n}``."""
     dirpath = dirpath or corpus_dir()
     rows: List[Dict] = []
-    files = corrupt = 0
+    files = corrupt = foreign = 0
     try:
         names = sorted(os.listdir(dirpath))
     except OSError:
@@ -196,8 +198,15 @@ def scan_corpus(dirpath: Optional[str] = None) -> Dict:
             except ValueError:
                 corrupt += 1
                 continue
+            if doc["schema"] != CORPUS_SCHEMA:
+                # a future/foreign row layout: counted and skipped —
+                # half-parsing it into training data would be worse
+                # than losing it (KNB005)
+                foreign += 1
+                continue
             rows.append(doc)
-    return {"rows": rows, "files": files, "corrupt_lines": corrupt}
+    return {"rows": rows, "files": files, "corrupt_lines": corrupt,
+            "foreign_schema": foreign}
 
 
 def existing_keys(dirpath: Optional[str] = None) -> Set[str]:

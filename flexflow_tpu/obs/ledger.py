@@ -159,12 +159,14 @@ def last_record() -> Optional[Dict]:
 def scan_ledger(dirpath: Optional[str] = None) -> Dict:
     """Read every ``*.jsonl`` under the ledger dir. Corrupt lines
     (crash-truncated appends, foreign garbage) are SKIPPED and counted —
-    one bad line never poisons the corpus. Returns
-    ``{"runs": [...], "files": n, "corrupt_lines": n}`` with runs in
-    ascending ``ts_unix_s`` order."""
+    one bad line never poisons the corpus — and so are records whose
+    ``schema`` VALUE is not this reader's ``LEDGER_SCHEMA``. Returns
+    ``{"runs": [...], "files": n, "corrupt_lines": n,
+    "foreign_schema": n}`` with runs in ascending ``ts_unix_s``
+    order."""
     dirpath = dirpath or ledger_dir()
     runs: List[Dict] = []
-    files = corrupt = 0
+    files = corrupt = foreign = 0
     try:
         names = sorted(os.listdir(dirpath))
     except OSError:
@@ -189,13 +191,20 @@ def scan_ledger(dirpath: Optional[str] = None) -> Dict:
             except ValueError:
                 corrupt += 1
                 continue
+            if doc["schema"] != LEDGER_SCHEMA:
+                # a record from a FUTURE (or foreign) layout: counted
+                # and skipped, never half-parsed into the corpus —
+                # presence of the key alone proved nothing (KNB005)
+                foreign += 1
+                continue
             runs.append(doc)
     # stable sort on the (rounded) timestamp only: records appended
     # within the same millisecond keep their file/line order — which IS
     # append order within a process file — instead of shuffling on a
     # random run_id tie-break
     runs.sort(key=lambda r: r.get("ts_unix_s") or 0)
-    return {"runs": runs, "files": files, "corrupt_lines": corrupt}
+    return {"runs": runs, "files": files, "corrupt_lines": corrupt,
+            "foreign_schema": foreign}
 
 
 def load_runs(dirpath: Optional[str] = None, kind: Optional[str] = None,
@@ -243,6 +252,10 @@ def cohort_key(rec: Dict) -> str:
         sorted((rec.get("mesh") or {}).items()),
         sorted((rec.get("knobs") or {}).items()),
         (rec.get("machine") or {}).get("backend"),
+        # records stamped under a different knob-field coverage carry
+        # knob blocks that describe different things — never comparable
+        # (pre-coverage records group under None, also their own cohort)
+        rec.get("knobs_cover"),
     ], sort_keys=True, default=str)
 
 
@@ -250,7 +263,41 @@ def cohort_key(rec: Dict) -> str:
 _KNOB_FIELDS = ("batch_size", "compute_dtype", "prefetch_depth",
                 "steps_per_dispatch", "max_inflight_steps",
                 "grad_accum_steps", "zero_optimizer", "pipeline_schedule",
-                "pipeline_interleave", "search_cache", "perform_fusion")
+                "pipeline_interleave", "search_cache", "perform_fusion",
+                # KNB002 sweep (PR 18): remat trades compute for memory
+                # in every pipelined step; interval checkpointing
+                # inserts periodic save pauses into the step-time
+                # distribution
+                "pipeline_remat", "checkpoint_interval_steps")
+
+# the serving-session cohort dimensions: the config-requested batching
+# envelope. The scheduler's extra block additionally carries RESOLVED
+# values (auto-sized num_blocks, derived max_length) which win on merge
+# in record_serving — these are the fallback for engine-only sessions
+_SERVING_KNOB_FIELDS = ("serving_decode_slots", "serving_block_size",
+                        "serving_num_blocks", "serving_max_length",
+                        "serving_prefill_buckets",
+                        "serving_max_prefills_per_step",
+                        "serving_prefill_token_budget")
+
+
+def knob_coverage_version() -> str:
+    """8-hex digest over the sorted union of every cohort knob-field
+    tuple — stamped on records as ``knobs_cover`` and keyed by
+    :func:`cohort_key`, so WIDENING the coverage (a new `_KNOB_FIELDS`
+    entry) splits cohorts cleanly instead of comparing records whose
+    knob blocks describe different things. The knob-flow auditor
+    (:mod:`..analysis.knobflow_check.cohort_cover_hash`) derives the
+    same value from the AST; a test pins the two equal."""
+    import hashlib as _h
+
+    fields = sorted(set(_KNOB_FIELDS) | set(_SERVING_KNOB_FIELDS))
+    return _h.sha256(",".join(fields).encode()).hexdigest()[:8]
+
+
+def serving_knob_context(config) -> Dict:
+    """Config-requested serving knobs for the serving cohort block."""
+    return {k: getattr(config, k, None) for k in _SERVING_KNOB_FIELDS}
 
 
 def model_context(ff) -> Dict:
@@ -261,7 +308,8 @@ def model_context(ff) -> Dict:
 
     cm = ff.compiled
     ctx: Dict = {"knobs": {k: getattr(ff.config, k, None)
-                           for k in _KNOB_FIELDS}}
+                           for k in _KNOB_FIELDS},
+                 "knobs_cover": knob_coverage_version()}
     try:
         import jax
 
@@ -497,6 +545,15 @@ def record_serving(extra: Optional[Dict] = None,
                 rec[name] = m.to_json()
         if extra:
             rec.update(extra)
+        if config is not None:
+            # serving cohort knobs: the config-requested ``serving_*``
+            # values, unioned with any block the scheduler's extra
+            # already carries (its RESOLVED short-name values — auto-
+            # sized num_blocks, derived max_length — ride alongside)
+            knobs = serving_knob_context(config)
+            knobs.update(rec.get("knobs") or {})
+            rec["knobs"] = knobs
+            rec.setdefault("knobs_cover", knob_coverage_version())
         fb = _faults_block()
         if fb:
             rec["faults"] = fb
@@ -530,8 +587,9 @@ def record_bench(tool: str, result: Dict, perf: Optional[Dict] = None,
 
 
 __all__ = [
-    "LEDGER_SCHEMA", "cohort_key", "filter_runs", "last_record",
-    "ledger_dir", "ledger_mode", "load_runs", "machine_fingerprint",
-    "merge_runs", "model_context", "record_bench", "record_compile",
-    "record_fit", "record_run", "record_serving", "scan_ledger",
+    "LEDGER_SCHEMA", "cohort_key", "filter_runs", "knob_coverage_version",
+    "last_record", "ledger_dir", "ledger_mode", "load_runs",
+    "machine_fingerprint", "merge_runs", "model_context", "record_bench",
+    "record_compile", "record_fit", "record_run", "record_serving",
+    "scan_ledger", "serving_knob_context",
 ]

@@ -232,7 +232,7 @@ class Watchdog:
             watched = {k: round(time.monotonic() - t, 3)
                        for k, t in self._watched.items()}
         doc = {
-            "schema": BLACKBOX_SCHEMA,
+            "schema": BLACKBOX_SCHEMA,  # knobflow: schema-ok (black-box dumps are human post-mortem artifacts; no in-repo reader parses them — chaos_bench/mh_launch only count the files)
             "reason": reason,
             "ts_unix_s": round(time.time(), 3),
             "pid": os.getpid(),

@@ -726,7 +726,7 @@ class FFModel:
         optimizer: Optional[Optimizer] = None,
         loss_type: Optional[Union[LossType, str]] = None,
         metrics: Optional[Sequence[Union[MetricsType, str]]] = None,
-        comp_mode: CompMode = CompMode.TRAINING,
+        comp_mode: Optional[CompMode] = None,
         strategies: Optional[Dict[str, Dict[str, str]]] = None,
         mesh=None,
         pipeline=None,
@@ -737,6 +737,14 @@ class FFModel:
         ``parallel.pipeline.PipelineConfig`` to train with a GPipe schedule
         over the mesh's pipe axis (no reference equivalent — PP is reserved
         but unimplemented upstream, model.h:190-192)."""
+        # comp_mode defaults from the config field (reference:
+        # FFConfig.computation_mode / comp_mode in config.h) — serving
+        # constructs FFConfig(computation_mode=INFERENCE) and compiles
+        # without the kwarg, so the field is the one source of truth;
+        # an explicit kwarg still wins. The mode is a _SEARCH_KNOBS key
+        # dimension: inference plans never warm-hit training plans.
+        if comp_mode is None:
+            comp_mode = self.config.computation_mode
         configure_tracer(self.config)  # config.trace="on" arms the recorder
         # typo'd obs mode knobs fail HERE, before any search/XLA work is
         # paid (the convention every mode knob follows)
@@ -2159,6 +2167,16 @@ class FFModel:
                 _wd_beat("fit.loop")  # watchdog heartbeat (no-op when off)
                 cm.iteration += nk
                 steps_in_epoch += nk
+                # reference: --print-freq (config.print_freq) — the
+                # mid-epoch progress cadence. Host-side counters only:
+                # no device value is read, so the async pipeline never
+                # syncs for a progress line
+                pf = self.config.print_freq
+                if (verbose and pf > 0
+                        and steps_in_epoch // pf
+                        != (steps_in_epoch - nk) // pf):
+                    print(f"[fit] epoch {epoch} step {steps_in_epoch} "
+                          f"(iteration {cm.iteration})", flush=True)
                 if ckpt_interval and ckpt_mgr is not None:
                     steps_since_ckpt += nk
                     if steps_since_ckpt >= ckpt_interval:

@@ -142,6 +142,15 @@ _SEARCH_KNOBS = (
     # schedules (or pins the requested one) per candidate mesh
     "pipeline_schedule",
     "pipeline_interleave",
+    # KNB001 sweep (PR 18): remat changes the stage program the ranked
+    # schedules execute; grad_accum microbatching changes the step the
+    # plan is priced for; comp_mode splits training plans from the
+    # inference plans serving compiles with the same graph+mesh.
+    # (search_prune stays OUT: bound pruning is selection-neutral by
+    # construction — results transfer, pinned by test_search_cache.)
+    "pipeline_remat",
+    "grad_accum_steps",
+    "computation_mode",
 )
 
 
@@ -260,6 +269,18 @@ def config_signature(config, mesh_axes: Optional[Dict[str, int]]) -> Dict:
                     f.read()).hexdigest()
         except OSError:
             sig["substitution_json"] = f"unreadable:{path}"
+    # a machine model file drives the cost model that prices every
+    # candidate (pipeline envelope included): hash the CONTENT, same
+    # contract as substitution_json — retuned numbers re-search, the
+    # same file from another path still hits
+    path = getattr(config, "machine_model_file", None)
+    if path:
+        try:
+            with open(path, "rb") as f:
+                sig["machine_model_file"] = hashlib.sha256(
+                    f.read()).hexdigest()
+        except OSError:
+            sig["machine_model_file"] = f"unreadable:{path}"
     from .substitution import _JSON_RULES
 
     if _JSON_RULES:
@@ -277,7 +298,7 @@ def strategy_cache_key(layers, input_tensors, machine, config,
         # plans are only as good as the pricing that selected them: a
         # retuned cost model (bumped COST_MODEL_VERSION) re-searches
         # instead of serving plans chosen under the old model forever
-        "cost_model": COST_MODEL_VERSION,
+        "cost_model": COST_MODEL_VERSION,  # knobflow: schema-ok (key component, not a payload field: a bumped cost model re-ADDRESSES entries, so the forced miss IS the validation)
         "graph": graph_signature(layers, input_tensors, protected),
         "machine": machine_signature(machine),
         "config": config_signature(config, mesh_axes),

@@ -1,0 +1,537 @@
+"""Knob-flow auditor tests (tier-1 gate).
+
+Seeded fixtures trip each KNB0xx rule with the matching correct idiom
+as a negative control, pragma suppressions follow the shared
+reason-required grammar, the coverage-version hash the auditor derives
+from the AST equals the one the ledger stamps on records, and the repo
+itself sweeps clean — the ``make knob-lint`` gate, in-process. The
+mutation tests re-run the audit over the real package with one key
+entry deleted (``_SEARCH_KNOBS`` / ``_KNOB_FIELDS`` / a CLI flag
+branch) and assert the gate fires: every coverage fix this PR made is
+pinned by the deletion that would undo it."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from flexflow_tpu.analysis.concurrency_check import (Package,
+                                                     _scan_module,
+                                                     build_package)
+from flexflow_tpu.analysis.findings import ValidationReport
+from flexflow_tpu.analysis.knobflow_check import (DEFAULT_COMPILE_ROOTS,
+                                                  DEFAULT_PERF_ROOTS,
+                                                  _run, check_sources,
+                                                  cohort_cover_hash)
+
+PKG = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "flexflow_tpu")
+ROOT = os.path.dirname(PKG)
+
+# ------------------------------------------------------------ fixtures
+# A miniature package exercising every surface the auditor reads: a
+# config dataclass + parse_args, a strategy-cache module (knob tuple,
+# stamp function with a conditional stamp, schema constant + reader),
+# a ledger module (cohort tuple + context builder), a compile root and
+# a perf root. The baseline is CLEAN; each test mutates one string to
+# trip exactly one rule.
+_CONFIG = textwrap.dedent("""
+    from dataclasses import dataclass
+
+
+    @dataclass
+    class FFConfig:
+        alpha: int = 1
+        beta: int = 2
+        gamma: int = 3
+        delta: int = 4
+        mode: str = "off"
+
+        @staticmethod
+        def parse_args(argv):
+            cfg = FFConfig()
+            i = 0
+            while i < len(argv):
+                a = argv[i]
+                if a == "--alpha":
+                    cfg.alpha = int(argv[i + 1])
+                elif a == "--beta":
+                    cfg.beta = int(argv[i + 1])
+                elif a == "--gamma":
+                    cfg.gamma = int(argv[i + 1])
+                elif a == "--delta":
+                    cfg.delta = int(argv[i + 1])
+                elif a == "--mode":
+                    cfg.mode = argv[i + 1]
+                i += 2
+            return cfg
+""")
+
+_CACHE = textwrap.dedent("""
+    REC_SCHEMA = 1
+
+    _SEARCH_KNOBS = (
+        "alpha",
+        "gamma",
+        "mode",
+    )
+
+
+    def config_signature(config):
+        sig = {k: getattr(config, k, None) for k in _SEARCH_KNOBS}
+        sig["schema"] = REC_SCHEMA
+        if config.mode != "off":
+            sig["beta"] = config.beta
+        return sig
+
+
+    def load_signature(doc):
+        if doc.get("schema") != REC_SCHEMA:
+            return None
+        return doc
+""")
+
+_LEDGER = textwrap.dedent("""
+    _KNOB_FIELDS = (
+        "alpha",
+        "delta",
+    )
+
+
+    def model_context(config):
+        return {k: getattr(config, k, None) for k in _KNOB_FIELDS}
+""")
+
+_COMPILER = textwrap.dedent("""
+    from .cache import config_signature
+
+
+    def build(config):
+        plan = config_signature(config)
+        return plan, config.alpha, config.gamma
+
+
+    def lower(config):
+        if config.mode != "off":
+            return config.beta
+        return 0
+""")
+
+_SERVE = textwrap.dedent("""
+    def step(config):
+        return config.delta + config.alpha
+""")
+
+
+def _files():
+    return {"config.py": _CONFIG, "cache.py": _CACHE,
+            "ledger.py": _LEDGER, "compiler.py": _COMPILER,
+            "serve.py": _SERVE}
+
+
+def _findings(files):
+    return check_sources(files, compile_roots=("compiler.py::",),
+                         perf_roots=("serve.py::",))
+
+
+def _codes(files):
+    return [f.code for f in _findings(files)]
+
+
+def _mut(files, rel, old, new):
+    assert old in files[rel], f"fixture drift: {old!r} not in {rel}"
+    out = dict(files)
+    out[rel] = files[rel].replace(old, new)
+    return out
+
+
+# ------------------------------------------------------- clean baseline
+def test_clean_fixture_baseline():
+    """Every knob keyed, cohorted, flagged and read; schema compared;
+    guarded stamp read under the same guard — the auditor must stay
+    silent."""
+    findings = _findings(_files())
+    assert findings == [], [f.format() for f in findings]
+
+
+def test_syntax_error_module_reports_knb000():
+    codes = [f.code for f in check_sources({"broken.py": "def oops(:\n"})]
+    assert codes == ["KNB000"]
+
+
+# ------------------------------------------- KNB001 unkeyed compile knob
+def test_unkeyed_compile_knob_fires_knb001():
+    files = _mut(_files(), "cache.py", '    "gamma",\n', "")
+    findings = _findings(files)
+    assert [f.code for f in findings] == ["KNB001"], \
+        [f.format() for f in findings]
+    f = findings[0]
+    # the finding lands on the config FIELD line (where the pragma
+    # would live), names the knob and the read site, and is an error
+    assert f.severity == "error" and f.file == "config.py"
+    assert "gamma" in f.format() and "compiler.py" in f.format()
+
+
+def test_key_ok_pragma_with_reason_suppresses_knb001():
+    files = _mut(_files(), "cache.py", '    "gamma",\n', "")
+    files = _mut(files, "config.py", "gamma: int = 3",
+                 "gamma: int = 3  # knobflow: key-ok (fixture: priced "
+                 "into the plan content hash)")
+    assert _codes(files) == []
+
+
+def test_reasonless_pragma_does_not_suppress():
+    files = _mut(_files(), "cache.py", '    "gamma",\n', "")
+    files = _mut(files, "config.py", "gamma: int = 3",
+                 "gamma: int = 3  # knobflow: key-ok")
+    assert "KNB001" in _codes(files)
+
+
+# ------------------------------------------ KNB002 uncohorted perf knob
+def test_uncohorted_perf_knob_fires_knb002():
+    files = _mut(_files(), "ledger.py", '    "delta",\n', "")
+    findings = _findings(files)
+    assert [f.code for f in findings] == ["KNB002"], \
+        [f.format() for f in findings]
+    f = findings[0]
+    assert f.severity == "warning" and f.file == "config.py"
+    assert "delta" in f.format() and "serve.py" in f.format()
+
+
+def test_compile_side_reads_stay_knb001_jurisdiction():
+    """A compile-path knob missing from the COHORT key is not KNB002's
+    business — the plan signature already captures it. Deleting gamma
+    from the cohort tuple (it was never there) changes nothing; only
+    the search-key deletion fires, and fires KNB001."""
+    files = _mut(_files(), "cache.py", '    "gamma",\n', "")
+    assert "KNB002" not in _codes(files)
+
+
+def test_cohort_ok_pragma_with_reason_suppresses_knb002():
+    files = _mut(_files(), "ledger.py", '    "delta",\n', "")
+    files = _mut(files, "config.py", "delta: int = 4",
+                 "delta: int = 4  # knobflow: cohort-ok (fixture: "
+                 "display-only switch)")
+    assert _codes(files) == []
+
+
+# --------------------------------------------------- KNB003 dead knob
+def test_dead_knob_fires_knb003():
+    files = _mut(_files(), "config.py", "mode: str = \"off\"",
+                 "mode: str = \"off\"\n    unused: int = 0")
+    files = _mut(files, "config.py",
+                 "            elif a == \"--mode\":",
+                 "            elif a == \"--unused\":\n"
+                 "                cfg.unused = int(argv[i + 1])\n"
+                 "            elif a == \"--mode\":")
+    findings = _findings(files)
+    dead = [f for f in findings if f.code == "KNB003"]
+    assert dead and "unused" in dead[0].format(), \
+        [f.format() for f in findings]
+    assert dead[0].severity == "warning"
+
+
+def test_dead_ok_pragma_with_reason_suppresses_knb003():
+    files = _mut(_files(), "config.py", "mode: str = \"off\"",
+                 "mode: str = \"off\"\n    unused: int = 0  "
+                 "# knobflow: dead-ok (fixture: reserved field) "
+                 "# knobflow: flag-ok (fixture: reserved field)")
+    assert _codes(files) == []
+
+
+# ---------------------------------------------- KNB004 CLI-flag parity
+def test_missing_flag_fires_knb004():
+    files = _mut(_files(), "config.py",
+                 "            elif a == \"--gamma\":\n"
+                 "                cfg.gamma = int(argv[i + 1])\n",
+                 "")
+    findings = _findings(files)
+    drift = [f for f in findings if f.code == "KNB004"]
+    assert drift and "gamma" in drift[0].format(), \
+        [f.format() for f in findings]
+    assert drift[0].severity == "warning"
+
+
+def test_unknown_field_assign_fires_knb004_error():
+    files = _mut(_files(), "config.py", "cfg.gamma = int",
+                 "cfg.gama = int")
+    findings = [f for f in _findings(files) if f.code == "KNB004"]
+    assert any(f.severity == "error" and "gama" in f.format()
+               for f in findings), [f.format() for f in findings]
+
+
+# ------------------------------------- KNB005 unvalidated schema bump
+def test_unvalidated_schema_constant_fires_knb005():
+    files = _mut(_files(), "cache.py",
+                 "    if doc.get(\"schema\") != REC_SCHEMA:\n"
+                 "        return None\n", "")
+    findings = _findings(files)
+    assert [f.code for f in findings] == ["KNB005"], \
+        [f.format() for f in findings]
+    f = findings[0]
+    # anchored at the WRITER line in the serializer module
+    assert f.severity == "error" and f.file == "cache.py"
+    assert "REC_SCHEMA" in f.format()
+
+
+def test_schema_ok_pragma_with_reason_suppresses_knb005():
+    files = _mut(_files(), "cache.py",
+                 "    if doc.get(\"schema\") != REC_SCHEMA:\n"
+                 "        return None\n", "")
+    files = _mut(files, "cache.py", 'sig["schema"] = REC_SCHEMA',
+                 'sig["schema"] = REC_SCHEMA  # knobflow: schema-ok '
+                 "(fixture: key component, miss IS the validation)")
+    assert _codes(files) == []
+
+
+# --------------------------------------- KNB006 guard-asymmetric read
+def test_guard_asymmetric_read_fires_knb006():
+    """beta is stamped only under the ``mode`` guard; dropping the
+    guard from the compile-path read means beta can steer the plan
+    while the key omits it."""
+    files = _mut(_files(), "compiler.py",
+                 "    if config.mode != \"off\":\n"
+                 "        return config.beta\n"
+                 "    return 0\n",
+                 "    return config.beta\n")
+    findings = _findings(files)
+    assert [f.code for f in findings] == ["KNB006"], \
+        [f.format() for f in findings]
+    f = findings[0]
+    # anchored at the READ site, names the guard knob, compile = error
+    assert f.severity == "error" and f.file == "compiler.py"
+    assert "beta" in f.format() and "mode" in f.format()
+
+
+def test_guard_ok_pragma_with_reason_suppresses_knb006():
+    files = _mut(_files(), "compiler.py",
+                 "    if config.mode != \"off\":\n"
+                 "        return config.beta\n"
+                 "    return 0\n",
+                 "    return config.beta  # knobflow: guard-ok "
+                 "(fixture: value inert when mode is off)\n")
+    assert _codes(files) == []
+
+
+# ------------------------------------------------- repo stays clean
+@pytest.fixture(scope="module")
+def repo_pkg():
+    return build_package([PKG])
+
+
+@pytest.fixture(scope="module")
+def repo_report(repo_pkg):
+    # one shared scan: the clean-sweep report reuses the package build
+    # the mutation tests below re-audit (the scan dominates the cost)
+    from flexflow_tpu.analysis.knobflow_check import _scan_light
+
+    extras = [_scan_light(os.path.join(ROOT, d))
+              for d in ("tools", "examples", "scripts")
+              if os.path.isdir(os.path.join(ROOT, d))]
+    report = ValidationReport(source=PKG, tag="knobflow")
+    _run(repo_pkg, extras, report, DEFAULT_COMPILE_ROOTS,
+         DEFAULT_PERF_ROOTS)
+    return report
+
+
+def test_repo_is_knobflow_clean(repo_report):
+    """The ``make knob-lint`` gate, in-process: zero findings over the
+    whole package. A new compile-determinant knob missing from the
+    strategy-cache key, a perf knob missing from the ledger cohort, or
+    an unvalidated schema constant fails tier-1 here."""
+    assert not repo_report.errors, \
+        "\n".join(f.format() for f in repo_report.errors)
+    assert not repo_report.warnings, \
+        "\n".join(f.format() for f in repo_report.warnings)
+    # every suppression that fired carries a reason (grammar-enforced)
+    assert getattr(repo_report, "suppressed", 0) > 0
+
+
+def test_repo_coverage_tables(repo_report):
+    """The PR's own key fixes stay pinned in the extracted coverage:
+    deleting any of these entries flips the matching mutation test
+    below AND empties this table."""
+    cov = repo_report.coverage
+    for knob in ("pipeline_remat", "grad_accum_steps",
+                 "computation_mode", "machine_model_file"):
+        assert knob in cov["search"], (knob, cov["search"])
+    for knob in ("pipeline_remat", "checkpoint_interval_steps",
+                 "serving_decode_slots", "serving_prefill_token_budget"):
+        assert knob in cov["cohort"], (knob, cov["cohort"])
+    # the conditional-stamp idiom is extracted, not hand-listed: the
+    # seq-group stamps are guarded on the seq_buckets mode knob
+    assert cov["conditional"].get("seq_bucket_max") == ["seq_buckets"]
+    assert len(repo_report.knobs) >= 80
+
+
+def test_cohort_cover_hash_matches_ledger(repo_report):
+    """The auditor's AST-derived coverage hash equals the value the
+    ledger stamps on every record — the contract that makes a
+    ``_KNOB_FIELDS`` widening split sentinel cohorts cleanly."""
+    from flexflow_tpu.obs import ledger
+
+    assert repo_report.coverage["cohort_cover_hash"] \
+        == ledger.knob_coverage_version()
+    assert ledger.knob_coverage_version() == cohort_cover_hash(
+        set(ledger._KNOB_FIELDS) | set(ledger._SERVING_KNOB_FIELDS))
+
+
+# ------------------------------------------- key-deletion regressions
+# The three deletions are independent (different modules, different
+# rules), so ONE re-audit of the real package with all three applied
+# covers all three regressions at a third of the scan cost.
+_REPO_MUTATIONS = (
+    ("search/cache.py", '    "pipeline_remat",\n', ""),
+    ("obs/ledger.py", ' "checkpoint_interval_steps"', ""),
+    ("config.py",
+     '            elif a == "--grad-accum-steps":\n'
+     "                cfg.grad_accum_steps = int(_next())\n", ""),
+)
+
+
+@pytest.fixture(scope="module")
+def mutated_findings(repo_pkg):
+    """Re-audit the real package with the key-entry deletions applied
+    in memory — the working tree is never touched."""
+    mods = {m.rel: m for m in repo_pkg.modules.values()}
+    for rel, old, new in _REPO_MUTATIONS:
+        with open(os.path.join(PKG, rel)) as fh:
+            src = fh.read()
+        assert old in src, \
+            f"mutation target drifted: {old!r} not in {rel}"
+        mod = _scan_module(rel, "", src.replace(old, new, 1))
+        assert mod is not None
+        mods[rel] = mod
+    report = ValidationReport(source=PKG, tag="knobflow")
+    _run(Package(list(mods.values())), [], report,
+         DEFAULT_COMPILE_ROOTS, DEFAULT_PERF_ROOTS)
+    return report.findings
+
+
+def test_deleting_search_knob_fires_knb001(mutated_findings):
+    """Regression lock on the PR's KNB001 fix: remove pipeline_remat
+    from ``_SEARCH_KNOBS`` and the gate must fire again — a cached
+    plan priced with remat on would silently replay with it off."""
+    hits = [f for f in mutated_findings
+            if f.code == "KNB001" and "pipeline_remat" in f.format()]
+    assert hits and hits[0].severity == "error", \
+        [f.format() for f in mutated_findings]
+
+
+def test_deleting_cohort_knob_fires_knb002(mutated_findings):
+    """Regression lock on the PR's KNB002 fix: remove
+    checkpoint_interval_steps from ``_KNOB_FIELDS`` and the gate must
+    fire — the sentinel would compare step times across different
+    checkpoint cadences."""
+    hits = [f for f in mutated_findings
+            if f.code == "KNB002"
+            and "checkpoint_interval_steps" in f.format()]
+    assert hits and hits[0].severity == "warning", \
+        [f.format() for f in mutated_findings]
+
+
+def test_deleting_cli_flag_fires_knb004(mutated_findings):
+    """Regression lock on flag/field parity: drop the
+    ``--grad-accum-steps`` branch from parse_args and the gate must
+    flag the orphaned field."""
+    hits = [f for f in mutated_findings
+            if f.code == "KNB004" and "grad_accum_steps" in f.format()]
+    assert hits, [f.format() for f in mutated_findings]
+
+
+# --------------------------------------- ledger/sentinel cohort split
+def test_cohort_key_splits_on_coverage_hash():
+    from flexflow_tpu.obs.ledger import cohort_key
+
+    base = {"kind": "fit", "perf": {"metric": "step_time_s",
+                                    "value": 1.0},
+            "knobs": {"batch_size": 64}}
+    old = dict(base, knobs_cover="deadbeef")
+    new = dict(base, knobs_cover="451c9d16")
+    assert cohort_key(old) != cohort_key(new)
+    assert cohort_key(old) == cohort_key(dict(old))
+    # pre-coverage records (no stamp) form their own cohort too
+    assert cohort_key(base) != cohort_key(new)
+
+
+def test_serving_knob_context_covers_every_serving_field():
+    from flexflow_tpu.config import FFConfig
+    from flexflow_tpu.obs import ledger
+
+    ctx = ledger.serving_knob_context(FFConfig())
+    assert set(ctx) == set(ledger._SERVING_KNOB_FIELDS)
+    assert ctx["serving_decode_slots"] is not None
+
+
+def test_sentinel_cohort_row_carries_knobs_cover():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "perf_sentinel", os.path.join(ROOT, "tools",
+                                      "perf_sentinel.py"))
+    sentinel = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(sentinel)
+    runs = [{"kind": "fit", "run_id": "a", "ts_unix_s": 1,
+             "knobs_cover": "451c9d16",
+             "perf": {"metric": "step_time_s", "value": 1.0}}]
+    row = sentinel._judge_cohort("k", runs, margin=0.5, min_baseline=2)
+    assert row["knobs_cover"] == "451c9d16"
+
+
+# ------------------------------------------------------------- tooling
+def test_make_ci_runs_knob_lint():
+    mk = open(os.path.join(ROOT, "Makefile")).read()
+    assert "\nknob-lint:" in mk
+    ci_line = next(l for l in mk.splitlines() if l.startswith("ci:"))
+    assert "knob-lint" in ci_line
+
+
+def test_knob_lint_tool_emits_one_json_line(tmp_path):
+    out = tmp_path / "knb.json"
+    tool = os.path.join(ROOT, "tools", "knob_lint.py")
+    r = subprocess.run(
+        [sys.executable, tool, PKG, "--out", str(out)],
+        capture_output=True, text=True, timeout=240)
+    assert r.returncode == 0, r.stdout + r.stderr
+    lines = r.stdout.strip().splitlines()
+    assert len(lines) == 1, r.stdout
+    doc = json.loads(lines[0])
+    assert doc["exit"] == 0 and doc["errors"] == 0
+    assert doc["reasonless"] == [] and doc["suppressed"] > 0
+    assert doc["knobs"] >= 80
+    assert "KNB001" in doc["codes"] and "KNB006" in doc["codes"]
+    assert doc["coverage"]["cohort_cover_hash"]
+    assert doc["runtime_s"] > 0
+    assert json.loads(out.read_text())["exit"] == 0
+
+
+def test_reasonless_pragma_fails_the_tool_gate(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("AUDIT_FLAG = 1  # knobflow: key-ok\n")
+    tool = os.path.join(ROOT, "tools", "knob_lint.py")
+    r = subprocess.run(
+        [sys.executable, tool, str(bad)],
+        capture_output=True, text=True, timeout=240)
+    assert r.returncode == 1, r.stdout + r.stderr
+    doc = json.loads(r.stdout.strip().splitlines()[-1])
+    assert doc["reasonless"], doc
+
+
+# --------------------------------------------------------- gate semantics
+def test_report_error_class_and_tag(repo_report):
+    from flexflow_tpu.analysis.findings import KnobFlowAuditError
+
+    assert repo_report.tag == "knobflow"
+    assert check_sources({"empty.py": "X = 1\n"}) == []
+    report = ValidationReport(source="x", tag="knobflow")
+    report.add("KNB001", "synthetic", severity="error", file="x.py",
+               line=1)
+    try:
+        report.handle("error")
+    except KnobFlowAuditError as e:
+        assert "KNB001" in str(e)
+    else:
+        raise AssertionError("handle('error') did not raise")

@@ -114,6 +114,11 @@ def _judge_cohort(key: str, runs: List[Dict], margin: float,
         "runs": len(runs),
         "newest": float(perf["value"]),
         "newest_run_id": newest.get("run_id"),
+        # the knob-field coverage version the cohort was stamped under
+        # (ledger.knob_coverage_version, keyed by cohort_key): a
+        # _KNOB_FIELDS widening shows up HERE as a fresh-hash cohort
+        # starting its own baseline, not as old-key vs new-key ratios
+        "knobs_cover": newest.get("knobs_cover"),
         # the attribution engine's phase verdict for the newest run: a
         # regression row NAMES its suspect (input_wait = feed problem,
         # collective_transfer = comm problem, ...) instead of just a
