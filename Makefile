@@ -182,10 +182,16 @@ serve-bench:
 
 # continuous-batching guard: continuous must strictly beat static on
 # tokens/s over the seeded heterogeneous open-arrival trace, with one
-# decode dispatch per step regardless of active-request count
+# decode dispatch per step regardless of active-request count; then the
+# two composable speed paths — speculation must strictly win tokens/s
+# with bit-identical greedy outputs, and int8 paged KV must double
+# admissible concurrency at equal pool bytes inside the divergence
+# budget
 serve-bench-smoke:
 	$(CPU_MESH) $(PY) tools/serve_bench.py --smoke
 	$(CPU_MESH) $(PY) tools/serve_bench.py --smoke --trace longtail
+	$(CPU_MESH) $(PY) tools/serve_bench.py --smoke --spec
+	$(CPU_MESH) $(PY) tools/serve_bench.py --smoke --kv-dtype int8
 
 obs-report:
 	$(CPU_MESH) $(PY) tools/obs_report.py

@@ -194,6 +194,12 @@ CODE_CATALOG: Dict[str, str] = {
     "HOT002": "device work (jax call) on an input-pipeline worker thread",
     "HOT003": "shared-state mutation in a worker thread without "
               "lock/queue discipline",
+    # serving KV quantization gate (serving/generation.py PagedDecoder
+    # calibration)
+    "KVQ001": "quantized KV pool calibration divergence exceeds "
+              "serving_kv_divergence_budget — decoder fell back to "
+              "float32 arenas (loud: stderr + "
+              "serving.kv_dtype_fallbacks counter)",
 }
 
 _SEVERITIES = ("error", "warning", "info")

@@ -313,6 +313,23 @@ class FFConfig:
     # 0 (default) = one prompt per prefill dispatch, the historical
     # behavior.
     serving_prefill_token_budget: int = 0
+    # speculative decoding: a draft model proposes serving_spec_k tokens
+    # per live slot and the target verifies all k+1 positions in ONE
+    # paged-attention dispatch (the verify IS the decode dispatch).
+    # serving_draft_model picks the draft: "self:N" (the target's first
+    # N blocks with copied weights) or "gpt:layers=..,hidden=..,heads=.."
+    # (fresh random draft at the target's vocab). 0 / "" = speculation
+    # off, the historical one-token decode.
+    serving_draft_model: str = ""
+    serving_spec_k: int = 0
+    # quantized paged KV arenas: "float32" (historical), "bfloat16", or
+    # "int8" (per-token per-head scale/zero sidecars, dequantize inside
+    # the dispatch). Halving/quartering pool bytes doubles worst-case
+    # admission at fixed memory; gated at Generator construction by the
+    # calibration divergence budget below (KVQ001 fallback to float32
+    # when exceeded; 0.0 = the built-in default budget).
+    serving_kv_dtype: str = "float32"
+    serving_kv_divergence_budget: float = 0.0
     # numerics
     computation_mode: CompMode = CompMode.TRAINING  # knobflow: flag-ok (CompMode enum set by the serving entry points, not a CLI scalar)
     # mixed precision: "bfloat16" runs activations/matmuls in bf16 on the
@@ -605,6 +622,14 @@ class FFConfig:
                 cfg.serving_max_prefills_per_step = int(_next())
             elif a == "--serving-prefill-token-budget":
                 cfg.serving_prefill_token_budget = int(_next())
+            elif a == "--serving-draft-model":
+                cfg.serving_draft_model = _next()
+            elif a == "--serving-spec-k":
+                cfg.serving_spec_k = int(_next())
+            elif a == "--serving-kv-dtype":
+                cfg.serving_kv_dtype = _next()
+            elif a == "--serving-kv-divergence-budget":
+                cfg.serving_kv_divergence_budget = float(_next())
             elif a == "--seq-buckets":
                 cfg.seq_buckets = _next()
             elif a == "--seq-bucket-min":

@@ -72,7 +72,7 @@ RULE_FAMILIES: Dict[str, tuple] = {
     # serving phases (continuous-batching session records)
     "queue_wait": ("decode_slots", "kv_pool"),
     "prefill": ("prefill_interleave",),
-    "decode": ("block_size",),
+    "decode": ("block_size", "speculation"),
 }
 
 REQUIRED_SUGGESTION_KEYS = (
@@ -320,7 +320,22 @@ def _serving_phase_means(rec: Dict) -> Dict[str, float]:
     return out
 
 
-def _serving_suggestions(rec: Dict) -> List[Dict]:
+def _prior_spec_accept_rate(priors) -> Optional[float]:
+    """Newest measured acceptance rate from prior serving records that
+    ran WITH speculation — the spec_k rule's measured-pricing source."""
+    best_ts, best = -1.0, None
+    for r in priors or []:
+        spec = (r or {}).get("spec") or {}
+        rate = spec.get("accept_rate")
+        if not isinstance(rate, (int, float)):
+            continue
+        ts = float(r.get("ts_unix_s") or 0.0)
+        if ts >= best_ts:
+            best_ts, best = ts, float(rate)
+    return best
+
+
+def _serving_suggestions(rec: Dict, priors=None) -> List[Dict]:
     means = _serving_phase_means(rec)
     if not means:
         return []
@@ -330,6 +345,8 @@ def _serving_suggestions(rec: Dict) -> List[Dict]:
     bsz = int(knobs.get("block_size") or 0)
     mpps = int(knobs.get("max_prefills_per_step") or 1)
     kv = rec.get("kv") or {}
+    kv_dtype = str(kv.get("kv_dtype") or knobs.get("kv_dtype")
+                   or "float32")
     out: List[Dict] = []
     s = means.get("queue_wait", 0.0)
     if s > 0 and slots:
@@ -344,13 +361,30 @@ def _serving_suggestions(rec: Dict) -> List[Dict]:
         cap = kv.get("capacity_blocks")
         if (isinstance(hw, (int, float)) and isinstance(cap, (int, float))
                 and cap and hw >= cap):
-            nb = int(knobs.get("num_blocks") or cap)
-            out.append(_sug(
-                "queue_wait", "kv_pool", "num_blocks", nb, nb * 2,
-                {"num_blocks": nb * 2}, 0.25 * s, total, "modeled",
-                "PagedKVPool high-water vs capacity",
-                f"the paged pool hit its capacity ({hw}/{cap} blocks); "
-                f"admission stalls on block reservations, not slots"))
+            if kv_dtype == "float32":
+                # dtype-aware: int8 arenas free ~half the pool bytes at
+                # the SAME memory bill — suggest quantizing before
+                # suggesting the pool grow (num_blocks*2 doubles bytes;
+                # int8 doubles admission for free, divergence-gated)
+                out.append(_sug(
+                    "queue_wait", "kv_pool", "serving_kv_dtype",
+                    "float32", "int8", {"serving_kv_dtype": "int8"},
+                    0.25 * s, total, "modeled",
+                    "PagedKVPool high-water vs capacity (dtype-aware)",
+                    f"the paged pool hit its capacity ({hw}/{cap} "
+                    f"blocks); int8 KV arenas halve pool bytes so the "
+                    f"same memory admits ~2x the blocks "
+                    f"(serving_kv_divergence_budget gates fidelity)"))
+            else:
+                nb = int(knobs.get("num_blocks") or cap)
+                out.append(_sug(
+                    "queue_wait", "kv_pool", "num_blocks", nb, nb * 2,
+                    {"num_blocks": nb * 2}, 0.25 * s, total, "modeled",
+                    "PagedKVPool high-water vs capacity",
+                    f"the paged pool hit its capacity ({hw}/{cap} "
+                    f"blocks) with kv_dtype={kv_dtype} already "
+                    f"quantized; admission stalls on block "
+                    f"reservations, not slots"))
     s = means.get("prefill", 0.0)
     proposed_mpps = min(max(2, mpps * 2), max(slots, 2))
     if s > 0 and slots and proposed_mpps > mpps:
@@ -373,6 +407,31 @@ def _serving_suggestions(rec: Dict) -> List[Dict]:
             f"decode gathers over per-request block tables; doubling "
             f"the block size to {bsz * 2} halves the table length per "
             f"request (coarser pool granularity is the trade)"))
+    spec_on = bool(knobs.get("spec_k")) or bool(rec.get("spec"))
+    dominant = max(means, key=lambda n: means[n]) if means else None
+    if s > 0 and dominant == "decode" and not spec_on:
+        # decode-dominant and speculation off: one verify dispatch
+        # retires up to k+1 tokens, so decode wall time shrinks by
+        # ~(1 - 1/(1 + alpha*k)) at acceptance rate alpha. Price with
+        # the MEASURED acceptance when a prior spec record exists;
+        # otherwise model a mid-range draft (alpha=0.6).
+        k = 4
+        alpha = _prior_spec_accept_rate(priors)
+        if alpha is not None:
+            basis, priced_by = "measured", (
+                "prior serving record's spec.accept_rate")
+        else:
+            alpha, basis, priced_by = 0.6, "modeled", (
+                "modeled draft acceptance (no prior spec record)")
+        out.append(_sug(
+            "decode", "speculation", "serving_spec_k", 0, k,
+            {"serving_spec_k": k}, s * (1.0 - 1.0 / (1.0 + alpha * k)),
+            total, basis, priced_by,
+            f"decode dominates and speculation is off; a draft "
+            f"proposing k={k} tokens per slot verified in ONE paged "
+            f"dispatch retires ~{1 + alpha * k:.1f} tokens per step "
+            f"at acceptance rate {alpha:.2f} (requires "
+            f"serving_draft_model)"))
     return out
 
 
@@ -388,16 +447,19 @@ def _rank(sugs: List[Dict], k: int) -> List[Dict]:
 
 
 def advise_record(rec: Dict,
-                  max_suggestions: int = DEFAULT_MAX_SUGGESTIONS
-                  ) -> Optional[Dict]:
+                  max_suggestions: int = DEFAULT_MAX_SUGGESTIONS,
+                  priors=None) -> Optional[Dict]:
     """Build one advisor report for a ledger record (or an equivalent
     in-process dict). Fit/eval records need an ``attribution`` block,
     serving records a ``phases`` percentile table; anything else (bench
     records, classic serving) returns None — there is no phase verdict
-    to act on."""
+    to act on. ``priors`` (optional list of earlier ledger records)
+    upgrades modeled pricing to measured where a prior run measured the
+    quantity — e.g. the spec_k rule prices with a prior record's
+    ``spec.accept_rate``."""
     kind = rec.get("kind")
     if kind == "serving" or rec.get("serving_engine") == "continuous":
-        sugs = _serving_suggestions(rec)
+        sugs = _serving_suggestions(rec, priors=priors)
         if not sugs:
             return None
         means = _serving_phase_means(rec)

@@ -270,6 +270,28 @@ def init_params(
 _FULL_PRECISION_PARAM_OPS = frozenset({OpType.BATCHNORM})
 
 
+def causal_lm_signature(cm: CompiledModel) -> Dict[str, Optional[int]]:
+    """The serving tokenizer/vocab contract of a compiled causal LM:
+    vocab size (the logits tensor's trailing dim) and position capacity
+    (the position-embedding table's ``num_entries``, None when the
+    graph has no position embedding).
+
+    This is the draft-model compile seam for speculative decoding: a
+    draft proposes token ids the TARGET must be able to verify, so the
+    two models must agree on vocab exactly and the draft must cover the
+    serving ``max_length`` — validated once here at registration, never
+    per dispatch."""
+    vocab = int(cm.logits_tensor.dims[-1])
+    max_positions: Optional[int] = None
+    if len(cm.input_tensors) >= 2:
+        pos_tid = cm.input_tensors[1].tensor_id
+        for op in cm.ops:
+            if (op.op_type is OpType.EMBEDDING
+                    and op.layer.inputs[0].tensor_id == pos_tid):
+                max_positions = int(op.attrs["num_entries"])
+    return {"vocab_size": vocab, "max_positions": max_positions}
+
+
 def _resolve_compute_dtype(name: Optional[str]):
     if name in (None, "float32", "fp32", "f32"):
         return None

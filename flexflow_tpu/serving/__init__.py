@@ -26,12 +26,13 @@ simplification), and the pieces that remain are the serving-specific ones:
 from .engine import (DeadlineExceeded, GenerationInstance, InferenceEngine,
                      InferenceRequest, ModelInstance, ShedError)
 from .errors import KVPoolExhausted
-from .generation import Generator, PagedDecoder, sample_next_token
-from .kv_cache import PagedKVPool
+from .generation import (Generator, PagedDecoder, build_draft_model,
+                         sample_next_token)
+from .kv_cache import KV_DTYPES, PagedKVPool
 from .scheduler import ContinuousBatchingScheduler, GenerationRequest
 
 __all__ = ["ContinuousBatchingScheduler", "DeadlineExceeded",
            "GenerationInstance", "GenerationRequest", "Generator",
            "InferenceEngine", "InferenceRequest", "KVPoolExhausted",
-           "ModelInstance", "PagedDecoder", "PagedKVPool", "ShedError",
-           "sample_next_token"]
+           "KV_DTYPES", "ModelInstance", "PagedDecoder", "PagedKVPool",
+           "ShedError", "build_draft_model", "sample_next_token"]

@@ -268,7 +268,13 @@ class GenerationInstance:
                 cfg, "serving_max_prefills_per_step", 1),
             "prefill_token_budget": getattr(
                 cfg, "serving_prefill_token_budget", 0),
+            "spec_k": getattr(cfg, "serving_spec_k", 0),
+            "kv_dtype": getattr(cfg, "serving_kv_dtype", "float32")
+            or "float32",
         }
+        budget = getattr(cfg, "serving_kv_divergence_budget", 0.0)
+        if budget:
+            defaults["kv_divergence_budget"] = float(budget)
         num_blocks = getattr(cfg, "serving_num_blocks", 0)
         if num_blocks:
             defaults["num_blocks"] = int(num_blocks)
@@ -280,6 +286,20 @@ class GenerationInstance:
             defaults["prefill_buckets"] = [
                 int(x) for x in str(buckets).split(",") if x.strip()]
         defaults.update(scheduler_kw)
+        # the draft registers ALONGSIDE the target: an explicit
+        # draft_ff keyword wins; otherwise a non-empty
+        # serving_draft_model spec ("self:N" / "gpt:...") builds one
+        # sharing the target's vocab/position contract. Either path
+        # accepts a spec STRING (resolved here) or an already-built
+        # model. spec_k without a draft fails loudly in the scheduler.
+        if (defaults.get("spec_k", 0) and "draft_ff" not in defaults
+                and getattr(cfg, "serving_draft_model", "")):
+            defaults["draft_ff"] = str(cfg.serving_draft_model)
+        if isinstance(defaults.get("draft_ff"), str):
+            from .generation import build_draft_model
+
+            defaults["draft_ff"] = build_draft_model(
+                ff, defaults["draft_ff"])
         self.name = name
         self._ff = ff
         self.scheduler = ContinuousBatchingScheduler(ff, name=name,

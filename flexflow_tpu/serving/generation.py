@@ -90,21 +90,88 @@ def _attn_with_cache(op, weights, x, kcache, vcache, offset):
     return out, kcache, vcache
 
 
-def _attn_with_paged_cache(op, weights, x, kpool, vpool, tables, seq_lens):
-    """One-token causal self-attention through a paged KV pool.
+def _quant_rows(x):
+    """Asymmetric int8 per-(token, head) quantization over head_dim.
+    ``x``: (T, H, D) -> (q int8, scale f32 (T, H), zero f32 (T, H)).
+    Zero-point at the range midpoint, scale spanning [-127, 127], so
+    dequantization is ``q * scale + zero``."""
+    x = x.astype(jnp.float32)
+    hi = x.max(-1)
+    lo = x.min(-1)
+    zero = 0.5 * (hi + lo)
+    scale = jnp.maximum((hi - lo) / 254.0, 1e-8)
+    q = jnp.clip(jnp.round((x - zero[..., None]) / scale[..., None]),
+                 -127, 127)
+    return q.astype(jnp.int8), scale, zero
 
-    ``x``: (n, 1, E) — one new token per decode slot. ``kpool``/``vpool``:
-    (num_blocks, block_size, H, D) arenas. ``tables``: (n, max_blocks)
-    int32 per-slot block tables. ``seq_lens``: (n,) int32 — tokens
-    already cached per slot, i.e. the new token's absolute position.
 
-    Writes the new K/V at each slot's position (inactive slots, whose
-    tables are all :data:`~flexflow_tpu.serving.kv_cache.NULL_BLOCK`,
-    write into the null block — harmless by construction), then gathers
-    each slot's logical ``(max_blocks*block_size)`` cache view through
-    its table and masks by position exactly like the dense path — so a
-    slot's output is bit-identical to the dense cache decode at the same
-    position.
+def _entry_write(entry, flat, kh, vh):
+    """Scatter T new K/V rows (``kh``/``vh``: (T, H, D)) into a pool
+    arena entry at flat token slots ``flat`` (T,), quantizing when the
+    entry is an int8 6-tuple (values + scale/zero sidecars share the
+    same flat addressing). Returns the updated entry."""
+    if len(entry) == 2:
+        k, v = entry
+        nb, bs, h, d = k.shape
+        kf = k.reshape(nb * bs, h, d).at[flat].set(kh.astype(k.dtype))
+        vf = v.reshape(nb * bs, h, d).at[flat].set(vh.astype(v.dtype))
+        return (kf.reshape(k.shape), vf.reshape(v.shape))
+    kq, vq, ks, kz, vs, vz = entry
+    nb, bs, h, d = kq.shape
+    qk, sk, zk = _quant_rows(kh)
+    qv, sv, zv = _quant_rows(vh)
+    return (
+        kq.reshape(nb * bs, h, d).at[flat].set(qk).reshape(kq.shape),
+        vq.reshape(nb * bs, h, d).at[flat].set(qv).reshape(vq.shape),
+        ks.reshape(nb * bs, h).at[flat].set(sk).reshape(ks.shape),
+        kz.reshape(nb * bs, h).at[flat].set(zk).reshape(kz.shape),
+        vs.reshape(nb * bs, h).at[flat].set(sv).reshape(vs.shape),
+        vz.reshape(nb * bs, h).at[flat].set(zv).reshape(vz.shape))
+
+
+def _entry_read(entry, tables):
+    """Gather each slot's logical (max_blocks*block_size, H, D) K/V
+    view through its block table, dequantizing int8 entries to f32
+    INSIDE the dispatch (the arena stays quantized; only the gathered
+    working set pays the f32 width)."""
+    n = tables.shape[0]
+    if len(entry) == 2:
+        k, v = entry
+        nb, bs, h, d = k.shape
+        return (k[tables].reshape(n, -1, h, d),
+                v[tables].reshape(n, -1, h, d))
+    kq, vq, ks, kz, vs, vz = entry
+    nb, bs, h, d = kq.shape
+    k = (kq[tables].reshape(n, -1, h, d).astype(jnp.float32)
+         * ks[tables].reshape(n, -1, h)[..., None]
+         + kz[tables].reshape(n, -1, h)[..., None])
+    v = (vq[tables].reshape(n, -1, h, d).astype(jnp.float32)
+         * vs[tables].reshape(n, -1, h)[..., None]
+         + vz[tables].reshape(n, -1, h)[..., None])
+    return k, v
+
+
+def _attn_with_paged_cache(op, weights, x, entry, tables, seq_lens):
+    """W-token causal self-attention through a paged KV pool.
+
+    ``x``: (n, W, E) — W new tokens per decode slot at absolute
+    positions ``seq_lens .. seq_lens + W - 1`` (W=1 is the plain decode
+    step; W=k+1 is the speculative verify window). ``entry``: the pool
+    arena entry for this op — (k, v) arenas, or the int8 6-tuple with
+    scale/zero sidecars. ``tables``: (n, max_blocks) int32 per-slot
+    block tables. ``seq_lens``: (n,) int32 — tokens already cached per
+    slot, i.e. the window's first absolute position.
+
+    Writes the W new K/V rows at each slot's positions (inactive slots,
+    whose tables are all :data:`~flexflow_tpu.serving.kv_cache
+    .NULL_BLOCK`, write into the null block — harmless by construction;
+    positions past the table's span are redirected there too), then
+    gathers each slot's logical ``(max_blocks*block_size)`` cache view
+    through its table and masks per query position exactly like the
+    dense path — so window position j's output is bit-identical to the
+    dense cache decode at absolute position ``seq_lens + j`` (the
+    window's own future K/V rows are masked to -1e30, where exp
+    underflows to exact 0.0).
     """
     qh = jnp.einsum("bse,ehd->bshd", x, weights["wq"])
     kh = jnp.einsum("bse,ehd->bshd", x, weights["wk"])
@@ -113,29 +180,34 @@ def _attn_with_paged_cache(op, weights, x, kpool, vpool, tables, seq_lens):
         qh = qh + weights["bq"]
         kh = kh + weights["bk"]
         vh = vh + weights["bv"]
-    nb, bs, heads, hdim = kpool.shape
-    n = x.shape[0]
-    blk = tables[jnp.arange(n), seq_lens // bs]                 # (n,)
-    flat = blk * bs + seq_lens % bs                             # (n,)
-    kflat = kpool.reshape(nb * bs, heads, hdim).at[flat].set(kh[:, 0])
-    vflat = vpool.reshape(nb * bs, heads, hdim).at[flat].set(vh[:, 0])
+    nb, bs, heads, hdim = entry[0].shape
+    n, w = x.shape[0], x.shape[1]
+    mb = tables.shape[1]
+    pos = seq_lens[:, None] + jax.lax.iota(jnp.int32, w)[None, :]  # (n, W)
+    blk = jnp.take_along_axis(tables, jnp.clip(pos // bs, 0, mb - 1),
+                              axis=1)                           # (n, W)
+    # positions past the table span (a verify window overrunning a
+    # request's worst case) land in the null block, never a clamped
+    # real block — by then the request has retired, so the rows are
+    # write-only garbage like every other masked lane
+    flat = jnp.where(pos < mb * bs, blk * bs + pos % bs,
+                     NULL_BLOCK * bs)                           # (n, W)
+    entry = _entry_write(entry, flat.reshape(-1),
+                         kh.reshape(n * w, heads, hdim),
+                         vh.reshape(n * w, heads, hdim))
     # gather each slot's logical view: (n, MB, BS, H, D) -> (n, L, H, D)
-    k = kflat.reshape(nb, bs, heads, hdim)[tables].reshape(
-        n, -1, heads, hdim)
-    v = vflat.reshape(nb, bs, heads, hdim)[tables].reshape(
-        n, -1, heads, hdim)
+    k, v = _entry_read(entry, tables)
     scale = 1.0 / math.sqrt(op.head_dim)
-    scores = jnp.einsum("bqhd,bkhd->bhqk", qh, k) * scale       # (n,H,1,L)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", qh, k) * scale       # (n,H,W,L)
     kpos = jax.lax.iota(jnp.int32, k.shape[1])                  # (L,)
-    mask = kpos[None, :] <= seq_lens[:, None]                   # (n, L)
-    scores = jnp.where(mask[:, None, None, :], scores, -1e30)
+    mask = kpos[None, None, :] <= pos[:, :, None]               # (n, W, L)
+    scores = jnp.where(mask[:, None, :, :], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
     ctxv = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
     out = jnp.einsum("bqhd,hde->bqe", ctxv, weights["wo"])
     if op.use_bias:
         out = out + weights["bo"]
-    return (out, kflat.reshape(nb, bs, heads, hdim),
-            vflat.reshape(nb, bs, heads, hdim))
+    return out, entry
 
 
 def sample_next_token(row_logits: np.ndarray, temperature: float,
@@ -538,7 +610,10 @@ class PagedDecoder(_DecodeGraph):
 
     def __init__(self, ff, max_length: int, *, decode_slots: int = 4,
                  block_size: int = 16, num_blocks: Optional[int] = None,
-                 prefill_buckets: Optional[Sequence[int]] = None):
+                 prefill_buckets: Optional[Sequence[int]] = None,
+                 kv_dtype: str = "float32",
+                 kv_divergence_budget: Optional[float] = None,
+                 calibrate: bool = True):
         super().__init__(ff, max_length)
         if decode_slots < 1:
             raise ValueError(f"decode_slots {decode_slots} < 1")
@@ -552,11 +627,13 @@ class PagedDecoder(_DecodeGraph):
             num_blocks = (self.decode_slots * self.max_blocks_per_request
                           + 1)
         dt = self._compute_dtype() or jnp.float32
+        self.kv_dtype = str(kv_dtype)
         self.pool = PagedKVPool(
             {op.name: (op.num_heads, op.head_dim)
              for op in self._attn_ops},
             num_blocks=int(num_blocks), block_size=self.block_size,
-            max_blocks_per_request=self.max_blocks_per_request, dtype=dt)
+            max_blocks_per_request=self.max_blocks_per_request, dtype=dt,
+            kv_dtype=self.kv_dtype)
         if prefill_buckets is None:
             prefill_buckets = default_prefill_buckets(self.max_length)
         self.prefill_buckets = sorted(
@@ -564,32 +641,71 @@ class PagedDecoder(_DecodeGraph):
         if self.prefill_buckets[-1] < self.max_length:
             self.prefill_buckets.append(self.max_length)
         self._decode = jax.jit(self._decode_step, donate_argnums=(2,))
+        # one verify executable per window width W=k+1 (spec_k is a
+        # session knob, so in practice this holds one entry)
+        self._verify_fns: Dict[int, object] = {}
         self._prefill_fns: Dict[Tuple[int, int], object] = {}
         self.decode_dispatches = 0
         self.decode_steps = 0
         self.audit_report = None
         self.exec_telemetry = None
+        # KVQ001 state: measured max-abs logit divergence of the
+        # quantized pool vs the f32 dense reference, and the loud
+        # fallback report when it exceeded the budget
+        self.kv_divergence: Optional[float] = None
+        self.kv_divergence_budget: Optional[float] = None
+        self.kv_quant_report = None
         self._maybe_audit()
+        if self.kv_dtype != "float32" and calibrate:
+            self._calibrate_kv_quant(kv_divergence_budget)
 
     # ---- compiled programs -------------------------------------------------
     def _decode_step(self, params, tokens, pool, tables, seq_lens):
         """One decode step for all slots: tokens (slots, 1) int32, pool
-        {op: (k, v)} donated, tables (slots, MB) int32, seq_lens (slots,)
-        int32. Returns ((slots, vocab) float32 logits, new pool)."""
+        {op: arena entry} donated, tables (slots, MB) int32, seq_lens
+        (slots,) int32. Returns ((slots, vocab) float32 logits, new
+        pool)."""
         positions = seq_lens[:, None]                           # (slots, 1)
         acts = {self._token_id.tensor_id: tokens,
                 self._pos_id.tensor_id: positions}
         new_pool = dict(pool)
 
         def attn(op, p, x):
-            k, v = new_pool[op.name]
-            out, k, v = _attn_with_paged_cache(op, p, x, k, v, tables,
-                                               seq_lens)
-            new_pool[op.name] = (k, v)
+            out, new_pool[op.name] = _attn_with_paged_cache(
+                op, p, x, new_pool[op.name], tables, seq_lens)
             return out
 
         logits = self._forward_block(params, acts, attn)
         return logits[:, -1, :], new_pool
+
+    def _verify_step(self, params, tokens, pool, tables, seq_lens):
+        """Speculative verify: tokens (slots, W) int32 — each slot's
+        last accepted token followed by W-1 draft proposals, at absolute
+        positions ``seq_lens .. seq_lens + W - 1``. Writes K/V for ALL
+        W positions through the block tables and returns the full
+        ((slots, W, vocab) float32 logits, new pool) in ONE dispatch:
+        row j is the target's distribution for the token AFTER window
+        position j — exactly what W sequential single-token decode steps
+        would produce, because each query position only attends to keys
+        at positions ≤ its own. Rejected suffixes need no undo: the
+        scheduler rolls ``seq_len`` back and the stale rows stay masked
+        by position until the next window (which always starts at or
+        before them, since ≥1 token is accepted per round) overwrites
+        them."""
+        w = tokens.shape[1]
+        positions = (seq_lens[:, None]
+                     + jax.lax.iota(jnp.int32, w)[None, :])     # (slots, W)
+        acts = {self._token_id.tensor_id: tokens,
+                self._pos_id.tensor_id: positions}
+        new_pool = dict(pool)
+
+        def attn(op, p, x):
+            out, new_pool[op.name] = _attn_with_paged_cache(
+                op, p, x, new_pool[op.name], tables, seq_lens)
+            return out
+
+        logits = self._forward_block(params, acts, attn)
+        return logits, new_pool
 
     def _prefill_step(self, params, tokens, pool, tables, lengths):
         """Bucketed prefill for a GROUP of requests: tokens (P, Sb)
@@ -632,20 +748,17 @@ class PagedDecoder(_DecodeGraph):
             # position p lands in block tables[i, p // bs] at offset
             # p % bs; padding positions (p >= lengths[i]) are
             # redirected into the null block (real positions never
-            # collide — each row owns its blocks)
-            kpool, vpool = new_pool[op.name]
-            nb = kpool.shape[0]
+            # collide — each row owns its blocks). _entry_write
+            # quantizes on the way in for int8 arenas.
             blk = tables[:, pos // bs]                          # (P, Sb)
             flat = jnp.where(pos[None, :] < lengths[:, None],
                              blk * bs + (pos % bs)[None, :],
                              NULL_BLOCK * bs)                   # (P, Sb)
             heads, hdim = kh.shape[2], kh.shape[3]
-            kflat = kpool.reshape(nb * bs, heads, hdim).at[
-                flat.reshape(-1)].set(kh.reshape(b * s_blk, heads, hdim))
-            vflat = vpool.reshape(nb * bs, heads, hdim).at[
-                flat.reshape(-1)].set(vh.reshape(b * s_blk, heads, hdim))
-            new_pool[op.name] = (kflat.reshape(kpool.shape),
-                                 vflat.reshape(vpool.shape))
+            new_pool[op.name] = _entry_write(
+                new_pool[op.name], flat.reshape(-1),
+                kh.reshape(b * s_blk, heads, hdim),
+                vh.reshape(b * s_blk, heads, hdim))
             return out
 
         logits = self._forward_block(params, acts, attn)
@@ -761,3 +874,217 @@ class PagedDecoder(_DecodeGraph):
             jnp.asarray(np.asarray(tables, np.int32)),
             jnp.asarray(np.asarray(seq_lens, np.int32)))
         return np.asarray(logits)
+
+    def verify(self, tokens: np.ndarray, tables: np.ndarray,
+               seq_lens: np.ndarray) -> np.ndarray:
+        """Speculative verify step for all slots: ``tokens`` (slots, W)
+        int32 — each slot's last accepted token plus W-1 draft
+        proposals. ONE dispatch (the verify IS the step's decode
+        dispatch — same counters, same invariant). Returns (slots, W,
+        vocab) float32 logits: row j is the target's next-token
+        distribution after window position j."""
+        tokens = np.asarray(tokens, np.int32)
+        w = int(tokens.shape[1])
+        fn = self._verify_fns.get(w)
+        if fn is None:
+            fn = jax.jit(self._verify_step, donate_argnums=(2,))
+            self._verify_fns[w] = fn
+        self.decode_steps += 1
+        self.decode_dispatches += 1
+        logits, self.pool.kv = fn(
+            self._exec_params(), jnp.asarray(tokens), self.pool.kv,
+            jnp.asarray(np.asarray(tables, np.int32)),
+            jnp.asarray(np.asarray(seq_lens, np.int32)))
+        return np.asarray(logits)
+
+    # ---- KV quantization gate (KVQ001) -------------------------------------
+    def _dense_reference_logits(self, tokens: np.ndarray) -> np.ndarray:
+        """Eager (un-jitted) dense causal forward over one full
+        sequence — the cache-free reference the quantized pool is
+        calibrated against. Returns (S, vocab) float32 logits."""
+        tokens = np.asarray(tokens, np.int32)
+        s = tokens.shape[0]
+        acts = {
+            self._token_id.tensor_id: jnp.asarray(tokens[None, :]),
+            self._pos_id.tensor_id:
+                jnp.asarray(np.arange(s, dtype=np.int32)[None, :])}
+
+        def attn(op, p, x):
+            qh = jnp.einsum("bse,ehd->bshd", x, p["wq"])
+            kh = jnp.einsum("bse,ehd->bshd", x, p["wk"])
+            vh = jnp.einsum("bse,ehd->bshd", x, p["wv"])
+            if op.use_bias:
+                qh = qh + p["bq"]
+                kh = kh + p["bk"]
+                vh = vh + p["bv"]
+            scale = 1.0 / math.sqrt(op.head_dim)
+            scores = jnp.einsum("bqhd,bkhd->bhqk", qh, kh) * scale
+            pos = jax.lax.iota(jnp.int32, s)
+            mask = pos[None, :] <= pos[:, None]
+            scores = jnp.where(mask[None, None, :, :], scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1)
+            ctxv = jnp.einsum("bhqk,bkhd->bqhd", probs, vh)
+            out = jnp.einsum("bqhd,hde->bqe", ctxv, p["wo"])
+            if op.use_bias:
+                out = out + p["bo"]
+            return out
+
+        logits = self._forward_block(self._exec_params(), acts, attn)
+        return np.asarray(logits[0], np.float32)
+
+    def _calibrate_kv_quant(self, budget: Optional[float]) -> None:
+        """The ``serving_kv_divergence_budget`` gate: run a calibration
+        prompt through the REAL quantized prefill + decode programs,
+        compare the decode logits against the dense f32-arena reference,
+        and fall back LOUDLY to a float32 pool (KVQ001 finding +
+        ``serving.kv_dtype_fallbacks`` counter + stderr) when the
+        max-abs logit divergence exceeds the budget. The measured
+        divergence is kept on :attr:`kv_divergence` either way, so the
+        ledger records how close a passing config sailed."""
+        cfg = self._cm.config
+        if budget is None:
+            budget = getattr(cfg, "serving_kv_divergence_budget", None)
+        # 0.0 is the knob's "unset" sentinel (config default), not a
+        # zero-tolerance request — both map to the 0.05 default budget.
+        budget = float(budget) if budget else 0.05
+        self.kv_divergence_budget = budget
+        vocab = int(self._cm.logits_tensor.dims[-1])
+        prompt_len = int(max(1, min(self.block_size + 1,
+                                    self.max_length - 1, 12)))
+        rng = np.random.default_rng(0)
+        prompt = rng.integers(0, vocab, size=prompt_len).astype(np.int32)
+        # reference: dense cache-free forward, then one more position
+        ref = self._dense_reference_logits(prompt)
+        nxt = int(ref[-1].argmax(-1))
+        ref_row = self._dense_reference_logits(
+            np.concatenate([prompt, [nxt]]))[-1]
+        # quantized path: the exact programs serving will dispatch
+        table = self.pool.try_admit(prompt_len + 1)
+        if table is None:  # pragma: no cover — fresh pool always fits
+            raise RuntimeError("calibration admission failed on a "
+                               "fresh pool")
+        try:
+            self.prefill(prompt, table)
+            toks = np.zeros(self.decode_slots, np.int32)
+            toks[0] = nxt
+            tabs = np.full((self.decode_slots, self.max_blocks_per_request),
+                           NULL_BLOCK, np.int32)
+            tabs[0, :table.shape[0]] = table
+            lens = np.zeros(self.decode_slots, np.int32)
+            lens[0] = prompt_len
+            q_row = self.decode(toks, tabs, lens)[0]
+        finally:
+            self.pool.free(table)
+        self.kv_divergence = float(np.max(np.abs(q_row - ref_row)))
+        if self.kv_divergence <= budget:
+            return
+        import sys
+
+        from ..analysis.findings import ValidationReport
+        from ..obs.metrics import metrics_registry
+
+        report = ValidationReport(source="serving", tag="kv_quant")
+        report.add(
+            "KVQ001",
+            f"kv_dtype={self.kv_dtype!r} calibration divergence "
+            f"{self.kv_divergence:.3e} exceeds "
+            f"serving_kv_divergence_budget {budget:.3e}; falling back "
+            f"to float32 arenas (admission headroom reverts to the f32 "
+            f"pool size)",
+            severity="warning")
+        self.kv_quant_report = report
+        metrics_registry().counter("serving.kv_dtype_fallbacks").inc()
+        print(f"[serving] KVQ001: {report.warnings[0].message}",
+              file=sys.stderr)
+        self.kv_dtype = "float32"
+        dt = self._compute_dtype() or jnp.float32
+        self.pool = PagedKVPool(
+            {op.name: (op.num_heads, op.head_dim)
+             for op in self._attn_ops},
+            num_blocks=self.pool.num_blocks, block_size=self.block_size,
+            max_blocks_per_request=self.max_blocks_per_request, dtype=dt,
+            kv_dtype="float32")
+
+
+def build_draft_model(ff, spec: str):
+    """Build + compile a draft causal LM sharing ``ff``'s vocab and
+    position contract (:func:`~flexflow_tpu.runtime.compiler
+    .causal_lm_signature`), for speculative decoding. ``spec``:
+
+    * ``"self:N"`` — layer-skip self-drafting: a GPT with the target's
+      own geometry truncated to its first N transformer blocks, with
+      every shared-name parameter (embeddings, blocks 0..N-1, final LN,
+      LM head) COPIED from the target — the draft approximates the
+      target by construction, no separate training needed (the standard
+      draft-free speculation baseline);
+    * ``"gpt:layers=1,hidden=16,heads=2"`` — a fresh randomly
+      initialized GPT at the target's vocab/max_positions (every key
+      optional; hidden/heads default to the target's).
+
+    Returns the compiled draft FFModel.
+    """
+    import copy
+
+    from ..ffconst import CompMode
+    from ..models.gpt import GPTConfig, build_gpt
+    from ..runtime.compiler import causal_lm_signature
+    from ..runtime.model import FFModel
+
+    cm = ff.compiled
+    if cm is None:
+        raise ValueError("compile() the target before building a draft")
+    sig = causal_lm_signature(cm)
+    attn_ops = [op for op in cm.ops
+                if op.op_type is OpType.MULTIHEAD_ATTENTION]
+    if not attn_ops:
+        raise ValueError("target has no attention ops — not a causal LM")
+    t_heads = attn_ops[0].num_heads
+    t_hidden = attn_ops[0].num_heads * attn_ops[0].head_dim
+    kind, _, rest = spec.partition(":")
+    if kind == "self":
+        layers = int(rest or 1)
+        if layers < 1 or layers > len(attn_ops):
+            raise ValueError(
+                f"draft spec {spec!r}: need 1 <= N <= "
+                f"{len(attn_ops)} target blocks")
+        up = cm.params.get("block0_mlp_up", {}).get("kernel")
+        ratio = (int(up.shape[-1] // t_hidden) if up is not None else 4)
+        gcfg = GPTConfig(
+            vocab_size=sig["vocab_size"],
+            max_positions=sig["max_positions"] or 1024,
+            hidden_size=t_hidden, num_heads=t_heads,
+            num_layers=layers, mlp_ratio=ratio)
+    elif kind == "gpt":
+        kw = {}
+        for part in filter(None, rest.split(",")):
+            key, _, val = part.partition("=")
+            kw[key.strip()] = int(val)
+        gcfg = GPTConfig(
+            vocab_size=sig["vocab_size"],
+            max_positions=sig["max_positions"] or 1024,
+            hidden_size=kw.get("hidden", t_hidden),
+            num_heads=kw.get("heads", t_heads),
+            num_layers=kw.get("layers", 1),
+            mlp_ratio=kw.get("mlp_ratio", 4))
+    else:
+        raise ValueError(
+            f"draft spec {spec!r}: expected 'self:N' or "
+            f"'gpt:layers=...,hidden=...,heads=...'")
+    dcfg = copy.deepcopy(ff.config)
+    dcfg.computation_mode = CompMode.INFERENCE
+    draft = FFModel(dcfg)
+    build_gpt(draft, cm.input_tensors[0].dims[0], 8, gcfg)
+    draft.compile(optimizer=None, loss_type=None, metrics=[])
+    if kind == "self":
+        # graft the target's weights onto every shared-name layer —
+        # shapes match by construction (same vocab/hidden/heads/ratio)
+        for name, weights in draft.compiled.params.items():
+            src = cm.params.get(name)
+            if not src:
+                continue
+            draft.compiled.params[name] = {
+                w: (src[w] if w in src and src[w].shape == arr.shape
+                    else arr)
+                for w, arr in weights.items()}
+        draft.compiled.bump_params_version()
+    return draft

@@ -28,6 +28,20 @@ Memory math (per attention op): ``2 * num_blocks * block_size * heads *
 head_dim * dtype_bytes`` — e.g. 256 blocks x 16 tokens x 8 heads x 64
 dims in bf16 = 2 * 256*16*8*64 * 2B = 8 MiB per layer, serving up to
 ``(num_blocks-1) // blocks_per_request`` concurrent worst-case requests.
+
+**Quantized arenas** (``kv_dtype``): the pool can store its arenas in
+``"bfloat16"`` (cast-in/cast-out) or ``"int8"`` — asymmetric per-token
+per-head quantization, with the f32 scale and zero-point stored in
+sidecar arrays indexed by the same (block, slot, head) coordinates so
+the scatter/gather path never needs a second addressing scheme. int8
+per-token bytes per head are ``head_dim + 8`` (values + scale + zero)
+vs f32's ``4 * head_dim`` — half the bytes at head_dim 8, a quarter at
+head_dim 64 — so worst-case admission at a fixed byte budget doubles
+or better. Dequantization happens inside the decode/verify dispatch
+(:func:`~flexflow_tpu.serving.generation._attn_with_paged_cache`);
+the numerics gate (``serving_kv_divergence_budget``, KVQ001) lives in
+:class:`~flexflow_tpu.serving.generation.PagedDecoder`, which
+calibrates at construction and falls back loudly to f32.
 """
 
 from __future__ import annotations
@@ -44,6 +58,12 @@ from ..obs.metrics import metrics_registry
 from .errors import KVPoolExhausted
 
 NULL_BLOCK = 0  # reserved scatter/gather sink; never allocated
+
+# arena storage modes: "float32" stores in the pool's compute dtype
+# (the historical behavior — ``dtype`` may itself be bf16 under a
+# bf16 compute config), "bfloat16" forces bf16 arenas, "int8" adds
+# per-token per-head f32 scale/zero-point sidecars
+KV_DTYPES = ("float32", "bfloat16", "int8")
 
 
 class PagedKVPool:
@@ -64,7 +84,8 @@ class PagedKVPool:
 
     def __init__(self, specs: Dict[str, Tuple[int, int]], *,
                  num_blocks: int, block_size: int,
-                 max_blocks_per_request: int, dtype=jnp.float32):
+                 max_blocks_per_request: int, dtype=jnp.float32,
+                 kv_dtype: str = "float32"):
         if num_blocks < 2:
             raise ValueError(f"num_blocks {num_blocks} < 2: block 0 is the "
                              f"reserved null block, so a usable pool needs "
@@ -74,16 +95,33 @@ class PagedKVPool:
         if max_blocks_per_request < 1:
             raise ValueError(
                 f"max_blocks_per_request {max_blocks_per_request} < 1")
+        if kv_dtype not in KV_DTYPES:
+            raise ValueError(f"kv_dtype {kv_dtype!r}: expected one of "
+                             f"{KV_DTYPES}")
         self.num_blocks = int(num_blocks)
         self.block_size = int(block_size)
         self.max_blocks_per_request = int(max_blocks_per_request)
         self.dtype = dtype
+        self.kv_dtype = kv_dtype
         self.specs = dict(specs)
-        self.kv: Dict[str, Tuple[jnp.ndarray, jnp.ndarray]] = {}
+        # arena entry per op: (k, v) for float/bf16 storage, or the
+        # 6-tuple (k_q, v_q, k_scale, k_zero, v_scale, v_zero) for int8
+        # — the generation helpers dispatch on the tuple length, so the
+        # donated pytree structure is the only quantization "flag" the
+        # compiled programs ever see
+        self.kv: Dict[str, Tuple[jnp.ndarray, ...]] = {}
         for name, (heads, head_dim) in self.specs.items():
             shape = (self.num_blocks, self.block_size, heads, head_dim)
-            self.kv[name] = (jnp.zeros(shape, dtype),
-                            jnp.zeros(shape, dtype))
+            if kv_dtype == "int8":
+                side = (self.num_blocks, self.block_size, heads)
+                self.kv[name] = (
+                    jnp.zeros(shape, jnp.int8), jnp.zeros(shape, jnp.int8),
+                    jnp.zeros(side, jnp.float32), jnp.zeros(side, jnp.float32),
+                    jnp.zeros(side, jnp.float32), jnp.zeros(side, jnp.float32))
+            else:
+                store = jnp.bfloat16 if kv_dtype == "bfloat16" else dtype
+                self.kv[name] = (jnp.zeros(shape, store),
+                                 jnp.zeros(shape, store))
         # LIFO free list: freshly freed blocks are reused first (their
         # stale contents are masked by position either way)
         self._free: List[int] = list(range(self.num_blocks - 1, 0, -1))
@@ -102,10 +140,20 @@ class PagedKVPool:
         return max(1, math.ceil(int(tokens) / self.block_size))
 
     def memory_bytes(self) -> int:
-        """Total arena bytes across all ops (k and v)."""
+        """Total arena bytes across all ops (k and v), dtype-aware:
+        int8 pools count their f32 scale/zero-point sidecars too (the
+        honest admission-doubling denominator). Pinned byte-for-byte to
+        the sim's :func:`~flexflow_tpu.sim.simulator
+        .serving_kv_pool_bytes` by a parity test."""
+        if self.kv_dtype == "int8":
+            # per token: k+v int8 values plus (scale, zero) f32 per head
+            per_tok = sum(2 * h * d + 2 * 2 * h * 4
+                          for h, d in self.specs.values())
+            return self.num_blocks * self.block_size * per_tok
+        item = (2 if self.kv_dtype == "bfloat16"
+                else jnp.dtype(self.dtype).itemsize)
         per_tok = sum(2 * h * d for h, d in self.specs.values())
-        return (self.num_blocks * self.block_size * per_tok
-                * jnp.dtype(self.dtype).itemsize)
+        return self.num_blocks * self.block_size * per_tok * item
 
     # ---- allocator ---------------------------------------------------------
     def in_use(self) -> int:
@@ -180,7 +228,8 @@ class PagedKVPool:
             "in_use": used,
             "high_water": hw,
             "memory_bytes": int(self.memory_bytes()),
+            "kv_dtype": self.kv_dtype,
         }
 
 
-__all__ = ["NULL_BLOCK", "PagedKVPool", "KVPoolExhausted"]
+__all__ = ["KV_DTYPES", "NULL_BLOCK", "PagedKVPool", "KVPoolExhausted"]

@@ -76,6 +76,16 @@ _PHASE_WINDOW = 4096
 _PUBLISH_EVERY = 16
 
 
+def _temp_softmax(row_logits: np.ndarray, temperature: float) -> np.ndarray:
+    """The temperature softmax :func:`sample_next_token` samples from,
+    as an explicit distribution — the speculative path's rejection test
+    needs p and q themselves, with numerics identical to the sampling
+    path (same max-shift, same normalization)."""
+    p = np.exp((np.asarray(row_logits, np.float64)
+                - float(row_logits.max())) / temperature)
+    return p / p.sum()
+
+
 def _percentiles(xs) -> Optional[Dict]:
     from ..obs.metrics import nearest_rank_percentile
 
@@ -148,7 +158,10 @@ class ContinuousBatchingScheduler:
                  default_deadline_s: Optional[float] = None,
                  breaker_threshold: int = 0,
                  breaker_cooldown_s: float = 1.0,
-                 worker_retry_budget: int = 2):
+                 worker_retry_budget: int = 2,
+                 draft_ff=None, spec_k: int = 0,
+                 kv_dtype: str = "float32",
+                 kv_divergence_budget: Optional[float] = None):
         if max_length is None:
             max_length = _position_capacity(ff)
         self.name = name
@@ -156,7 +169,47 @@ class ContinuousBatchingScheduler:
         self.decoder = PagedDecoder(
             ff, max_length, decode_slots=decode_slots,
             block_size=block_size, num_blocks=num_blocks,
-            prefill_buckets=prefill_buckets)
+            prefill_buckets=prefill_buckets, kv_dtype=kv_dtype,
+            kv_divergence_budget=kv_divergence_budget)
+        self.spec_k = max(0, int(spec_k))
+        self.draft: Optional[PagedDecoder] = None
+        if self.spec_k > 0:
+            if draft_ff is None:
+                raise ValueError(
+                    f"{name!r}: spec_k={self.spec_k} needs a draft model "
+                    f"— pass draft_ff (or set serving_draft_model so the "
+                    f"GenerationInstance builds one)")
+            from ..runtime.compiler import causal_lm_signature
+
+            tsig = causal_lm_signature(ff.compiled)
+            dsig = causal_lm_signature(draft_ff.compiled)
+            if dsig["vocab_size"] != tsig["vocab_size"]:
+                raise ValueError(
+                    f"{name!r}: draft vocab {dsig['vocab_size']} != "
+                    f"target vocab {tsig['vocab_size']} — speculation "
+                    f"needs the shared tokenizer/vocab contract")
+            if (dsig["max_positions"] is not None
+                    and dsig["max_positions"] < self.decoder.max_length):
+                raise ValueError(
+                    f"{name!r}: draft position capacity "
+                    f"{dsig['max_positions']} < serving max_length "
+                    f"{self.decoder.max_length}")
+            # the draft decoder SHARES the target's block tables (same
+            # geometry: block_size / num_blocks / max_length), writing
+            # its own arenas at the same coordinates; its allocator is
+            # never used — admission lives in the target pool only
+            self.draft = PagedDecoder(
+                draft_ff, self.decoder.max_length,
+                decode_slots=self.decoder.decode_slots,
+                block_size=self.decoder.block_size,
+                num_blocks=self.decoder.pool.num_blocks,
+                prefill_buckets=self.decoder.prefill_buckets,
+                kv_dtype=self.decoder.kv_dtype, calibrate=False)
+        self._spec_rounds = 0
+        self._spec_slot_rounds = 0
+        self._spec_proposed = 0
+        self._spec_matched = 0
+        self._spec_emitted = 0
         self.max_prefills_per_step = max(1, int(max_prefills_per_step))
         self.prefill_token_budget = max(0, int(prefill_token_budget))
         self._prefill_dispatches = 0
@@ -537,6 +590,14 @@ class ContinuousBatchingScheduler:
             logits = _DECODE_RETRY.call(
                 self.decoder.prefill_many,
                 [r.prompt for r in reqs], [r.table for r in reqs])
+            if self.draft is not None:
+                # prime the draft's arenas through the SAME block
+                # tables (its prefill logits are unused — the first
+                # generated token is sampled from the target, exactly
+                # like non-speculative serving)
+                _DECODE_RETRY.call(
+                    self.draft.prefill_many,
+                    [r.prompt for r in reqs], [r.table for r in reqs])
         except Exception as e:  # noqa: BLE001 — fail the group only
             reg.counter("serving.errors").inc()
             for _, req in members:
@@ -565,6 +626,10 @@ class ContinuousBatchingScheduler:
         t0 = time.perf_counter()
         logits = _DECODE_RETRY.call(self.decoder.prefill, req.prompt,
                                     req.table)
+        if self.draft is not None:
+            # prime the draft's arenas through the SAME block table
+            # (its prefill logits are unused)
+            _DECODE_RETRY.call(self.draft.prefill, req.prompt, req.table)
         t_done = time.perf_counter()
         with self._mu:
             self._prefill_dispatches += 1
@@ -579,6 +644,8 @@ class ContinuousBatchingScheduler:
 
     # ---- decode ------------------------------------------------------------
     def _decode_once(self) -> None:
+        if self.spec_k > 0 and self.draft is not None:
+            return self._spec_once()
         reg = metrics_registry()
         now = time.perf_counter()
         with self._mu:
@@ -652,14 +719,193 @@ class ContinuousBatchingScheduler:
             with self._mu:  # a served step closes the failure streak
                 self._consec_failures = 0
 
+    def _spec_once(self) -> None:
+        """One speculative round: ``spec_k`` draft proposals per live
+        slot (k+1 draft dispatches — the extra one writes the last
+        proposal's K/V so the draft cache stays position-complete for
+        the next round), then ONE target verify dispatch over the
+        (k+1)-token window. The verify IS the step's decode dispatch,
+        so the one-decode-dispatch-per-step invariant holds with
+        speculation on.
+
+        Commit rule per slot, walking the verify rows in order (row j
+        is the target's distribution AFTER window position j):
+
+        * greedy — commit the target's argmax; a proposal that matches
+          it keeps the walk going (its K/V is already cached at the
+          right position), the first mismatch commits the target's
+          correction and rolls the cursor back by simple ``seq_len``
+          arithmetic (stale suffix rows stay masked by position and are
+          overwritten next round). Token-for-token the target's own
+          argmax chain — identical to non-speculative decoding.
+        * temperature — standard rejection sampling: accept proposal d
+          with prob min(1, p(d)/q(d)); on reject, sample the correction
+          from normalize(max(p-q, 0)). All draws come from the
+          request's own seeded stream in a fixed order (k proposal
+          draws, then the acceptance draws), so runs replay.
+        * full match — one bonus token from the last verify row, the
+          (k+1)-th emission of the round.
+
+        Rejected suffixes never touch other slots: acceptance is pure
+        per-row host bookkeeping over the shared dispatch."""
+        reg = metrics_registry()
+        now = time.perf_counter()
+        with self._mu:
+            slots = list(self._slots)
+        expired = set()
+        for i, req in enumerate(slots):
+            if req is not None and req.expired(now):
+                expired.add(i)
+                with self._mu:
+                    self._slots[i] = None
+                    self._deadline_rejects += 1
+                reg.counter("serving.deadline_rejects").inc()
+                self.decoder.pool.free(req.table)
+                if not req.future.done():
+                    req.future.set_exception(DeadlineExceeded(
+                        f"request {req.request_id} exceeded its deadline "
+                        f"{req.deadline_s:.3f}s mid-decode "
+                        f"({len(req.tokens)}/{req.max_new_tokens} tokens)"))
+        active = [(i, r) for i, r in enumerate(slots)
+                  if r is not None and i not in expired]
+        if not active:
+            return
+        k = self.spec_k
+        n_slots = len(slots)
+        base_tokens = np.zeros(n_slots, np.int32)
+        tables = np.zeros(
+            (n_slots, self.decoder.max_blocks_per_request), np.int32)
+        seq_lens = np.zeros(n_slots, np.int32)
+        with self._mu:
+            for i, req in active:
+                base_tokens[i] = req.tokens[-1]
+                tables[i] = req.table
+                seq_lens[i] = req.seq_len
+                if req.decode_t0 is None:
+                    req.decode_t0 = time.perf_counter()
+        t0 = time.perf_counter()
+        proposals = np.zeros((n_slots, k), np.int32)
+        qdists: List[Optional[List[np.ndarray]]] = [None] * n_slots
+        try:
+            cur = base_tokens.copy()
+            lens = seq_lens.copy()
+            for j in range(k + 1):
+                dlogits = _DECODE_RETRY.call(self.draft.decode, cur,
+                                             tables, lens)
+                lens = lens + 1
+                if j == k:
+                    break  # cache-sync dispatch: writes d_k, logits unused
+                nxt = np.zeros(n_slots, np.int32)
+                for i, req in active:
+                    if req.temperature > 0:
+                        q = _temp_softmax(dlogits[i], req.temperature)
+                        if qdists[i] is None:
+                            qdists[i] = []  # hotpath: lock-ok (round-local list, never shared)
+                        qdists[i].append(q)
+                        nxt[i] = int(req.rng.choice(q.shape[-1], p=q))  # hotpath: lock-ok (round-local array)
+                    else:
+                        nxt[i] = int(dlogits[i].argmax(-1))  # hotpath: lock-ok (round-local array)
+                proposals[:, j] = nxt  # hotpath: lock-ok (round-local array)
+                cur = nxt
+            window = np.zeros((n_slots, k + 1), np.int32)
+            window[:, 0] = base_tokens  # hotpath: lock-ok (round-local array)
+            window[:, 1:] = proposals  # hotpath: lock-ok (round-local array)
+            vlogits = _DECODE_RETRY.call(self.decoder.verify, window,
+                                         tables, seq_lens)
+        except Exception as e:  # noqa: BLE001 — fail the step's requests
+            reg.counter("serving.errors").inc()
+            for i, req in active:
+                with self._mu:
+                    self._slots[i] = None
+                self.decoder.pool.free(req.table)
+                if not req.future.done():
+                    req.future.set_exception(e)
+            if self.breaker_threshold:
+                with self._mu:
+                    self._consec_failures += 1
+                    opened = (self._consec_failures
+                              == self.breaker_threshold)
+                    if opened:
+                        self._breaker_open_until = (
+                            time.monotonic() + self.breaker_cooldown_s)
+                if opened:
+                    reg.counter("serving.breaker_opens").inc()
+            return
+        dt = time.perf_counter() - t0
+        reg.histogram("serving.decode_step_s").observe(dt)
+        for i, req in active:
+            matched = 0
+            emitted = 0
+            done = False
+            accepted = True
+            for j in range(k):
+                row = np.asarray(vlogits[i, j])
+                d = int(proposals[i, j])
+                if req.temperature > 0:
+                    p = _temp_softmax(row, req.temperature)
+                    q = qdists[i][j]
+                    u = req.rng.uniform()
+                    if q[d] > 0 and u < min(1.0, float(p[d]) / float(q[d])):
+                        tok = d
+                        accepted = True
+                    else:
+                        resid = np.maximum(p - q, 0.0)
+                        tot = resid.sum()
+                        tok = (int(req.rng.choice(
+                                   resid.shape[-1], p=resid / tot))
+                               if tot > 0 else
+                               int(req.rng.choice(p.shape[-1], p=p)))
+                        accepted = False
+                else:
+                    tok = int(row.argmax(-1))
+                    accepted = tok == d
+                emitted += 1
+                done = self._commit_token(req, tok, advance_seq=True)
+                if done or not accepted:
+                    break
+                matched += 1
+            if accepted and not done and matched == k:
+                # every proposal accepted: the bonus token rides the
+                # last verify row for free
+                tok = sample_next_token(np.asarray(vlogits[i, k]),
+                                        req.temperature, req.rng)
+                emitted += 1
+                self._commit_token(req, tok, advance_seq=True)
+            with self._mu:
+                req.decode_steps += 1
+                self._spec_slot_rounds += 1
+                self._spec_proposed += k
+                self._spec_matched += matched
+                self._spec_emitted += emitted
+            reg.histogram("serving.spec_accept_rate").observe(matched / k)
+            reg.histogram("serving.spec_tokens_per_dispatch").observe(
+                emitted)
+        with self._mu:  # one verify dispatch served this whole round
+            self._spec_rounds += 1
+        if self.breaker_threshold:
+            with self._mu:  # a served step closes the failure streak
+                self._consec_failures = 0
+
     def _append_token(self, req: GenerationRequest, row_logits) -> None:
         """Sample the next token for one request (mask-aware: only
         called for live requests) and retire it when finished."""
         tok = sample_next_token(np.asarray(row_logits), req.temperature,
                                 req.rng)
+        self._commit_token(req, tok)
+
+    def _commit_token(self, req: GenerationRequest, tok: int,
+                      advance_seq: bool = False) -> bool:
+        """Record one committed token for a live request and retire it
+        when finished. ``advance_seq`` bumps ``seq_len`` atomically
+        with the append (the speculative path: each commit means the
+        previous token's K/V is now validly cached); the plain decode
+        path advances ``seq_len`` per dispatch instead. Returns True
+        when the request retired."""
         now = time.perf_counter()
         ttft = None
         with self._mu:
+            if advance_seq:
+                req.seq_len += 1
             req.tokens.append(int(tok))
             if req.t_first_token is None:
                 req.t_first_token = now
@@ -678,6 +924,7 @@ class ContinuousBatchingScheduler:
                 or (req.eos_id is not None and tok == req.eos_id))
         if done:
             self._retire(req, now)
+        return done
 
     def _retire(self, req: GenerationRequest, now: float) -> None:
         reg = metrics_registry()
@@ -752,9 +999,18 @@ class ContinuousBatchingScheduler:
             prefill_dispatches = self._prefill_dispatches
             prefill_prompts = self._prefill_prompts
             phases = {k: _percentiles(v) for k, v in self._lat.items()}
+            spec_rounds = self._spec_rounds
+            spec_slot_rounds = self._spec_slot_rounds
+            spec_proposed = self._spec_proposed
+            spec_matched = self._spec_matched
+            spec_emitted = self._spec_emitted
         now = time.perf_counter()
         tps = (tokens / (now - t_start)
                if t_start is not None and now > t_start else 0.0)
+        kv = self.decoder.pool.stats()
+        if self.decoder.kv_divergence is not None:
+            kv["divergence"] = self.decoder.kv_divergence
+            kv["quant_fallback"] = self.decoder.kv_quant_report is not None
         return {
             "serving_engine": "continuous",
             "model": self.name,
@@ -766,12 +1022,30 @@ class ContinuousBatchingScheduler:
             "shed": shed,
             "deadline_rejects": deadline,
             "phases": phases,
-            "kv": self.decoder.pool.stats(),
+            "kv": kv,
             "decode_steps": self.decoder.decode_steps,
             "decode_dispatches": self.decoder.decode_dispatches,
             "prefill_dispatches": prefill_dispatches,
             "prefill_prompts": prefill_prompts,
             "prefill_buckets": list(self.decoder.prefill_buckets),
+            **({"spec": {
+                "k": self.spec_k,
+                # rounds = verify dispatches; slot_rounds = per-slot
+                # acceptance walks (rounds x live slots at the time)
+                "rounds": spec_rounds,
+                "slot_rounds": spec_slot_rounds,
+                "proposed": spec_proposed,
+                "matched": spec_matched,
+                "emitted": spec_emitted,
+                "accept_rate": (round(spec_matched / spec_proposed, 4)
+                                if spec_proposed else 0.0),
+                # mean tokens ONE slot retires per verify dispatch
+                # (1..k+1 — the speculative multiplier)
+                "tokens_per_dispatch": (
+                    round(spec_emitted / spec_slot_rounds, 3)
+                    if spec_slot_rounds else 0.0),
+                "draft_dispatches": self.draft.decode_dispatches,
+            }} if self.spec_k > 0 and self.draft is not None else {}),
             "knobs": {
                 "decode_slots": self.decoder.decode_slots,
                 "block_size": self.decoder.block_size,
@@ -780,6 +1054,9 @@ class ContinuousBatchingScheduler:
                 "max_prefills_per_step": self.max_prefills_per_step,
                 **({"prefill_token_budget": self.prefill_token_budget}
                    if self.prefill_token_budget > 0 else {}),
+                **({"spec_k": self.spec_k} if self.spec_k > 0 else {}),
+                **({"kv_dtype": self.decoder.kv_dtype}
+                   if self.decoder.kv_dtype != "float32" else {}),
             },
         }
 

@@ -26,7 +26,7 @@ from .network import (
     default_topology_for,
     route_transfers,
 )
-from .simulator import MemoryUsage, SimTask, Simulator
+from .simulator import MemoryUsage, SimTask, Simulator, serving_kv_pool_bytes
 
 __all__ = [
     "TPUChipSpec",
@@ -49,4 +49,5 @@ __all__ = [
     "MemoryUsage",
     "SimTask",
     "Simulator",
+    "serving_kv_pool_bytes",
 ]

@@ -63,6 +63,28 @@ class MemoryUsage:
         return self.weights + self.optimizer_state + self.activations
 
 
+def serving_kv_pool_bytes(specs, num_blocks: int, block_size: int,
+                          kv_dtype: str = "float32",
+                          dtype_bytes: int = 4) -> int:
+    """Dtype-aware paged-KV pool arena bytes — the sim-side mirror of
+    ``PagedKVPool.memory_bytes`` (a parity test pins the two byte-for-
+    byte, so capacity planning and the advisor's admission math can
+    never drift from the real allocation).
+
+    ``specs``: ``{attention op name: (num_heads, head_dim)}``. Per
+    token per op: k+v at the storage width, plus — for ``"int8"`` —
+    the f32 scale/zero-point sidecar pair per head for each of k and v.
+    ``dtype_bytes`` is the ``"float32"`` mode's item size (that mode
+    stores in the pool's compute dtype, which may itself be bf16)."""
+    if kv_dtype == "int8":
+        per_tok = sum(2 * h * d + 2 * 2 * h * 4
+                      for h, d in dict(specs).values())
+        return int(num_blocks) * int(block_size) * per_tok
+    item = 2 if kv_dtype == "bfloat16" else int(dtype_bytes)
+    per_tok = sum(2 * h * d for h, d in dict(specs).values())
+    return int(num_blocks) * int(block_size) * per_tok * item
+
+
 def _collective_axes(op: Op) -> Tuple[List[Tuple[str, int, str]], int]:
     """Infer XLA-inserted collectives for a compute op: axes that shard an
     input/weight dim but do not shard any output dim are contraction axes →

@@ -222,12 +222,47 @@ def test_serving_rules_map_phases_to_knob_families():
     for dominant, family, knob in (
             ("queue_wait", "decode_slots", "decode_slots"),
             ("prefill", "prefill_interleave", "max_prefills_per_step"),
-            ("decode", "block_size", "block_size")):
+            # decode-dominant with speculation off: the spec rule
+            # outprices block_size (one verify dispatch retires ~1+ak
+            # tokens vs a constant-factor gather saving)
+            ("decode", "speculation", "serving_spec_k")):
         rep = advise_record(_serving_rec(dominant))
         assert rep["kind"] == "serving"
         assert rep["dominant_phase"] == dominant
         top = rep["suggestions"][0]
         assert top["family"] == family and top["knob"] == knob, dominant
+
+
+def test_serving_spec_rule_golden():
+    """Golden: decode-dominant + spec off -> serving_spec_k, modeled
+    pricing without priors, measured pricing when a prior serving
+    record carries a spec.accept_rate; silent once speculation is on
+    (block_size becomes the decode top again)."""
+    rep = advise_record(_serving_rec("decode"))
+    top = rep["suggestions"][0]
+    assert top["family"] == "speculation"
+    assert top["knob"] == "serving_spec_k"
+    assert top["knobs"] == {"serving_spec_k": 4}
+    assert top["expected"]["basis"] == "modeled"
+    # measured pricing: a prior run with speculation on measured alpha
+    prior = _serving_rec("decode", run_id="s0", ts=0.5)
+    prior["spec"] = {"k": 4, "accept_rate": 0.8}
+    rep_m = advise_record(_serving_rec("decode"), priors=[prior])
+    top_m = rep_m["suggestions"][0]
+    assert top_m["knob"] == "serving_spec_k"
+    assert top_m["expected"]["basis"] == "measured"
+    # measured alpha=0.8 prices a bigger decode saving than the
+    # modeled alpha=0.6 default
+    assert (top_m["expected"]["phase_delta_s"]
+            > top["expected"]["phase_delta_s"])
+    # speculation already on -> no spec suggestion; block_size rules
+    rec_on = _serving_rec("decode", knobs={"spec_k": 4})
+    rec_on["spec"] = {"k": 4, "accept_rate": 0.5}
+    rep_on = advise_record(rec_on)
+    assert all(s["family"] != "speculation" for s in rep_on["suggestions"])
+    top_on = next(s for s in rep_on["suggestions"]
+                  if s["phase"] == "decode")
+    assert top_on["family"] == "block_size"
 
 
 def test_serving_prefill_rule_never_proposes_a_noop():
@@ -244,12 +279,24 @@ def test_serving_prefill_rule_never_proposes_a_noop():
 
 
 def test_serving_kv_pool_rule_fires_at_capacity():
+    """Golden: the kv_pool rule is dtype-aware — at capacity with f32
+    arenas it suggests quantizing (int8 frees the same bytes num_blocks*2
+    would buy, at zero extra memory); only an already-quantized pool gets
+    the num_blocks*2 grow."""
     rep = advise_record(_serving_rec(
         "queue_wait", kv={"high_water": 24, "capacity_blocks": 24}))
     fams = _families(rep)
     assert "kv_pool" in fams
     kvsug = next(s for s in rep["suggestions"] if s["family"] == "kv_pool")
-    assert kvsug["knobs"] == {"num_blocks": 48}
+    assert kvsug["knobs"] == {"serving_kv_dtype": "int8"}
+    assert kvsug["proposed"] == "int8" and kvsug["current"] == "float32"
+    # already int8: quantization can't free more — grow the pool
+    rep8 = advise_record(_serving_rec(
+        "queue_wait", kv={"high_water": 24, "capacity_blocks": 24,
+                          "kv_dtype": "int8"}))
+    kvsug8 = next(s for s in rep8["suggestions"]
+                  if s["family"] == "kv_pool")
+    assert kvsug8["knobs"] == {"num_blocks": 48}
 
 
 # --------------------------------------------------- ranking + validation
@@ -468,7 +515,7 @@ def test_malformed_report_exits_one_not_traceback(tmp_path,
     adv = _tool("perf_advisor")
     _write_ledger(tmp_path, [_fit_rec("input_wait")])
 
-    def broken(rec, max_suggestions=5):
+    def broken(rec, max_suggestions=5, **kw):
         raise AssertionError("advisor built a malformed report: [...]")
 
     monkeypatch.setattr(advisor_mod, "advise_record", broken)

@@ -456,6 +456,40 @@ def test_sentinel_empty_and_thin_baselines(tmp_path):
     assert out["cohorts"][0]["verdict"] == "no_baseline"
 
 
+def test_sentinel_excludes_pytest_borne_records(tmp_path):
+    """Baseline-pollution contract, test-harness edition: a record a
+    unit test leaked into the shared corpus (``pytest`` stamp) is never
+    a baseline and never the judged newest run — a 2-step mini-fit's
+    steps_per_s measures harness overhead, not the code."""
+    sent = _sentinel()
+    leaked = _bench_rec(1.0, 4)  # 10x slower than the clean trend
+    leaked["pytest"] = "tests/test_x.py::test_y"
+    _write_ledger(tmp_path, [_bench_rec(10.0, 1), _bench_rec(10.5, 2),
+                             _bench_rec(9.8, 3), leaked])
+    out = sent.run_sentinel(ledger_dir=str(tmp_path), margin=0.2,
+                            blackbox_dir=str(tmp_path / "bb"))
+    assert out["exit"] == 0 and not out["regressions"]
+    assert out["ledger"]["pytest_excluded"] == 1
+    (row,) = out["cohorts"]
+    assert row["newest_run_id"] == "r3"  # newest CLEAN run is judged
+
+
+def test_record_run_stamps_pytest_only_in_shared_corpus(tmp_path,
+                                                        monkeypatch):
+    """record_run stamps the writing test's id ONLY when the record
+    lands in the default (shared) corpus: corpora a test builds on
+    purpose through an explicit ledger_dir stay unstamped, so sentinel
+    tests over tmp ledgers keep their judgments."""
+    monkeypatch.chdir(tmp_path)  # default dir resolves inside tmp
+    doc = ledger.record_run("fit", {"model_sig": "cafe"})
+    assert doc is not None
+    assert doc["pytest"].startswith("tests/test_obs_ledger.py")
+    doc = ledger.record_run(
+        "fit", {"model_sig": "cafe"},
+        config=FFConfig(ledger_dir=str(tmp_path / "own")))
+    assert doc is not None and "pytest" not in doc
+
+
 def test_fit_bench_main_appends_ledger_record(tmp_path, monkeypatch):
     """CI/tooling satellite: the bench tools' main() persists the trend
     line. The bench itself is covered by test_fit_bench.py — here it is

@@ -401,7 +401,8 @@ def run_advisor(ledger_dir: Optional[str] = None,
     schema_problems: List[str] = []
     for rec in targets:
         try:
-            rep = advise_record(rec, max_suggestions=max_suggestions)
+            rep = advise_record(rec, max_suggestions=max_suggestions,
+                                priors=runs)
         except AssertionError as e:
             # advise_record asserts its own output valid; a rule bug
             # must surface as the documented clean exit-1, not a

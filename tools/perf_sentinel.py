@@ -90,6 +90,14 @@ def _cohorts(runs: List[Dict]) -> Dict[str, List[Dict]]:
             # settings on a canonical workload, not this repo's code —
             # never a baseline (counted by the caller)
             continue
+        if r.get("pytest"):
+            # a unit test leaked this record into the shared corpus
+            # (ledger.record_run stamps the test id): a test's 2-step
+            # mini-fit measures harness overhead, not the code — never
+            # a baseline, never a judged newest run (counted by the
+            # caller). Corpora a test builds ON PURPOSE pass their own
+            # ledger_dir and are never stamped.
+            continue
         perf = r.get("perf") or {}
         if not isinstance(perf.get("value"), (int, float)) \
                 or perf["value"] <= 0 or not perf.get("metric"):
@@ -240,6 +248,9 @@ def run_sentinel(ledger_dir: Optional[str] = None,
                 1 for r in runs
                 if r.get("kind") == "advisor_experiment"
                 or r.get("advisor")),
+            # pytest-borne records (test leaked into the shared corpus)
+            # excluded likewise: harness throughput is not code perf
+            "pytest_excluded": sum(1 for r in runs if r.get("pytest")),
             "by_kind": _by_kind(runs),
         },
         "exec": exec_block,

@@ -460,6 +460,278 @@ def run_longtail_bench(seed: int = 0, requests: int = 24,
     return doc
 
 
+def build_spec_model(seed: int = 0):
+    """The speculative-decoding A/B's target: DEEP enough (8 layers,
+    hidden 256) that a 1-layer draft's dispatch is genuinely ~8x
+    cheaper in FLOPs (on a toy-depth target the per-dispatch fixed
+    overhead would dominate and speculation could never win
+    wall-clock). The upper blocks' output projections are scaled to
+    ~zero so the target ~= its own first layer + a small perturbation —
+    a ``self:1`` draft then tracks it closely (measured acceptance
+    ~0.9), the regime speculation is built for. Both A/B arms serve
+    THIS model; the scaling is part of the benchmark fixture, not a
+    trick on one side."""
+    from flexflow_tpu import FFConfig, FFModel
+    from flexflow_tpu.ffconst import CompMode
+    from flexflow_tpu.models import GPTConfig, build_gpt
+
+    cfg = GPTConfig(vocab_size=64, max_positions=64, hidden_size=256,
+                    num_heads=4, num_layers=8)
+    ff = FFModel(FFConfig(batch_size=4, seed=seed,
+                          computation_mode=CompMode.INFERENCE))
+    build_gpt(ff, 4, 8, cfg)
+    ff.compile(optimizer=None, loss_type=None, metrics=[])
+    cm = ff.compiled
+    for i in range(1, 8):
+        for op, key in ((f"block{i}_attn", "wo"),
+                        (f"block{i}_mlp_down", "kernel")):
+            cm.params[op][key] = cm.params[op][key] * 1e-3
+    cm.bump_params_version()
+    return ff
+
+
+def _replay_window(eng, name, dec, trace: List[Dict], timed: bool):
+    """Replay the trace once against one registered generator. Returns
+    (tokens_per_s, outputs, steps_delta, dispatches_delta)."""
+    tokens = sum(r["max_new"] for r in trace)
+    steps0, disp0 = dec.decode_steps, dec.decode_dispatches
+    t0 = time.perf_counter()
+    futs = []
+    for r in trace:
+        now = time.perf_counter() - t0
+        if r["arrival_s"] > now:
+            time.sleep(r["arrival_s"] - now)
+        futs.append(eng.generate_async(name, r["prompt"], r["max_new"]))
+    outs = [f.result(timeout=600) for f in futs]
+    wall = time.perf_counter() - t0
+    return (tokens / wall if timed else 0.0, outs,
+            dec.decode_steps - steps0, dec.decode_dispatches - disp0)
+
+
+def run_spec_bench(seed: int = 0, requests: int = 10,
+                   decode_slots: int = 4, block_size: int = 8,
+                   spec_k: int = 3, draft_spec: str = "self:1",
+                   pairs: int = 2, rate_per_s: float = 5000.0,
+                   smoke: bool = False) -> Dict:
+    """The speculative-decoding A/B: the SAME target model over the
+    SAME trace, once plain continuous batching, once with a draft
+    proposing ``spec_k`` tokens per slot verified in ONE paged
+    dispatch. Interleaved pairs (base window, spec window, base, spec,
+    ...) with the warmup replays outside BOTH timed windows;
+    median-of-pair-ratios decides. Exits 1 unless speculation STRICTLY
+    wins tokens/s at its measured acceptance rate, greedy outputs stay
+    bit-identical, and the verify loop holds the
+    one-dispatch-per-step invariant."""
+    from flexflow_tpu.serving import InferenceEngine
+    from flexflow_tpu.serving.generation import build_draft_model
+
+    max_length = 48
+    trace = make_trace(seed, requests, rate_per_s, max_prompt=8,
+                       long_new=16, short_new=4)
+    ff = build_spec_model(seed)
+    draft = build_draft_model(ff, draft_spec)
+    eng = InferenceEngine()
+    kw = {"decode_slots": decode_slots, "block_size": block_size,
+          "max_length": max_length, "max_prefills_per_step": decode_slots}
+    base = eng.register_generator(ff, name="base", **kw)
+    spec = eng.register_generator(ff, name="spec", draft_ff=draft,
+                                  spec_k=spec_k, **kw)
+    base_dec = base.scheduler.decoder
+    spec_dec = spec.scheduler.decoder
+    # warm OUTSIDE both timed windows — twice per arm (a jitted
+    # program's second invocation pays the one-time fastpath recompile)
+    for _ in range(2):
+        _replay_window(eng, "base", base_dec, trace, timed=False)
+        _replay_window(eng, "spec", spec_dec, trace, timed=False)
+    ratios: List[float] = []
+    pair_rows: List[Dict] = []
+    identical = True
+    one_dispatch = True
+    base_out = spec_out = None
+    for _ in range(max(1, pairs)):
+        b_tps, base_out, b_steps, b_disp = _replay_window(
+            eng, "base", base_dec, trace, timed=True)
+        s_tps, spec_out, s_steps, s_disp = _replay_window(
+            eng, "spec", spec_dec, trace, timed=True)
+        ratios.append(s_tps / b_tps if b_tps else 0.0)
+        pair_rows.append({"base_tokens_per_s": round(b_tps, 3),
+                          "spec_tokens_per_s": round(s_tps, 3),
+                          "ratio": round(ratios[-1], 4)})
+        identical = identical and all(
+            np.array_equal(a, b) for a, b in zip(base_out, spec_out))
+        one_dispatch = (one_dispatch and b_steps == b_disp
+                        and s_steps == s_disp)
+    spec_stats = spec.stats()
+    eng.stop()
+    median_ratio = float(np.median(ratios))
+    sp = spec_stats.get("spec") or {}
+    doc: Dict = {
+        "tool": "serve_bench",
+        "smoke": smoke,
+        "trace": {
+            "kind": "spec",
+            "seed": seed,
+            "requests": requests,
+            "rate_per_s": rate_per_s,
+            "prompt_lens": [int(len(r["prompt"])) for r in trace],
+            "max_new": [r["max_new"] for r in trace],
+        },
+        "knobs": {"decode_slots": decode_slots, "block_size": block_size,
+                  "max_length": max_length, "spec_k": spec_k,
+                  "draft": draft_spec},
+        "pairs": pair_rows,
+        "median_ratio": round(median_ratio, 4),
+        "accept_rate": sp.get("accept_rate"),
+        "tokens_per_dispatch": sp.get("tokens_per_dispatch"),
+        "draft_dispatches": sp.get("draft_dispatches"),
+        "generated_identical": identical,
+        "one_dispatch_per_step": one_dispatch,
+    }
+    failures = []
+    if not one_dispatch:
+        failures.append("verify loop issued retraced/extra dispatches "
+                        "(steps != dispatches)")
+    if not identical:
+        failures.append("speculation changed the greedy outputs vs "
+                        "plain decoding")
+    if median_ratio <= 1.0:
+        failures.append(
+            f"speculation did not beat plain decoding "
+            f"(median ratio {median_ratio:.4f} at acceptance "
+            f"{sp.get('accept_rate')})")
+    doc["failures"] = failures
+    doc["exit"] = 1 if failures else 0
+    from flexflow_tpu.obs.ledger import model_context, record_bench
+
+    ctx = model_context(ff)
+    spec_tps = float(np.median(
+        [p["spec_tokens_per_s"] for p in pair_rows]))
+    record_bench(
+        "serve_bench", doc,
+        perf={"metric": "serving.tokens_per_s", "value": spec_tps,
+              "higher_is_better": True},
+        label=f"serve_spec:{ctx.get('model_sig')}",
+        knobs={"model_sig": ctx.get("model_sig"),
+               "decode_slots": decode_slots, "block_size": block_size,
+               "spec_k": spec_k, "draft": draft_spec},
+        config=ff.config)
+    return doc
+
+
+def run_kv_bench(seed: int = 0, requests: int = 12,
+                 decode_slots: int = 4, block_size: int = 8,
+                 kv_dtype: str = "int8", rate_per_s: float = 5000.0,
+                 smoke: bool = False) -> Dict:
+    """The quantized-KV A/B: at EQUAL pool bytes, how many worst-case
+    requests does each arena dtype admit? The int8 pool must admit
+    >= 2x the float32 pool (its per-token bytes are at most half, scale
+    sidecars included), and the quantized engine must then actually
+    serve a burst: calibration divergence inside
+    ``serving_kv_divergence_budget``, NO loud f32 fallback, and the
+    one-dispatch invariant intact."""
+    from flexflow_tpu.serving import InferenceEngine
+    from flexflow_tpu.serving.kv_cache import PagedKVPool
+    from flexflow_tpu.sim import serving_kv_pool_bytes
+
+    max_length = 48
+    trace = make_trace(seed, requests, rate_per_s, max_prompt=8,
+                       long_new=16, short_new=4)
+    ff = build_model(seed)
+    eng = InferenceEngine()
+    kw = {"decode_slots": decode_slots, "block_size": block_size,
+          "max_length": max_length, "max_prefills_per_step": decode_slots}
+    inst = eng.register_generator(ff, name="q", kv_dtype=kv_dtype, **kw)
+    dec = inst.scheduler.decoder
+    specs = dict(dec.pool.specs)
+    n_f32 = dec.pool.num_blocks
+    budget_bytes = serving_kv_pool_bytes(specs, n_f32, block_size,
+                                         "float32")
+    # the largest quantized pool that fits the SAME byte budget
+    n_q = n_f32
+    while serving_kv_pool_bytes(specs, n_q + 1, block_size,
+                                kv_dtype) <= budget_bytes:
+        n_q += 1
+    blocks_per_req = -(-max_length // block_size)
+
+    def _admissible(dtype: str, num_blocks: int) -> int:
+        pool = PagedKVPool(specs, num_blocks=num_blocks,
+                           block_size=block_size,
+                           max_blocks_per_request=blocks_per_req,
+                           kv_dtype=dtype)
+        count = 0
+        while True:
+            try:
+                if pool.try_admit(max_length) is None:
+                    break
+            except Exception:  # noqa: BLE001 — exhausted = stop counting
+                break
+            count += 1
+        return count
+
+    admit_f32 = _admissible("float32", n_f32)
+    admit_q = _admissible(kv_dtype, n_q)
+    # serve a burst through the quantized engine (warm twice first)
+    for _ in range(2):
+        _replay_window(eng, "q", dec, trace, timed=False)
+    tps, _outs, steps, disp = _replay_window(eng, "q", dec, trace,
+                                             timed=True)
+    stats = inst.stats()
+    eng.stop()
+    kv = stats["kv"]
+    budget = dec.kv_divergence_budget
+    doc: Dict = {
+        "tool": "serve_bench",
+        "smoke": smoke,
+        "trace": {
+            "kind": "kv_dtype",
+            "seed": seed,
+            "requests": requests,
+            "rate_per_s": rate_per_s,
+        },
+        "knobs": {"decode_slots": decode_slots, "block_size": block_size,
+                  "max_length": max_length, "kv_dtype": kv_dtype},
+        "pool_bytes_budget": budget_bytes,
+        "f32_blocks": n_f32,
+        "quant_blocks": n_q,
+        "admissible_f32": admit_f32,
+        "admissible_quant": admit_q,
+        "concurrency_ratio": (round(admit_q / admit_f32, 4)
+                              if admit_f32 else None),
+        "divergence": kv.get("divergence"),
+        "divergence_budget": budget,
+        "quant_fallback": kv.get("quant_fallback"),
+        "tokens_per_s": round(tps, 3),
+        "one_dispatch_per_step": steps == disp,
+    }
+    failures = []
+    if steps != disp:
+        failures.append("decode loop issued retraced/extra dispatches "
+                        "(steps != dispatches)")
+    if kv.get("kv_dtype") != kv_dtype or kv.get("quant_fallback"):
+        failures.append(
+            f"quantized pool fell back to float32 (divergence "
+            f"{kv.get('divergence')} vs budget {budget})")
+    if kv_dtype == "int8" and admit_f32 and admit_q < 2 * admit_f32:
+        failures.append(
+            f"int8 did not double admissible concurrency at equal pool "
+            f"bytes ({admit_q} vs {admit_f32} x2)")
+    doc["failures"] = failures
+    doc["exit"] = 1 if failures else 0
+    from flexflow_tpu.obs.ledger import model_context, record_bench
+
+    ctx = model_context(ff)
+    record_bench(
+        "serve_bench", doc,
+        perf={"metric": "serving.tokens_per_s", "value": doc["tokens_per_s"],
+              "higher_is_better": True},
+        label=f"serve_kv_{kv_dtype}:{ctx.get('model_sig')}",
+        knobs={"model_sig": ctx.get("model_sig"),
+               "decode_slots": decode_slots, "block_size": block_size,
+               "kv_dtype": kv_dtype},
+        config=ff.config)
+    return doc
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--smoke", action="store_true",
@@ -476,6 +748,25 @@ def main(argv=None) -> int:
     ap.add_argument("--prefill-token-budget", type=int, default=64,
                     help="longtail trace: the token-native variant's "
                          "per-dispatch prefill token budget")
+    ap.add_argument("--spec", action="store_true",
+                    help="speculative-decoding A/B: draft+verify vs "
+                         "plain continuous on the same target; exit 1 "
+                         "unless speculation strictly wins tokens/s "
+                         "with bit-identical greedy outputs")
+    ap.add_argument("--spec-k", type=int, default=3,
+                    help="--spec: draft proposals per slot per round")
+    ap.add_argument("--draft", default="self:1",
+                    help="--spec: draft model spec for "
+                         "build_draft_model ('self:N' or 'gpt:...')")
+    ap.add_argument("--pairs", type=int, default=2,
+                    help="--spec: interleaved A/B window pairs "
+                         "(median-of-ratios decides)")
+    ap.add_argument("--kv-dtype", choices=("float32", "bfloat16", "int8"),
+                    default="float32",
+                    help="non-float32: quantized paged-KV A/B — equal "
+                         "pool bytes must admit >=2x (int8) the "
+                         "requests, divergence inside budget, no "
+                         "fallback")
     ap.add_argument("--rate", type=float, default=5000.0,
                     help="Poisson arrival rate (requests/s). The default "
                          "saturates the toy model (service-bound, near-"
@@ -483,7 +774,20 @@ def main(argv=None) -> int:
                          "just keep up and tokens/s measures the trace, "
                          "not the server")
     ns = ap.parse_args(argv)
-    if ns.trace == "longtail":
+    if ns.spec:
+        requests = ns.requests or (8 if ns.smoke else 10)
+        doc = run_spec_bench(
+            seed=ns.seed, requests=requests,
+            decode_slots=ns.decode_slots, block_size=ns.block_size,
+            spec_k=ns.spec_k, draft_spec=ns.draft, pairs=ns.pairs,
+            rate_per_s=ns.rate, smoke=ns.smoke)
+    elif ns.kv_dtype != "float32":
+        requests = ns.requests or (8 if ns.smoke else 12)
+        doc = run_kv_bench(
+            seed=ns.seed, requests=requests,
+            decode_slots=ns.decode_slots, block_size=ns.block_size,
+            kv_dtype=ns.kv_dtype, rate_per_s=ns.rate, smoke=ns.smoke)
+    elif ns.trace == "longtail":
         requests = ns.requests or (12 if ns.smoke else 24)
         doc = run_longtail_bench(
             seed=ns.seed, requests=requests,
