@@ -88,7 +88,11 @@
 #                   black-box dump + relaunch, seeded init-timeout
 #                   retry + sentinel cohort exclusion, shrunk-world
 #                   resume -> re-search (cache miss) + counted elastic
-#                   restore; one JSON line; exit 1 on any violated
+#                   restore, and the cohort-obs gate (clean cohort:
+#                   merged trace validates on one-lane-per-rank + zero
+#                   OBS003; seeded multihost.slow_peer: the slowed rank
+#                   is NAMED straggler and the rank_skew table
+#                   telescopes); one JSON line; exit 1 on any violated
 #                   invariant
 #   make explain  — explain the newest ledger run: attribution phase
 #                   breakdown (must reconcile with the measured step

@@ -77,6 +77,11 @@ CODE_CATALOG: Dict[str, str] = {
               "config.exec_mem_threshold — the liveness model steering "
               "memory-aware decisions no longer matches the allocator "
               "(warning; suppressible only with a reasoned allow entry)",
+    "OBS003": "cross-rank step skew: the cohort's steady-state skew "
+              "fraction (slowest minus median rank step time, over the "
+              "median) exceeded config.cohort_skew_threshold — one "
+              "straggler rank is pacing the whole barrier-synchronized "
+              "cohort; the finding names it (warning)",
     "PCG016": "non-positive tensor dimension: a declared shape has a "
               "dim <= 0 (e.g. a conv/pool window larger than its input "
               "— the size formula goes negative and downstream sizes "

@@ -246,6 +246,22 @@ class FFConfig:
     watchdog: str = "off"  # knobflow: cohort-ok (stall monitor gate; heartbeats are O(1) host work)
     watchdog_threshold_s: float = 60.0  # knobflow: cohort-ok (stall monitor threshold; observability-only)
     watchdog_dir: str = ".ffcache/obs/blackbox"  # knobflow: cohort-ok (black-box dump location; observability-only)
+    # cohort observability (obs/cohort.py): "on" arms the tracer (the
+    # fit.step spans are the cross-rank skew substrate) and makes every
+    # fit export this rank's artifacts — labeled trace-rank<r>.json,
+    # metrics-rank<r>.json snapshot, cohort-rank<r>.json manifest —
+    # into the cohort directory, so the mh_launch supervisor (or
+    # tools/cohort_report.py) can merge the cohort onto ONE timeline,
+    # attribute cross-rank skew, and name the straggler rank. "off"
+    # (default) costs one mode check at fit entry.
+    cohort_obs: str = "off"  # knobflow: cohort-ok (observability export gate; fit-tail host work only)
+    # steady-state cross-rank skew fraction (slowest minus median rank,
+    # over median) tolerated before the coded OBS003 finding fires
+    cohort_skew_threshold: float = 0.25  # knobflow: cohort-ok (skew finding threshold; observability-only)
+    # None = unset: knob > FLEXFLOW_TPU_COHORT_DIR env >
+    # .ffcache/obs/cohort (obs/cohort.cohort_dir) — the ledger_dir
+    # resolution convention, shared by all ranks and config-less tools
+    cohort_obs_dir: Optional[str] = None  # knobflow: cohort-ok (cohort artifact location; observability-only)
     # --- fault tolerance (runtime/faults.py, retry.py, checkpoint.py) -----
     # deterministic fault injection: a schema-versioned plan dict
     # ({"schema": 1, "seed": ..., "sites": {...}}) arming named failure
@@ -521,6 +537,12 @@ class FFConfig:
                 cfg.obs_server_port = int(_next())
             elif a == "--ledger-per-op-topk":
                 cfg.ledger_per_op_topk = int(_next())
+            elif a == "--cohort-obs":
+                cfg.cohort_obs = "on"
+            elif a == "--cohort-skew-threshold":
+                cfg.cohort_skew_threshold = float(_next())
+            elif a == "--cohort-obs-dir":
+                cfg.cohort_obs_dir = _next()
             elif a == "--watchdog":
                 cfg.watchdog = "on"
             elif a == "--watchdog-threshold":

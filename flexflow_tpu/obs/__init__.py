@@ -38,7 +38,14 @@ The EXPLAIN half (why a run performed the way it did):
   — the learned cost model's training set (ROADMAP item 2).
 * :mod:`.server` — **observability HTTP server**: a zero-dep
   ``http.server`` background thread (role ``ff-obs-server``) serving
-  ``/metrics``, ``/healthz``, ``/runs``, ``/trace``, ``/attribution``.
+  ``/metrics``, ``/healthz``, ``/runs``, ``/trace``, ``/attribution``,
+  ``/cohort``.
+* :mod:`.cohort` — **cohort observability**: per-rank trace/metrics
+  exports under ``config.cohort_obs=on``, cross-process trace
+  unification on the PR 8 wall-clock anchors, cross-rank ``fit.step``
+  skew attribution (straggler verdict, OBS003), and the fleet-level
+  roll-up report ``tools/mh_launch.py --cohort-obs`` folds into its
+  supervisor output.
 
 Plus the DURABLE half (telemetry that outlives the process):
 
@@ -132,8 +139,19 @@ from .server import (  # noqa: F401
     configure_obs_server,
     latest_advice,
     latest_attribution,
+    latest_cohort,
     obs_server,
     publish_advice,
     publish_attribution,
+    publish_cohort,
     stop_obs_server,
+)
+from .cohort import (  # noqa: F401
+    build_cohort_report,
+    cohort_attribution,
+    cohort_dir,
+    maybe_export_cohort,
+    merge_metric_snapshots,
+    merge_traces,
+    step_skew,
 )

@@ -69,6 +69,9 @@ RULE_FAMILIES: Dict[str, tuple] = {
     "collective_transfer": ("mesh_reshape",),
     "optimizer_fold": ("optimizer_sharding",),
     "device_compute": ("precision", "fusion", "token_bucketing"),
+    # cohort phase (obs/cohort.py): the barrier tax a straggler rank
+    # charges the whole cohort
+    "rank_skew": ("elastic_shrink", "multi_step_dispatch"),
     # serving phases (continuous-batching session records)
     "queue_wait": ("decode_slots", "kv_pool"),
     "prefill": ("prefill_interleave",),
@@ -309,6 +312,42 @@ def _rule_token_bucketing(s: float, total: float, knobs: Dict,
     return []
 
 
+def _rule_rank_skew(s: float, total: float, knobs: Dict,
+                    cohort: Dict) -> List[Dict]:
+    """Skew-dominant cohort record: the ``rank_skew`` phase (cohort
+    attribution) or the record's OBS003-bearing ``cohort`` block names a
+    straggler pacing the barrier-synchronized cohort. Both remedies are
+    priced ``measured`` — the skew fraction IS a measurement of the
+    barrier tax, not a model of it."""
+    out: List[Dict] = []
+    frac = (s / total) if total > 0 else 0.0
+    straggler = cohort.get("straggler_rank")
+    who = f"rank {straggler}" if straggler is not None else "one rank"
+    n = int(knobs.get("process_count")
+            or len(cohort.get("ranks") or []) or 0)
+    if n > 1:
+        out.append(_sug(
+            "rank_skew", "elastic_shrink", "process_count", n, n - 1,
+            {"process_count": n - 1}, s, total, "measured",
+            "cohort steady_skew_frac (cross-rank fit.step skew)",
+            f"{who} paces the cohort — {frac:.1%} of every step is the "
+            f"barrier waiting on it; the elastic supervisor can shrink "
+            f"the world to {n - 1} processes and resume (topology-keyed "
+            f"re-search, checkpoint.elastic_resumes), leaving the "
+            f"remaining ranks pacing at their own median"))
+    k = int(knobs.get("steps_per_dispatch") or 1)
+    k2 = max(2, 2 * k)
+    out.append(_sug(
+        "rank_skew", "multi_step_dispatch", "steps_per_dispatch", k, k2,
+        {"steps_per_dispatch": k2}, 0.5 * s, total, "measured",
+        "cohort steady_skew_frac (cross-rank fit.step skew)",
+        f"when the straggler's excess is per-dispatch jitter (GC, host "
+        f"noise) rather than persistent, dispatching {k2} steps per "
+        f"host round-trip halves how often the cohort re-synchronizes "
+        f"on {who}"))
+    return out
+
+
 # --------------------------------------------------------- serving rules
 def _serving_phase_means(rec: Dict) -> Dict[str, float]:
     out = {}
@@ -517,6 +556,19 @@ def advise_record(rec: Dict,
                 sugs += _rule_token_bucketing(secs["device_compute"],
                                               measured, knobs,
                                               rec["buckets"])
+        # cohort skew: triggered by the rank_skew phase (a cohort
+        # attribution table) OR by an OBS003-bearing cohort block the
+        # supervisor annotated onto a merged multi-rank fit record
+        cohort_blk = rec.get("cohort") or {}
+        obs003 = any((f or {}).get("code") == "OBS003"
+                     for f in (cohort_blk.get("findings") or []))
+        skew_s = secs.get("rank_skew", 0.0)
+        if skew_s <= 0 and obs003:
+            skew_s = float(cohort_blk.get("steady_skew_frac") or 0.0) \
+                * float(measured)
+        if skew_s > 0 and (obs003
+                           or attr.get("dominant_phase") == "rank_skew"):
+            sugs += _rule_rank_skew(skew_s, measured, knobs, cohort_blk)
         if not sugs:
             return None
         report = {

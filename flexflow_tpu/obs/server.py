@@ -13,7 +13,9 @@ the stdlib alone (``http.server``; the repo's zero-dep contract):
                    about to dump), dump count
 ``/runs``          run-ledger tail as JSON (``?n=`` bounds it, def. 20)
 ``/trace``         the tracer ring as a Chrome trace-event JSON
-                   download (open in chrome://tracing / Perfetto)
+                   download (open in chrome://tracing / Perfetto);
+                   ``metadata.label`` reports the rank/process label
+                   when one was set (``Tracer.export(label=...)``)
 ``/attribution``   the latest attribution report — the fit phase table,
                    or (``?kind=serving`` / fit-less processes) the
                    serving queue_wait/prefill/decode table; 404 until
@@ -21,6 +23,10 @@ the stdlib alone (``http.server``; the repo's zero-dep contract):
 ``/advice``        the latest perf-advisor report (ranked knob deltas
                    for the dominant phase; 404 until a fit/serving
                    session or ``tools/perf_advisor.py`` published one)
+``/cohort``        the latest cohort report (merged-trace path, skew
+                   table, straggler verdict, OBS003 findings —
+                   obs/cohort.build_cohort_report publishes it; 404
+                   until a cohort report was built)
 =================  ====================================================
 
 Threading discipline (checked by analysis/concurrency_check.py): ONE
@@ -59,6 +65,7 @@ DEFAULT_RUNS_TAIL = 20
 _attr_mu = threading.Lock()
 _LATEST_ATTRIBUTION: Dict[str, Dict] = {}
 _LATEST_ADVICE: Optional[Dict] = None
+_LATEST_COHORT: Optional[Dict] = None
 _LEDGER_DIR: Optional[str] = None
 
 
@@ -95,6 +102,19 @@ def publish_advice(report: Dict) -> None:
 def latest_advice() -> Optional[Dict]:
     with _attr_mu:
         return dict(_LATEST_ADVICE) if _LATEST_ADVICE is not None else None
+
+
+def publish_cohort(report: Dict) -> None:
+    """Make the newest cohort report visible on ``/cohort``
+    (obs/cohort.build_cohort_report calls this)."""
+    global _LATEST_COHORT
+    with _attr_mu:
+        _LATEST_COHORT = dict(report)
+
+
+def latest_cohort() -> Optional[Dict]:
+    with _attr_mu:
+        return dict(_LATEST_COHORT) if _LATEST_COHORT is not None else None
 
 
 def _publish_ledger_dir(dirpath: Optional[str]) -> None:
@@ -173,11 +193,23 @@ class _Handler(BaseHTTPRequestHandler):
                         status=404)
                 else:
                     self._send_json(rec)
+            elif url.path == "/cohort":
+                rec = latest_cohort()
+                if rec is None:
+                    self._send_json(
+                        {"unavailable": "no cohort report yet — run "
+                         "ranks with config.cohort_obs='on' and build "
+                         "one (tools/cohort_report.py or the mh_launch "
+                         "supervisor's --cohort-obs)"},
+                        status=404)
+                else:
+                    self._send_json(rec)
             else:
                 self._send_json(
                     {"error": f"unknown path {url.path!r}",
                      "endpoints": ["/metrics", "/healthz", "/runs",
-                                   "/trace", "/attribution", "/advice"]},
+                                   "/trace", "/attribution", "/advice",
+                                   "/cohort"]},
                     status=404)
         except Exception as e:  # noqa: BLE001 — a bad scrape must not
             reg.counter("obs_server.errors").inc()  # kill the server
@@ -388,7 +420,7 @@ def stop_obs_server() -> None:
 
 __all__ = [
     "DEFAULT_RUNS_TAIL", "ObsServer", "configure_obs_server",
-    "latest_advice", "latest_attribution", "obs_server",
-    "publish_advice", "publish_attribution", "server_port_knob",
-    "stop_obs_server",
+    "latest_advice", "latest_attribution", "latest_cohort", "obs_server",
+    "publish_advice", "publish_attribution", "publish_cohort",
+    "server_port_knob", "stop_obs_server",
 ]

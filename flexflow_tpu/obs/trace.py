@@ -86,6 +86,9 @@ class Tracer:
 
     def __init__(self, enabled: bool = False, capacity: int = 65536):
         self.enabled = bool(enabled)
+        # rank/process label stamped into export metadata (cohort merge
+        # lane naming); set via export(label=...) or directly
+        self.label: Optional[str] = None
         self._events: collections.deque = collections.deque(maxlen=capacity)
         # the two clocks are read back to back so the wall-clock anchor
         # corresponds to ts == 0: merged cross-process traces realign on
@@ -176,17 +179,28 @@ class Tracer:
         every event by ``(anchor_a - anchor_b) * 1e6`` to co-plot."""
         import platform
 
-        return {
+        md = {
             "wall_clock_anchor_unix_s": round(self._anchor_unix, 6),
             "process": f"{platform.node() or 'host'}:{self._pid}",
             "pid": self._pid,
             "clock": "us_since_process_epoch",
         }
+        if self.label:
+            # rank/process lane name for merged cohort traces
+            # (obs/cohort.merge_traces) and the /trace endpoint
+            md["label"] = self.label
+        return md
 
-    def export(self, path: str) -> int:
+    def export(self, path: str, label: Optional[str] = None) -> int:
         """Write the buffer as Chrome trace-event JSON (with the
         cross-process ``metadata`` anchor); returns the event count
-        written."""
+        written. ``label`` names this process's lane in a merged cohort
+        trace (e.g. ``"rank1"``) — mh workers pass their rank so N
+        ranks export collision-free ``trace-rank<r>.json`` files whose
+        lane identity rides IN the file, and the obs server's
+        ``/trace`` endpoint reports the same label."""
+        if label is not None:
+            self.label = str(label)  # concurrency: race-ok (export-time label stamp; all exports of one process agree on it)
         evs = self.events()
         with open(path, "w") as f:
             json.dump({"traceEvents": evs, "displayTimeUnit": "ms",

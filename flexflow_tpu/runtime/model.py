@@ -2031,6 +2031,13 @@ class FFModel:
         ledger_mode(self.config)      # same contract for the ledger knob
         attribution_mode(self.config)
         corpus_mode(self.config)
+        # cohort observability (obs/cohort.py): validated at entry like
+        # every mode knob; "on" arms the tracer — the fit.step spans ARE
+        # the cross-rank skew substrate the fit-tail export ships
+        from ..obs.cohort import cohort_obs_mode, maybe_export_cohort
+
+        if cohort_obs_mode(self.config) == "on":
+            configure_tracer(enabled=True)
         # fault plan: validated + armed before any step runs (zero cost
         # off: every site below is one global None-check)
         from . import faults as _fx
@@ -2348,6 +2355,10 @@ class FFModel:
         # durable telemetry: one ledger record per fit — throughput,
         # divergence block, attribution, watchdog state, metrics snapshot
         record_fit(self)
+        # cohort artifacts (config.cohort_obs; obs/cohort.py): this
+        # rank's labeled trace + metrics snapshot + manifest, for the
+        # supervisor's cross-rank merge/skew report
+        maybe_export_cohort(self)
         return history
 
     def eval(self, x, y, batch_size: Optional[int] = None, verbose: bool = True) -> PerfMetrics:

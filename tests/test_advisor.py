@@ -218,6 +218,58 @@ def test_rule_token_bucketing_prices_padded_flops():
                for s in rep3["suggestions"])
 
 
+def test_rule_rank_skew_golden():
+    """Golden: a skew-dominant cohort record (OBS003-bearing cohort
+    block, or a rank_skew-dominant cohort attribution table) maps to
+    elastic shrink of the straggler + steps_per_dispatch amortization,
+    both priced basis="measured" from the skew fraction."""
+    assert RULE_FAMILIES["rank_skew"] == ("elastic_shrink",
+                                          "multi_step_dispatch")
+    rec = _fit_rec("device_compute", knobs={"process_count": 4})
+    rec["cohort"] = {  # the supervisor-annotated skew verdict
+        "schema": 1, "ranks": [0, 1, 2, 3], "straggler_rank": 2,
+        "steady_skew_frac": 0.4, "threshold": 0.25,
+        "per_rank_mean_step_s": {"0": 0.01, "1": 0.01, "2": 0.014,
+                                 "3": 0.01},
+        "findings": [{"code": "OBS003", "severity": "warning",
+                      "message": "rank 2 is pacing the cohort"}],
+    }
+    rep = advise_record(rec)
+    skew = [s for s in rep["suggestions"] if s["phase"] == "rank_skew"]
+    assert {s["family"] for s in skew} == {"elastic_shrink",
+                                           "multi_step_dispatch"}
+    shrink = next(s for s in skew if s["family"] == "elastic_shrink")
+    assert shrink["knob"] == "process_count"
+    assert shrink["current"] == 4 and shrink["proposed"] == 3
+    assert shrink["expected"]["basis"] == "measured"
+    # priced FROM the measured skew fraction: 0.4 x the measured step
+    measured = rec["attribution"]["measured_step_s"]
+    assert shrink["expected"]["phase_delta_s"] == pytest.approx(
+        0.4 * measured, rel=1e-6)
+    assert "rank 2" in shrink["rationale"]
+    disp = next(s for s in skew if s["family"] == "multi_step_dispatch")
+    assert disp["knobs"] == {"steps_per_dispatch": 2}
+    assert disp["expected"]["basis"] == "measured"
+    # a clean cohort block (no OBS003, sub-threshold skew) stays silent
+    rec2 = _fit_rec("device_compute", knobs={"process_count": 4})
+    rec2["cohort"] = dict(rec["cohort"], findings=[],
+                          steady_skew_frac=0.05)
+    rep2 = advise_record(rec2)
+    assert all(s["phase"] != "rank_skew" for s in rep2["suggestions"])
+    # the other trigger: a cohort attribution table whose dominant
+    # phase IS rank_skew (no annotated block needed)
+    rec3 = _fit_rec("device_compute", knobs={"process_count": 2})
+    attr = rec3["attribution"]
+    attr["phases"]["rank_skew"] = {"seconds": 0.08, "fraction": 0.5,
+                                   "basis": "measured"}
+    attr["measured_step_s"] += 0.08
+    attr["dominant_phase"] = "rank_skew"
+    rep3 = advise_record(rec3)
+    skew3 = [s for s in rep3["suggestions"] if s["phase"] == "rank_skew"]
+    assert skew3 and skew3[0]["expected"]["phase_delta_s"] == \
+        pytest.approx(0.08, rel=1e-6)
+
+
 def test_serving_rules_map_phases_to_knob_families():
     for dominant, family, knob in (
             ("queue_wait", "decode_slots", "decode_slots"),

@@ -1,0 +1,518 @@
+"""Cohort observability: anchor-based trace unification, cross-rank
+skew attribution (OBS003), the cohort attribution table, the metrics
+roll-up, ledger back-fill, and the report tool/endpoint surfaces."""
+
+import importlib.util
+import json
+import os
+import types
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from flexflow_tpu.obs.cohort import (COHORT_PHASE, COHORT_SCHEMA,
+                                     annotate_ledger_with_skew,
+                                     build_cohort_report,
+                                     cohort_attribution, cohort_dir,
+                                     cohort_obs_mode,
+                                     merge_metric_snapshots,
+                                     merge_traces, rank_step_times,
+                                     skew_summary, step_skew)
+from flexflow_tpu.obs.metrics import MetricsRegistry
+from flexflow_tpu.obs.trace import validate_chrome_trace
+
+_TOOLS = os.path.join(os.path.dirname(__file__), os.pardir, "tools")
+
+
+def _tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_TOOLS, f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _rank_trace(path, anchor, durs_us, pid=4242, label=None):
+    """A synthetic per-rank export: sequential ``fit.step`` spans on one
+    (pid, tid) track + the PR 8 merge metadata block."""
+    evs, ts = [], 0.0
+    for d in durs_us:
+        evs.append({"name": "fit.step", "ph": "X", "ts": ts,
+                    "dur": float(d), "pid": pid, "tid": 7,
+                    "args": {"k": 1}})
+        ts += d + 100.0
+    payload = {"traceEvents": evs, "displayTimeUnit": "ms",
+               "metadata": {"wall_clock_anchor_unix_s": float(anchor),
+                            "process": "ff:train",
+                            **({"label": label} if label else {})}}
+    with open(str(path), "w") as f:
+        json.dump(payload, f)
+    return payload
+
+
+def _attr(measured, dominant="device_compute"):
+    phases = {"input_wait": {"seconds": 0.1 * measured,
+                             "basis": "measured"},
+              dominant: {"seconds": 0.9 * measured, "basis": "modeled"}}
+    return {"measured_step_s": measured, "dominant_phase": dominant,
+            "phases": phases, "phase_order": ["input_wait", dominant]}
+
+
+def _seed_cohort_dir(d, durs_by_rank, anchors=None, threshold=0.25):
+    """Write trace/metrics/manifest triplets for each rank — the layout
+    ``export_rank_artifacts`` produces."""
+    os.makedirs(str(d), exist_ok=True)
+    anchors = anchors or {}
+    for r, durs in durs_by_rank.items():
+        _rank_trace(os.path.join(str(d), f"trace-rank{r}.json"),
+                    anchors.get(r, 100.0 + 0.25 * r), durs,
+                    label=f"rank{r}")
+        reg = MetricsRegistry()
+        reg.counter("fit.steps").inc(len(durs))
+        with open(os.path.join(str(d), f"metrics-rank{r}.json"),
+                  "w") as f:
+            json.dump(reg.to_json(), f)
+        mean_s = sum(durs) / len(durs) / 1e6
+        manifest = {"schema": COHORT_SCHEMA, "rank": r,
+                    "process_count": len(durs_by_rank),
+                    "ts_unix_s": 100.0,
+                    "trace": f"trace-rank{r}.json",
+                    "trace_events": len(durs),
+                    "metrics": f"metrics-rank{r}.json",
+                    "attribution": _attr(mean_s),
+                    "skew_threshold": threshold}
+        with open(os.path.join(str(d), f"cohort-rank{r}.json"),
+                  "w") as f:
+            json.dump(manifest, f)
+
+
+# ------------------------------------------------------ trace unification
+def test_merge_traces_rebases_onto_one_timeline(tmp_path):
+    p0 = tmp_path / "trace-rank0.json"
+    p1 = tmp_path / "trace-rank1.json"
+    _rank_trace(p0, anchor=100.0, durs_us=[10000, 10000], pid=111,
+                label="rank0")
+    _rank_trace(p1, anchor=100.5, durs_us=[10000, 10000], pid=111,
+                label="rank1")
+    out = tmp_path / "trace-cohort.json"
+    merged = merge_traces([str(p0), str(p1)], out=str(out))
+    # round-trip: the written file IS the returned payload, and both
+    # pass the validator (uniform shift preserves per-track nesting)
+    assert validate_chrome_trace(merged) == []
+    with open(str(out)) as f:
+        assert json.load(f) == json.loads(json.dumps(merged))
+    # one process lane per source rank, even though both source traces
+    # used the SAME os pid (the collision merge_traces exists to fix)
+    spans = [ev for ev in merged["traceEvents"] if ev.get("ph") == "X"]
+    assert sorted({ev["pid"] for ev in spans}) == [0, 1]
+    # rank 1's events shifted by its 0.5 s anchor drift
+    r0 = min(ev["ts"] for ev in spans if ev["pid"] == 0)
+    r1 = min(ev["ts"] for ev in spans if ev["pid"] == 1)
+    assert r1 - r0 == pytest.approx(0.5e6, abs=1.0)
+    md = merged["metadata"]
+    assert md["wall_clock_anchor_unix_s"] == 100.0
+    assert md["process"] == "cohort:2ranks"
+    assert md["ranks"]["0"]["drift_s"] == 0.0
+    assert md["ranks"]["1"]["drift_s"] == pytest.approx(0.5)
+    assert md["ranks"]["1"]["label"] == "rank1"
+    assert md["ranks"]["1"]["source_pids"] == [111]
+    # lane naming rides Perfetto process_name metadata events
+    names = {ev["pid"]: ev["args"]["name"]
+             for ev in merged["traceEvents"]
+             if ev.get("ph") == "M" and ev["name"] == "process_name"}
+    assert names == {0: "rank0", 1: "rank1"}
+
+
+def test_merge_traces_rejects_anchorless_trace(tmp_path):
+    p = tmp_path / "t.json"
+    with open(str(p), "w") as f:
+        json.dump({"traceEvents": [], "metadata": {"process": "x"}}, f)
+    with pytest.raises(ValueError, match="wall_clock_anchor_unix_s"):
+        merge_traces([str(p)])
+    with pytest.raises(ValueError, match="no trace paths"):
+        merge_traces([])
+
+
+def test_rank_step_times_expands_multi_step_dispatch():
+    evs = [{"name": "fit.step", "ph": "X", "ts": 5e6, "dur": 4e6,
+            "pid": 1, "tid": 1, "args": {"k": 4}},
+           {"name": "fit.step", "ph": "X", "ts": 0.0, "dur": 2e6,
+            "pid": 1, "tid": 1, "args": {"k": 2}},
+           {"name": "other", "ph": "X", "ts": 0.0, "dur": 9e6,
+            "pid": 1, "tid": 2}]
+    # k-spans expand to k equal steps, ordered by ts regardless of
+    # input order; non-step spans are ignored
+    assert rank_step_times(evs) == [1.0, 1.0, 1.0, 1.0, 1.0, 1.0]
+    assert rank_step_times({"traceEvents": []}) == []
+
+
+# ----------------------------------------------------- skew attribution
+def test_step_skew_names_straggler_and_fires_obs003():
+    skew = step_skew({0: [0.010] * 6, 1: [0.010] * 6, 2: [0.015] * 6})
+    assert skew["ranks"] == [0, 1, 2] and skew["steps"] == 6
+    # 3-rank median is robust to the single outlier rank: the baseline
+    # stays at 0.010 even though rank 2 is 50% slower every step
+    assert skew["per_step"][0]["median_s"] == pytest.approx(0.010)
+    assert skew["steady_skew_frac"] == pytest.approx(0.5)
+    assert skew["straggler_rank"] == 2
+    assert skew["per_rank"]["2"]["slowest_count"] == 5  # steady steps
+    [f] = skew["findings"]
+    assert f["code"] == "OBS003" and f["severity"] == "warning"
+    assert "rank 2" in f["message"]
+
+
+def test_step_skew_clean_cohort_zero_findings():
+    skew = step_skew({0: [0.01, 0.01, 0.01], 1: [0.01, 0.01, 0.01]})
+    assert skew["steady_skew_frac"] == pytest.approx(0.0)
+    assert skew["findings"] == []
+    # sub-threshold skew stays silent; the same skew over a tighter
+    # threshold fires — the config.cohort_skew_threshold contract
+    series = {0: [0.010] * 4, 1: [0.012] * 4}  # 2-rank mean baseline
+    assert step_skew(series, threshold=0.5)["findings"] == []
+    fired = step_skew(series, threshold=0.05)
+    assert fired["findings"] and fired["straggler_rank"] == 1
+
+
+def test_step_skew_degenerate_cohorts():
+    assert step_skew({0: [0.01, 0.01]}) is None  # one rank: no cohort
+    assert step_skew({0: [], 1: [0.01]}) is None  # zero aligned steps
+    # ragged series align on the common prefix, never misalign
+    skew = step_skew({0: [0.01] * 5, 1: [0.01] * 3})
+    assert skew["steps"] == 3
+
+
+def test_cohort_attribution_telescopes_with_rank_skew(tmp_path):
+    per_rank = {0: _attr(0.010), 1: _attr(0.016), 2: _attr(0.011)}
+    rec = cohort_attribution(per_rank)
+    assert rec["kind"] == "cohort" and rec["ranks"] == [0, 1, 2]
+    # cohort paces at its slowest rank; the base table is the median
+    # rank's (0.011 is closest to the median step)
+    assert rec["measured_step_s"] == pytest.approx(0.016)
+    assert rec["base_rank"] == 2
+    assert rec["phase_order"][-1] == COHORT_PHASE
+    row = rec["phases"][COHORT_PHASE]
+    assert row["basis"] == "measured"
+    assert row["seconds"] == pytest.approx(0.016 - 0.011)
+    recon = rec["reconciliation"]
+    assert recon["reconciles"] and recon["error"] <= 0.02
+    assert abs(recon["phase_sum_s"] / recon["measured_step_s"] - 1.0) \
+        <= 0.02
+    assert rec["dominant_phase"] in ("device_compute", COHORT_PHASE)
+    # no usable per-rank record -> no table
+    assert cohort_attribution({}) is None
+    assert cohort_attribution({0: {"phases": {}}}) is None
+
+
+# ------------------------------------------------------ metrics roll-up
+def test_merge_metric_snapshots_matches_registry_merge():
+    a, b = MetricsRegistry(), MetricsRegistry()
+    a.counter("fit.steps").inc(4)
+    b.counter("fit.steps").inc(8)
+    a.gauge("mem").set(1.0)
+    b.gauge("mem").set(2.0)
+    for v in (0.1, 0.2):
+        a.histogram("lat").observe(v)
+    b.histogram("lat").observe(0.4)
+    via_docs = merge_metric_snapshots(
+        [a.to_json(), b.to_json(), "not-a-doc", None])
+    manual = MetricsRegistry()
+    manual.merge(MetricsRegistry.from_json(a.to_json()))
+    manual.merge(MetricsRegistry.from_json(b.to_json()))
+    assert via_docs == manual.to_json()
+    assert via_docs["fit.steps"] == 12
+
+
+# ----------------------------------------------------------- knob guards
+def test_cohort_obs_mode_and_dir_resolution(monkeypatch):
+    ns = types.SimpleNamespace
+    assert cohort_obs_mode(ns(cohort_obs="on")) == "on"
+    assert cohort_obs_mode(ns(cohort_obs="off")) == "off"
+    assert cohort_obs_mode(ns()) == "off"  # absent = off
+    with pytest.raises(ValueError, match="cohort_obs"):
+        cohort_obs_mode(ns(cohort_obs="onn"))  # typo fails loudly
+    monkeypatch.delenv("FLEXFLOW_TPU_COHORT_DIR", raising=False)
+    assert cohort_dir() == ".ffcache/obs/cohort"
+    monkeypatch.setenv("FLEXFLOW_TPU_COHORT_DIR", "/tmp/env-cohort")
+    assert cohort_dir() == "/tmp/env-cohort"
+    assert cohort_dir(ns(cohort_obs_dir="/tmp/knob")) == "/tmp/knob"
+
+
+def test_config_carries_cohort_knobs():
+    from flexflow_tpu import FFConfig
+
+    cfg = FFConfig(batch_size=8, cohort_obs="on",
+                   cohort_skew_threshold=0.4, cohort_obs_dir="/tmp/x")
+    assert cohort_obs_mode(cfg) == "on"
+    assert cfg.cohort_skew_threshold == pytest.approx(0.4)
+    assert cohort_dir(cfg) == "/tmp/x"
+    assert cohort_obs_mode(FFConfig(batch_size=8)) == "off"
+
+
+# -------------------------------------------------- fleet-level report
+def test_build_cohort_report_names_seeded_straggler(tmp_path):
+    d = tmp_path / "cohort"
+    # rank 1 runs every step 3x slower: skew frac 0.5 on the 2-rank
+    # mean baseline, over the 0.25 threshold
+    _seed_cohort_dir(d, {0: [10000] * 4, 1: [30000] * 4})
+    report = build_cohort_report(str(d))
+    assert report["ranks"] == [0, 1] and "error" not in report
+    assert report["merged_trace_valid"]
+    assert report["merged_trace_problems"] == []
+    assert report["lanes"] == [0, 1]
+    assert os.path.exists(os.path.join(str(d), "trace-cohort.json"))
+    assert report["anchor_drift_s"]["1"] == pytest.approx(0.25)
+    assert report["straggler_rank"] == 1
+    assert report["steady_skew_frac"] == pytest.approx(0.5)
+    assert [f["code"] for f in report["findings"]] == ["OBS003"]
+    attr = report["attribution"]
+    assert attr["kind"] == "cohort" and COHORT_PHASE in attr["phases"]
+    assert attr["reconciliation"]["reconciles"]
+    assert report["metrics"]["fit.steps"] == 8
+    # the report publishes to the obs-server /cohort slot
+    from flexflow_tpu.obs.server import latest_cohort
+
+    assert latest_cohort()["straggler_rank"] == 1
+
+
+def test_build_cohort_report_clean_and_degenerate(tmp_path):
+    d = tmp_path / "clean"
+    _seed_cohort_dir(d, {0: [10000] * 4, 1: [10000] * 4})
+    report = build_cohort_report(str(d), write_merged=False)
+    assert report["findings"] == []  # clean cohort: zero OBS003
+    assert report["merged_trace"] is None
+    assert not os.path.exists(os.path.join(str(d), "trace-cohort.json"))
+    # corrupt + foreign-schema manifests demote to counted skips
+    with open(os.path.join(str(d), "cohort-rank7.json"), "w") as f:
+        f.write("{not json")
+    with open(os.path.join(str(d), "cohort-rank8.json"), "w") as f:
+        json.dump({"schema": 99, "rank": 8}, f)
+    report = build_cohort_report(str(d), write_merged=False)
+    assert report["ranks"] == [0, 1]
+    assert report["corrupt_manifests"] == 1
+    assert report["skipped_schema"] == 1
+    # an empty directory is an error report, not a crash
+    empty = build_cohort_report(str(tmp_path / "nope"))
+    assert empty["ranks"] == [] and "no cohort-rank" in empty["error"]
+
+
+def test_cohort_report_tool_one_json_line(tmp_path, capsys):
+    tool = _tool("cohort_report")
+    d = tmp_path / "cohort"
+    _seed_cohort_dir(d, {0: [10000] * 4, 1: [30000] * 4})
+    assert tool.main(["--dir", str(d)]) == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1  # the one-JSON-line tool contract
+    doc = json.loads(out[0])
+    assert doc["exit"] == 0 and doc["straggler_rank"] == 1
+    # an empty cohort dir is exit 1 with the error named
+    assert tool.main(["--dir", str(tmp_path / "nope")]) == 1
+    doc = json.loads(capsys.readouterr().out.strip())
+    assert doc["exit"] == 1 and doc["error"]
+
+
+# ------------------------------------------------- ledger back-fill
+def test_annotate_ledger_with_skew_roundtrip(tmp_path):
+    report = {"skew": {
+        "ranks": [0, 1], "straggler_rank": 1, "steady_skew_frac": 0.5,
+        "threshold": 0.25,
+        "per_rank": {"0": {"mean_step_s": 0.01},
+                     "1": {"mean_step_s": 0.03}},
+        "findings": [{"code": "OBS003", "severity": "warning",
+                      "message": "m"}]}}
+    summary = skew_summary(report)
+    assert summary["straggler_rank"] == 1
+    assert summary["per_rank_mean_step_s"] == {"0": 0.01, "1": 0.03}
+    assert skew_summary({"skew": None}) is None
+    d = tmp_path / "ledger"
+    os.makedirs(str(d))
+    recs = [
+        {"schema": 1, "kind": "fit", "run_id": "multi",
+         "knobs": {"process_count": 2}},
+        {"schema": 1, "kind": "fit", "run_id": "solo",
+         "knobs": {"process_count": 1}},
+        {"schema": 1, "kind": "fit", "run_id": "already",
+         "knobs": {"process_count": 2}, "cohort": {"straggler_rank": 0}},
+        {"schema": 1, "kind": "compile", "run_id": "c",
+         "knobs": {"process_count": 2}},
+    ]
+    with open(os.path.join(str(d), "runs-t.jsonl"), "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+        f.write("{corrupt line\n")
+    assert annotate_ledger_with_skew(str(d), report) == 1
+    with open(os.path.join(str(d), "runs-t.jsonl")) as f:
+        lines = f.read().splitlines()
+    assert lines[-1] == "{corrupt line"  # corrupt lines pass through
+    docs = {}
+    for line in lines[:-1]:
+        doc = json.loads(line)
+        docs[doc["run_id"]] = doc
+    # only the multi-rank fit record WITHOUT a cohort block gets stamped
+    assert docs["multi"]["cohort"]["straggler_rank"] == 1
+    assert "cohort" not in docs["solo"]
+    assert docs["already"]["cohort"] == {"straggler_rank": 0}
+    assert "cohort" not in docs["c"]
+    # idempotent: a second pass annotates nothing
+    assert annotate_ledger_with_skew(str(d), report) == 0
+    # no skew table / missing dir: a no-op, never a crash
+    assert annotate_ledger_with_skew(str(d), {"skew": None}) == 0
+    assert annotate_ledger_with_skew(str(tmp_path / "nope"), report) == 0
+
+
+# ------------------------------------------------------- obs endpoints
+def test_cohort_endpoint_404_then_report(tmp_path):
+    import flexflow_tpu.obs.server as server_mod
+    from flexflow_tpu.obs.server import ObsServer, publish_cohort
+
+    # earlier tests may have published a report into the process-wide
+    # slot — start from the pre-first-report state
+    with server_mod._attr_mu:
+        server_mod._LATEST_COHORT = None
+    srv = ObsServer(port=0)
+    port = srv.start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/cohort", timeout=10)
+        assert ei.value.code == 404
+        publish_cohort({"schema": COHORT_SCHEMA, "ranks": [0, 1],
+                        "straggler_rank": 1, "findings": []})
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/cohort", timeout=10) as r:
+            doc = json.loads(r.read())
+        assert doc["straggler_rank"] == 1 and doc["ranks"] == [0, 1]
+    finally:
+        srv.stop()
+
+
+# -------------------------------------------------- fit-tail export hook
+def test_fit_exports_rank_artifacts_under_cohort_obs(tmp_path,
+                                                    monkeypatch):
+    monkeypatch.setenv("FLEXFLOW_TPU_LEDGER_DIR",
+                       str(tmp_path / "ledger"))
+    from flexflow_tpu import (ActiMode, DataType, FFConfig, FFModel,
+                              LossType, SGDOptimizer)
+
+    d = tmp_path / "cohort"
+    cfg = FFConfig(batch_size=16, seed=0, cohort_obs="on",
+                   cohort_obs_dir=str(d), cohort_skew_threshold=0.3)
+    ff = FFModel(cfg)
+    x = ff.create_tensor((16, 16), DataType.FLOAT, name="coh_x")
+    t = ff.dense(x, 16, ActiMode.RELU, name="coh_fc")
+    t = ff.dense(t, 4, name="coh_head")
+    ff.softmax(t, name="coh_sm")
+    ff.compile(optimizer=SGDOptimizer(lr=0.05),
+               loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+               metrics=[])
+    rng = np.random.default_rng(0)
+    xs = rng.normal(size=(64, 16)).astype(np.float32)
+    ys = rng.integers(0, 4, size=(64, 1)).astype(np.int32)
+    ff.fit(xs, ys, epochs=2, verbose=False)
+    # this rank's triplet landed in the cohort dir, collision-free
+    for fn in ("trace-rank0.json", "metrics-rank0.json",
+               "cohort-rank0.json"):
+        assert os.path.exists(os.path.join(str(d), fn)), fn
+    with open(os.path.join(str(d), "cohort-rank0.json")) as f:
+        manifest = json.load(f)
+    assert manifest["rank"] == 0 and manifest["schema"] == COHORT_SCHEMA
+    assert manifest["skew_threshold"] == pytest.approx(0.3)
+    assert manifest["trace_events"] > 0
+    assert manifest["attribution"]  # the PR 10 table rides the manifest
+    # the exported trace is merge-ready: anchored + labeled
+    with open(os.path.join(str(d), "trace-rank0.json")) as f:
+        trace = json.load(f)
+    assert validate_chrome_trace(trace) == []
+    assert trace["metadata"]["label"] == "rank0"
+    assert any(ev.get("name") == "fit.step"
+               for ev in trace["traceEvents"])
+    assert (ff.fit_profile or {}).get("cohort_export", {}).get(
+        "trace") == "trace-rank0.json"
+    # a single-rank directory still builds a report: no skew (nothing
+    # to skew against), no error, valid merged trace
+    report = build_cohort_report(str(d))
+    assert report["ranks"] == [0] and "error" not in report
+    assert report["merged_trace_valid"] and report["skew"] is None
+    # cohort_obs=off exports nothing (the mode-gate contract)
+    d2 = tmp_path / "off"
+    cfg2 = FFConfig(batch_size=16, seed=0, cohort_obs="off",
+                    cohort_obs_dir=str(d2))
+    ff2 = FFModel(cfg2)
+    x2 = ff2.create_tensor((16, 16), DataType.FLOAT, name="coh2_x")
+    t2 = ff2.dense(x2, 4, name="coh2_fc")
+    ff2.softmax(t2, name="coh2_sm")
+    ff2.compile(optimizer=SGDOptimizer(lr=0.05),
+                loss_type=LossType.SPARSE_CATEGORICAL_CROSSENTROPY,
+                metrics=[])
+    ff2.fit(xs, ys, epochs=1, verbose=False)
+    assert not os.path.exists(str(d2))
+
+
+# -------------------------------------------------- explain_run narration
+def test_explain_run_narrates_cohort_and_exit_contract(tmp_path):
+    tool = _tool("explain_run")
+    good = {"schema": 1, "kind": "fit", "run_id": "good",
+            "ts_unix_s": 1.0, "pid": 1, "machine": {"backend": "cpu"},
+            "model_sig": "m", "mesh": {"data": 2},
+            "knobs": {"process_count": 2},
+            "perf": {"metric": "fit.steps_per_s", "value": 10.0,
+                     "higher_is_better": True},
+            "cohort": {"schema": 1, "ranks": [0, 1],
+                       "straggler_rank": 1, "steady_skew_frac": 0.5,
+                       "threshold": 0.25,
+                       "per_rank_mean_step_s": {"0": 0.01, "1": 0.03},
+                       "findings": [{"code": "OBS003",
+                                     "severity": "warning",
+                                     "message": "rank 1 paces"}]}}
+    # a multi-rank record whose cohort block LOST its skew surface is
+    # the exit-1 contract; a record with NO cohort block at all is fine
+    # (pre-cohort corpora and cohort_obs=off runs never start failing)
+    lost = dict(good, run_id="lost",
+                cohort={"schema": 1, "ranks": [0, 1]})
+    absent = {k: v for k, v in good.items() if k != "cohort"}
+    absent["run_id"] = "absent"
+    d = tmp_path / "ledger"
+    os.makedirs(str(d))
+    with open(os.path.join(str(d), "runs-t.jsonl"), "w") as f:
+        for r in (good, lost, absent):
+            f.write(json.dumps(r) + "\n")
+    doc = tool.explain(run_id="good", ledger_dir=str(d))
+    cs = doc["cohort_skew"]
+    assert cs["straggler_rank"] == 1
+    assert cs["steady_skew_frac"] == pytest.approx(0.5)
+    assert doc["exit"] == 0
+    text = tool._render_text(doc)
+    assert "straggler rank 1" in text and "OBS003" in text
+    doc = tool.explain(run_id="lost", ledger_dir=str(d))
+    assert doc["exit"] == 1 and doc["cohort_skew"]["error"]
+    assert "skew" in tool._render_text(doc)
+    doc = tool.explain(run_id="absent", ledger_dir=str(d))
+    assert doc["exit"] == 0 and doc["cohort_skew"] is None
+
+
+# ---------------------------------------------------- sentinel straggler
+def test_perf_sentinel_cohort_rows_carry_straggler_rank(tmp_path):
+    sentinel = _tool("perf_sentinel")
+    base = {"schema": 1, "kind": "fit", "pid": 1,
+            "machine": {"backend": "cpu"}, "model_sig": "m",
+            "n_ops": 4, "mesh": {"data": 2},
+            "knobs": {"process_count": 2},
+            "perf": {"metric": "fit.steps_per_s", "value": 10.0,
+                     "higher_is_better": True}}
+    old = dict(base, run_id="old", ts_unix_s=1.0)
+    new = dict(base, run_id="new", ts_unix_s=2.0,
+               perf=dict(base["perf"], value=4.0),
+               cohort={"straggler_rank": 1, "steady_skew_frac": 0.5})
+    d = tmp_path / "runs"
+    os.makedirs(str(d))
+    with open(os.path.join(str(d), "runs-t.jsonl"), "w") as f:
+        for r in (old, new):
+            f.write(json.dumps(r) + "\n")
+    report = sentinel.run_sentinel(ledger_dir=str(d), min_baseline=1)
+    rows = [r for r in report["cohorts"]
+            if r.get("straggler_rank") is not None]
+    # the regression row names WHICH rank paced the cohort, the same
+    # contract dominant_phase follows
+    assert rows and rows[0]["straggler_rank"] == 1
+    assert rows[0]["verdict"] == "regression"

@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""Fleet-level cohort report: one JSON line from per-rank artifacts.
+
+Reads a cohort directory of per-rank exports (``trace-rank<r>.json``,
+``metrics-rank<r>.json``, ``cohort-rank<r>.json`` manifests — what fits
+under ``config.cohort_obs=on`` write, and what ``tools/mh_launch.py
+--cohort-obs`` collects per run) and folds them through
+``flexflow_tpu.obs.cohort.build_cohort_report``:
+
+* merged Chrome trace (``trace-cohort.json``, one process lane per
+  rank, re-based on the PR 8 wall-clock anchors) + its
+  ``validate_chrome_trace`` verdict,
+* the cross-rank skew table — per-step skew, straggler rank,
+  steady-state skew fraction, OBS003 findings,
+* the cohort attribution table (the PR 10 phase table + ``rank_skew``)
+  and the merged metrics roll-up.
+
+Exit 1 when: the directory holds no usable manifests, the merged trace
+fails validation, or a multi-rank cohort produced no skew table (two
+ranks that exported traces MUST yield a skew verdict — losing it is a
+pipeline bug, not an empty result).
+
+Usage::
+
+    python tools/cohort_report.py                      # default dir
+    python tools/cohort_report.py --dir /run/cohort --threshold 0.4
+    python tools/cohort_report.py --no-merged          # skip trace write
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    from flexflow_tpu.obs.cohort import build_cohort_report, cohort_dir
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--dir", default=None,
+                    help="cohort artifact directory (default: the "
+                         "cohort_obs_dir resolution — knob > "
+                         "FLEXFLOW_TPU_COHORT_DIR > .ffcache/obs/cohort)")
+    ap.add_argument("--threshold", type=float, default=None,
+                    help="steady-state skew fraction that fires OBS003 "
+                         "(default: the threshold rank 0's manifest was "
+                         "configured with)")
+    ap.add_argument("--no-merged", action="store_true",
+                    help="skip writing trace-cohort.json (report only)")
+    ns = ap.parse_args(argv)
+    report = build_cohort_report(ns.dir or cohort_dir(),
+                                 threshold=ns.threshold,
+                                 write_merged=not ns.no_merged)
+    bad = bool(report.get("error"))
+    if not bad and not report.get("merged_trace_valid"):
+        bad = True
+    # a multi-rank cohort whose traces produced NO skew table lost its
+    # verdict somewhere between export and alignment — fail loudly
+    if not bad and len(report.get("ranks") or []) >= 2 \
+            and not report.get("skew"):
+        bad = True
+        report["error"] = (f"{len(report['ranks'])}-rank cohort yielded "
+                           f"no skew table — per-rank traces carry no "
+                           f"alignable fit.step spans")
+    report["exit"] = 1 if bad else 0
+    print(json.dumps(report, sort_keys=True, default=str))
+    return report["exit"]
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -15,6 +15,9 @@ or ONE JSON line (``--json``)::
      "cohort": {"runs": N, "baseline": ..., "ratio": ..., "verdict": ...,
                 "best_prior": {"run_id": ..., "value": ...,
                                "knob_diff": {knob: {"this","best"}}}},
+     "cohort_skew": {"ranks": [...], "straggler_rank": ...,
+                     "steady_skew_frac": ..., "per_rank_mean_step_s":
+                     {...}, "findings": [...]},
      "advice": {"dominant_phase": ..., "suggestions": [...]},
      "advisor_experiments": [{"verdict": "accepted"|"rejected", ...}],
      "exit": 0}
@@ -232,6 +235,10 @@ def explain(run_id: Optional[str] = None,
         "guard": rec.get("guard"),
         "faults": rec.get("faults"),
         "cohort": _cohort_trend(rec, runs),
+        # cross-rank skew verdict (obs/cohort.py): the mh supervisor
+        # back-fills this onto merged multi-rank fit records — distinct
+        # from the sentinel-trend "cohort" block above
+        "cohort_skew": _cohort_skew_block(rec),
         "advice": _advice_block(rec),
         "advisor_experiments": _experiments_for(rec, runs),
         "ledger": {"dir": ledger_dir or _ledger_dir(),
@@ -247,13 +254,45 @@ def explain(run_id: Optional[str] = None,
     # its per-phase percentiles (queue_wait/prefill/decode) broke the
     # engine's observability contract — same severity as a
     # non-reconciling phase table.
+    # A multi-rank record that CARRIES a cohort block (cohort_obs ran)
+    # but lost its skew surface (no steady fraction / fewer than two
+    # ranks) broke the cohort-observability contract the same way.
+    cs = rec.get("cohort")
+    pc = (rec.get("knobs") or {}).get("process_count") or 1
+    bad_cohort = bool(
+        isinstance(cs, dict) and pc > 1
+        and (not isinstance(cs.get("steady_skew_frac"), (int, float))
+             or len(cs.get("ranks") or []) < 2))
+    if bad_cohort:
+        doc["cohort_skew"] = {
+            "error": f"multi-rank record (process_count {pc}) carries a "
+                     f"cohort block without a usable skew surface — the "
+                     f"supervisor's annotation lost its verdict (exit 1)"}
     bad_attr = bool(attr and rcn and not rcn.get("reconciles"))
     bad_serving = bool(serving
                        and serving.get("missing_phase_percentiles"))
     doc["exit"] = 1 if (bad_attr
                         or (envelope or {}).get("silent_fallback")
-                        or bad_serving) else 0
+                        or bad_serving or bad_cohort) else 0
     return doc
+
+
+def _cohort_skew_block(rec: Dict) -> Optional[Dict]:
+    """The record's cross-rank skew verdict (the compact block
+    ``obs.cohort.annotate_ledger_with_skew`` stamped on): straggler
+    rank, steady skew fraction, per-rank step-time spread, OBS003
+    findings. None when the record never ran under cohort_obs."""
+    cs = rec.get("cohort")
+    if not isinstance(cs, dict):
+        return None
+    return {
+        "ranks": cs.get("ranks"),
+        "straggler_rank": cs.get("straggler_rank"),
+        "steady_skew_frac": cs.get("steady_skew_frac"),
+        "threshold": cs.get("threshold"),
+        "per_rank_mean_step_s": cs.get("per_rank_mean_step_s"),
+        "findings": cs.get("findings"),
+    }
 
 
 def _advice_block(rec: Dict) -> Optional[Dict]:
@@ -513,6 +552,21 @@ def _render_text(doc: Dict) -> str:
             lines.append(
                 f"vs best prior ({bp['run_id']}, value {bp['value']}): "
                 f"same knobs — the delta is code or machine state")
+    ck = doc.get("cohort_skew")
+    if ck and ck.get("error"):
+        lines.append(f"cohort skew: {ck['error']}")
+    elif ck:
+        spread = ", ".join(
+            f"r{r}={v:.6f}s" if isinstance(v, (int, float)) else f"r{r}=?"
+            for r, v in sorted((ck.get("per_rank_mean_step_s")
+                                or {}).items(), key=lambda kv: kv[0]))
+        lines.append(
+            f"cohort skew ({len(ck.get('ranks') or [])} ranks): "
+            f"straggler rank {ck.get('straggler_rank')}, steady skew "
+            f"fraction {ck.get('steady_skew_frac')} (threshold "
+            f"{ck.get('threshold')}); per-rank mean step {spread}")
+        for f in ck.get("findings") or []:
+            lines.append(f"  {f.get('code')}: {f.get('message')}")
     adv = doc.get("advice")
     if adv and adv.get("suggestions"):
         lines.append(f"advice (dominant phase {adv.get('dominant_phase')}):")

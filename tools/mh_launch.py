@@ -254,6 +254,11 @@ def run_worker(ns) -> int:
         cfg_kw.update(
             watchdog="on", watchdog_threshold_s=ns.watchdog_threshold,
             watchdog_dir=os.path.join(ns.run_dir, f"blackbox-r{ns.rank}"))
+    if ns.cohort_obs:
+        # per-rank cohort artifacts (obs/cohort.py): the artifact dir
+        # rides in via FLEXFLOW_TPU_COHORT_DIR (set by _spawn, per-run)
+        cfg_kw.update(cohort_obs="on",
+                      cohort_skew_threshold=ns.cohort_threshold)
     cfg = FFConfig(**cfg_kw)
     local = len(jax.local_devices())
     spec = two_level_mesh_spec(max(1, ns.nproc), local)
@@ -369,7 +374,9 @@ def _spawn(rank: int, nproc: int, coord: str, run_dir: str, ckpt_dir: str,
            init_timeout: float, watchdog_threshold: float,
            fault_plan: Optional[Dict], attempt: int,
            no_search: bool = False,
-           launch_id: Optional[str] = None) -> Dict:
+           launch_id: Optional[str] = None,
+           cohort_obs: bool = False,
+           cohort_threshold: float = 0.25) -> Dict:
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={devices}"
@@ -386,6 +393,11 @@ def _spawn(rank: int, nproc: int, coord: str, run_dir: str, ckpt_dir: str,
     # coordinator folds them into a cohort corpus after the run
     env["FLEXFLOW_TPU_COSTCORPUS_DIR"] = os.path.join(
         run_dir, "costcorpus", f"rank-{rank}")
+    if cohort_obs:
+        # one shared cohort dir: rank collisions are impossible — every
+        # artifact filename carries the rank (trace-rank<r>.json etc.),
+        # and the supervisor's build_cohort_report scans exactly here
+        env["FLEXFLOW_TPU_COHORT_DIR"] = os.path.join(run_dir, "cohort")
     env["PYTHONPATH"] = os.pathsep.join(
         filter(None, [_REPO, env.get("PYTHONPATH")]))
     # a wedged worker killed by the supervisor should leave thread
@@ -400,6 +412,9 @@ def _spawn(rank: int, nproc: int, coord: str, run_dir: str, ckpt_dir: str,
            "--watchdog-threshold", str(watchdog_threshold)]
     if no_search:
         cmd += ["--no-search"]
+    if cohort_obs:
+        cmd += ["--cohort-obs", "--cohort-threshold",
+                str(cohort_threshold)]
     if fault_plan is not None:
         cmd += ["--fault-plan", json.dumps(fault_plan)]
     logs = os.path.join(run_dir, "logs")
@@ -506,7 +521,9 @@ def supervise(nproc: int = 2, run_dir: Optional[str] = None,
               init_timeout_s: float = 60.0,
               cohort_timeout_s: float = 420.0,
               cache_dir: Optional[str] = None,
-              no_search: bool = False) -> Dict:
+              no_search: bool = False,
+              cohort_obs: bool = False,
+              cohort_threshold: float = 0.25) -> Dict:
     """Launch and heal one cohort; returns the supervisor report.
 
     The fault plan goes ONLY to ``fault_rank`` and ONLY on the first
@@ -557,7 +574,8 @@ def supervise(nproc: int = 2, run_dir: Optional[str] = None,
                    watchdog_threshold_s,
                    fault_plan if (attempt == 0 and r == fault_rank)
                    else None, attempt, no_search=no_search,
-                   launch_id=launch_id)
+                   launch_id=launch_id, cohort_obs=cohort_obs,
+                   cohort_threshold=cohort_threshold)
             for r in range(nproc)
         ]
         status = _monitor(workers, run_dir, hb_dir, hang_threshold_s,
@@ -644,6 +662,24 @@ def supervise(nproc: int = 2, run_dir: Optional[str] = None,
     if any_corpus:
         report["cost_corpus"] = {"cohort_dir": corpus_cohort,
                                  "merged": corpus_merged}
+    if cohort_obs:
+        # fleet-level observability roll-up: merge every rank's labeled
+        # trace onto one timeline, name the straggler, telescope the
+        # cohort attribution table (obs/cohort.build_cohort_report)
+        from flexflow_tpu.obs.cohort import (annotate_ledger_with_skew,
+                                             build_cohort_report)
+
+        try:
+            report["cohort"] = build_cohort_report(
+                os.path.join(run_dir, "cohort"),
+                threshold=cohort_threshold)
+            # back-fill the skew verdict onto the merged cohort-ledger
+            # fit records: the sentinel's straggler_rank column and
+            # explain_run's narration read it from there
+            report["cohort"]["ledger_annotated"] = \
+                annotate_ledger_with_skew(cohort_dir, report["cohort"])
+        except Exception as exc:  # noqa: BLE001 — obs must not fail the run
+            report["cohort"] = {"error": f"cohort report failed: {exc}"}
     return report
 
 
@@ -869,16 +905,115 @@ def _sc_init_retry_exclusion(ctx, violations) -> Dict:
     return row
 
 
+def _sc_cohort_baseline(ctx, violations) -> Dict:
+    """Clean cohort under cohort_obs=on: the merged trace must validate
+    with one lane per rank, zero OBS003 findings, and a telescoping
+    cohort attribution table with rank_skew as a phase. Threshold 0.75
+    (not the 0.25 default): a 2-rank median degrades to the mean,
+    millisecond CPU steps + checkpoint-boundary jitter measure ~0.23
+    steady skew on a clean shared box, and a clean run must not fire a
+    straggler finding."""
+    rep = supervise(nproc=ctx["nproc"], run_dir=os.path.join(
+        ctx["base"], "cohort_base"), devices_per_proc=ctx["devices"],
+        cache_dir=ctx["cache"], max_relaunches=0, interval=0,
+        cohort_timeout_s=ctx["timeout"], cohort_obs=True,
+        cohort_threshold=0.75)
+    row = {"ok": rep["ok"]}
+    if not rep["ok"]:
+        violations.append(f"cohort_baseline: cohort failed "
+                          f"({rep.get('error')}; events {rep['events']})")
+        return row
+    co = rep.get("cohort") or {}
+    row.update({"ranks": co.get("ranks"),
+                "lanes": co.get("lanes"),
+                "steady_skew_frac": co.get("steady_skew_frac"),
+                "findings": [f.get("code") for f in
+                             (co.get("findings") or [])]})
+    if co.get("error"):
+        violations.append(f"cohort_baseline: report error {co['error']}")
+        return row
+    if co.get("ranks") != list(range(ctx["nproc"])):
+        violations.append(f"cohort_baseline: expected manifests from all "
+                          f"{ctx['nproc']} ranks, got {co.get('ranks')}")
+    if not co.get("merged_trace_valid"):
+        violations.append(
+            f"cohort_baseline: merged trace failed validate_chrome_trace "
+            f"({co.get('merged_trace_problems')})")
+    if len(co.get("lanes") or []) != ctx["nproc"]:
+        violations.append(f"cohort_baseline: merged trace has lanes "
+                          f"{co.get('lanes')}, expected one per rank")
+    obs003 = [f for f in (co.get("findings") or [])
+              if f.get("code") == "OBS003"]
+    if obs003:
+        violations.append(f"cohort_baseline: clean cohort fired OBS003 "
+                          f"({obs003})")
+    attr = co.get("attribution") or {}
+    rec = attr.get("reconciliation") or {}
+    if not rec.get("reconciles"):
+        violations.append(f"cohort_baseline: cohort attribution does not "
+                          f"telescope (error {rec.get('error')})")
+    if "rank_skew" not in (attr.get("phase_order") or []):
+        violations.append("cohort_baseline: rank_skew missing from the "
+                          "cohort attribution phase order")
+    return row
+
+
+def _sc_cohort_slow_peer(ctx, violations) -> Dict:
+    """The falsifiable gate: a persistently stalled rank 1 (p=1.0
+    slow_peer, 0.25s every step) must be NAMED as the straggler and
+    OBS003 must fire. The stall must dominate the OTHER rank's worst
+    steps, and checkpointing stays off (interval=0): checkpoint ack
+    barriers couple rank 0's step time to the straggler's (it waits for
+    rank 1's shard), measurably halving the skew fraction — a 0.05s
+    stall under interval checkpoints loses the straggler verdict to
+    that jitter outright. Hang detection stays off too — the stall is a
+    straggler, not a hang."""
+    plan = {"schema": 1, "seed": 0,
+            "sites": {"multihost.slow_peer": {"p": 1.0, "stall_s": 0.25}}}
+    rep = supervise(nproc=ctx["nproc"], run_dir=os.path.join(
+        ctx["base"], "cohort_slow"), devices_per_proc=ctx["devices"],
+        cache_dir=ctx["cache"], fault_plan=plan, fault_rank=1,
+        max_relaunches=0, interval=0, cohort_timeout_s=ctx["timeout"],
+        cohort_obs=True, cohort_threshold=0.5)
+    row = {"ok": rep["ok"]}
+    if not rep["ok"]:
+        violations.append(f"cohort_slow_peer: cohort failed "
+                          f"({rep.get('error')}; events {rep['events']})")
+        return row
+    co = rep.get("cohort") or {}
+    row.update({"straggler_rank": co.get("straggler_rank"),
+                "steady_skew_frac": co.get("steady_skew_frac"),
+                "findings": [f.get("code") for f in
+                             (co.get("findings") or [])]})
+    if co.get("error"):
+        violations.append(f"cohort_slow_peer: report error {co['error']}")
+        return row
+    if co.get("straggler_rank") != 1:
+        violations.append(f"cohort_slow_peer: seeded slow rank 1 not "
+                          f"named straggler (got "
+                          f"{co.get('straggler_rank')}, skew "
+                          f"{co.get('steady_skew_frac')})")
+    if not any(f.get("code") == "OBS003"
+               for f in (co.get("findings") or [])):
+        violations.append(f"cohort_slow_peer: OBS003 did not fire for a "
+                          f"persistently stalled rank (skew "
+                          f"{co.get('steady_skew_frac')})")
+    return row
+
+
 MATRIX = {
     "baseline": _sc_baseline,
     "kill_resume": _sc_kill_resume,
     "shrink_resize": _sc_shrink_resize,
     "hang_relaunch": _sc_hang_relaunch,
     "init_retry_exclusion": _sc_init_retry_exclusion,
+    "cohort_baseline": _sc_cohort_baseline,
+    "cohort_slow_peer": _sc_cohort_slow_peer,
 }
 # baseline first (comparisons), shrink after kill (reuses its ckpt dir)
 MATRIX_ORDER = ("baseline", "kill_resume", "shrink_resize",
-                "hang_relaunch", "init_retry_exclusion")
+                "hang_relaunch", "init_retry_exclusion",
+                "cohort_baseline", "cohort_slow_peer")
 
 
 def run_matrix(scenarios=None, base_dir: Optional[str] = None,
@@ -928,6 +1063,13 @@ def main(argv=None) -> int:
     ap.add_argument("--no-search", action="store_true",
                     help="worker: skip the strategy search + cache "
                          "(cheap launch-mechanics runs)")
+    ap.add_argument("--cohort-obs", action="store_true",
+                    help="per-rank trace/metrics artifacts + the "
+                         "supervisor's merged cohort report "
+                         "(config.cohort_obs=on in every worker)")
+    ap.add_argument("--cohort-threshold", type=float, default=0.25,
+                    help="cohort_skew_threshold handed to workers and "
+                         "the supervisor's skew analysis")
     ap.add_argument("--fault-plan", default=None,
                     help="JSON fault plan (supervisor: armed on "
                          "--fault-rank, first launch only)")
@@ -958,7 +1100,8 @@ def main(argv=None) -> int:
         fault_rank=ns.fault_rank, hang_threshold_s=ns.hang_threshold,
         max_relaunches=ns.max_relaunches,
         watchdog_threshold_s=ns.watchdog_threshold,
-        init_timeout_s=ns.init_timeout, cache_dir=ns.cache_dir)
+        init_timeout_s=ns.init_timeout, cache_dir=ns.cache_dir,
+        cohort_obs=ns.cohort_obs, cohort_threshold=ns.cohort_threshold)
     print(json.dumps(rep, sort_keys=True, default=str))
     return 0 if rep["ok"] else 1
 

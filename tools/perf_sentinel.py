@@ -133,6 +133,11 @@ def _judge_cohort(key: str, runs: List[Dict], margin: float,
         # ratio; None when the run carried no attribution block
         "dominant_phase": (newest.get("attribution") or {}).get(
             "dominant_phase"),
+        # the cohort-observability verdict, same contract: a multi-rank
+        # run that regressed names WHICH rank paced it (obs/cohort.py);
+        # None when the run carried no cohort skew block
+        "straggler_rank": (newest.get("cohort") or {}).get(
+            "straggler_rank"),
     }
     if len(prior) < min_baseline:
         row.update({"verdict": "no_baseline", "baseline_runs": len(prior)})
